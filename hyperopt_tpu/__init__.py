@@ -1,0 +1,36 @@
+"""hyperopt_tpu — a TPU-native hyperparameter-optimization framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of hyperopt
+(reference: pminervini/hyperopt; see SURVEY.md): ``fmin``, the ``hp.*``
+search-space language including conditional ``hp.choice`` spaces, the
+``Trials`` store, and the random / TPE / annealing suggesters behind the
+``algo=`` plugin boundary — with search spaces compiled to jitted samplers,
+device-resident trial history, and the TPE hot path running as vmapped /
+mesh-sharded XLA kernels.
+"""
+
+from . import hp, spaces
+from .exceptions import (
+    AllTrialsFailed,
+    DuplicateLabel,
+    InvalidAnnotatedParameter,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+from .spaces import space_eval
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "hp",
+    "spaces",
+    "space_eval",
+    "AllTrialsFailed",
+    "DuplicateLabel",
+    "InvalidAnnotatedParameter",
+    "InvalidLoss",
+    "InvalidResultStatus",
+    "InvalidTrial",
+    "__version__",
+]

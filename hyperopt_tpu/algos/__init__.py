@@ -1,0 +1,12 @@
+"""Suggest algorithms behind the ``algo=`` plugin boundary.
+
+The plugin signature is preserved from the reference
+(``hyperopt/rand.py``/``tpe.py`` sym: suggest):
+
+    suggest(new_ids, domain, trials, seed, **kwargs) -> [trial docs]
+
+so ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` keeps
+working as the configuration mechanism (SURVEY.md §5 "Config / flag system").
+"""
+
+from . import anneal, mix, rand, tpe  # noqa: F401

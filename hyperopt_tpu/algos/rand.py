@@ -1,0 +1,72 @@
+"""Random-search suggester on ``jax.random``.
+
+Parity target: ``hyperopt/rand.py`` (sym: suggest, suggest_batch).  The
+reference seeds a fresh numpy RandomState per new id and interprets the
+vectorized pyll program; here each new id folds into a threefry key and the
+compiled space's jitted ``sample_flat`` draws every parameter in one traced
+program (batched across ids via ``vmap``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs"]
+
+
+def flat_to_new_trial_docs(domain, trials, new_ids, flats):
+    """Build reference-shaped trial docs from flat per-label samples.
+
+    ``flats``: list of {label: host scalar}.  Inactive conditional params get
+    empty idxs/vals (the sparse doc form of hyperopt/vectorize.py).
+    """
+    rval = []
+    for new_id, flat in zip(new_ids, flats):
+        active = domain.cs.active_flat(flat)
+        idxs = {}
+        vals = {}
+        for label, info in domain.cs.params.items():
+            if active[label]:
+                v = flat[label]
+                v = int(v) if info.is_int else float(v)
+                idxs[label] = [new_id]
+                vals[label] = [v]
+            else:
+                idxs[label] = []
+                vals[label] = []
+        misc = {"tid": new_id, "cmd": ("domain_attachment", "FMinIter_Domain"),
+                "idxs": idxs, "vals": vals}
+        if domain.workdir is not None:
+            misc["workdir"] = domain.workdir
+        rval.extend(
+            trials.new_trial_docs([new_id], [None], [domain.new_result()], [misc])
+        )
+    return rval
+
+
+def _flat_to_host(flat):
+    return {k: np.asarray(v).item() for k, v in flat.items()}
+
+
+def suggest(new_ids, domain, trials, seed):
+    """Draw one prior sample per new id (hyperopt/rand.py sym: suggest)."""
+    key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+    flats = []
+    for new_id in new_ids:
+        k = jax.random.fold_in(key, int(new_id) & 0x7FFFFFFF)
+        flats.append(_flat_to_host(domain.cs.sample_flat_jit(k)))
+    return flat_to_new_trial_docs(domain, trials, new_ids, flats)
+
+
+def suggest_batch(new_ids, domain, trials, seed):
+    """Vectorized variant: one vmapped device program for all ids."""
+    key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jax.numpy.asarray([int(i) & 0x7FFFFFFF for i in new_ids])
+    )
+    batch = jax.jit(jax.vmap(domain.cs.sample_flat))(keys)
+    host = {k: np.asarray(v) for k, v in batch.items()}
+    flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
+    return flat_to_new_trial_docs(domain, trials, new_ids, flats)

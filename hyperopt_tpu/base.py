@@ -1,0 +1,674 @@
+"""Core runtime: trial documents, trial stores, Domain, Ctrl.
+
+Parity target: ``hyperopt/base.py`` (sym: JOB_STATE_*, STATUS_*, Trials,
+Domain, Ctrl, trials_from_docs, miscs_to_idxs_vals, miscs_update_idxs_vals,
+spec_from_misc, SONify).
+
+TPU-first additions:
+
+* ``Trials`` keeps the reference's list-of-SON-documents API (pickle-compatible
+  shape), but also maintains an incremental **padded structure-of-arrays
+  history** per hyperparameter label — ``vals[f32, cap]``, ``active[bool, cap]``,
+  ``losses[f32, cap]`` — the dense device-side analog of the sparse
+  ``(idxs, vals)`` form produced by ``hyperopt/vectorize.py``.  Suggesters
+  consume this directly; capacities grow by power-of-two buckets so the jitted
+  TPE kernel recompiles only O(log n) times as history grows.
+* ``Domain`` compiles the search space once (``spaces.compile_space``) instead
+  of building a pyll ``VectorizeHelper`` program; evaluation assembles the
+  structured config on host and calls the objective, or — when the objective
+  is JAX-traceable — evaluates a whole batch of configs under ``vmap``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import numbers
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .exceptions import (
+    AllTrialsFailed,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+from .spaces import CompiledSpace, as_expr, compile_space
+
+__all__ = [
+    "JOB_STATE_NEW",
+    "JOB_STATE_RUNNING",
+    "JOB_STATE_DONE",
+    "JOB_STATE_ERROR",
+    "JOB_STATE_CANCEL",
+    "JOB_STATES",
+    "STATUS_NEW",
+    "STATUS_RUNNING",
+    "STATUS_SUSPENDED",
+    "STATUS_OK",
+    "STATUS_FAIL",
+    "STATUS_STRINGS",
+    "SONify",
+    "miscs_to_idxs_vals",
+    "miscs_update_idxs_vals",
+    "spec_from_misc",
+    "Trials",
+    "trials_from_docs",
+    "Ctrl",
+    "Domain",
+    "PaddedHistory",
+]
+
+# -- job states (hyperopt/base.py sym: JOB_STATE_*) -------------------------
+JOB_STATE_NEW = 0
+JOB_STATE_RUNNING = 1
+JOB_STATE_DONE = 2
+JOB_STATE_ERROR = 3
+JOB_STATE_CANCEL = 4
+JOB_STATES = [JOB_STATE_NEW, JOB_STATE_RUNNING, JOB_STATE_DONE, JOB_STATE_ERROR, JOB_STATE_CANCEL]
+
+# -- result statuses (hyperopt/base.py sym: STATUS_*) -----------------------
+STATUS_NEW = "new"
+STATUS_RUNNING = "running"
+STATUS_SUSPENDED = "suspended"
+STATUS_OK = "ok"
+STATUS_FAIL = "fail"
+STATUS_STRINGS = (STATUS_NEW, STATUS_RUNNING, STATUS_SUSPENDED, STATUS_OK, STATUS_FAIL)
+
+_MIN_CAP = 64  # smallest padded-history capacity bucket
+
+
+def coarse_utcnow():
+    """Timestamp truncated to ms (hyperopt/utils.py sym: coarse_utcnow)."""
+    now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+    return now.replace(microsecond=(now.microsecond // 1000) * 1000)
+
+
+def SONify(arg):
+    """Coerce to JSON/BSON-safe python types (hyperopt/base.py sym: SONify)."""
+    if isinstance(arg, dict):
+        return {SONify(k): SONify(v) for k, v in arg.items()}
+    if isinstance(arg, (list, tuple)):
+        return [SONify(a) for a in arg]
+    if isinstance(arg, (np.ndarray, jax.Array)):
+        return SONify(np.asarray(arg).tolist())
+    if isinstance(arg, (np.bool_, bool)):
+        return bool(arg)
+    if isinstance(arg, numbers.Integral):
+        return int(arg)
+    if isinstance(arg, numbers.Real):
+        return float(arg)
+    if isinstance(arg, (str, bytes, type(None), datetime.datetime)):
+        return arg
+    raise TypeError(f"cannot SONify {type(arg)}: {arg!r}")
+
+
+# -- misc helpers (hyperopt/base.py sym: miscs_to_idxs_vals etc.) -----------
+
+
+def miscs_update_idxs_vals(miscs, idxs, vals, assert_all_vals_used=True, idxs_map=None):
+    """Write per-label sparse (idxs, vals) into trial misc documents."""
+    if idxs_map is None:
+        idxs_map = {}
+    misc_by_id = {m["tid"]: m for m in miscs}
+    for m in miscs:
+        m.setdefault("idxs", {})
+        m.setdefault("vals", {})
+        for label in idxs:
+            m["idxs"].setdefault(label, [])
+            m["vals"].setdefault(label, [])
+    for label in idxs:
+        for tid, val in zip(idxs[label], vals[label]):
+            tid = idxs_map.get(tid, tid)
+            if tid in misc_by_id:
+                misc_by_id[tid]["idxs"][label] = [tid]
+                misc_by_id[tid]["vals"][label] = [val]
+            elif assert_all_vals_used:
+                raise InvalidTrial(f"no misc with tid {tid}")
+    return miscs
+
+
+def miscs_to_idxs_vals(miscs, keys=None):
+    """Gather per-label sparse (idxs, vals) from trial misc documents."""
+    if keys is None:
+        if len(miscs) == 0:
+            raise ValueError("cannot infer keys from empty miscs")
+        keys = list(miscs[0]["idxs"].keys())
+    idxs = {k: [] for k in keys}
+    vals = {k: [] for k in keys}
+    for m in miscs:
+        for k in keys:
+            t = m["idxs"].get(k, [])
+            v = m["vals"].get(k, [])
+            assert len(t) == len(v)
+            idxs[k].extend(t)
+            vals[k].extend(v)
+    return idxs, vals
+
+
+def spec_from_misc(misc):
+    """Flat ``{label: value}`` config from one misc (hyperopt/base.py sym:
+    spec_from_misc) — inactive conditional params are absent."""
+    spec = {}
+    for k, v in misc["vals"].items():
+        if len(v) == 0:
+            continue
+        if len(v) == 1:
+            spec[k] = v[0]
+        else:
+            raise InvalidTrial(f"multiple values for {k} in one trial")
+    return spec
+
+
+def _validate_trial_doc(doc):
+    required = ("tid", "spec", "result", "misc", "state", "exp_key", "owner", "version")
+    for k in required:
+        if k not in doc:
+            raise InvalidTrial(f"trial document missing key {k!r}: {sorted(doc)}")
+    if doc["state"] not in JOB_STATES:
+        raise InvalidTrial(f"invalid state {doc['state']!r}")
+    misc = doc["misc"]
+    for k in ("tid", "cmd", "idxs", "vals"):
+        if k not in misc:
+            raise InvalidTrial(f"trial misc missing key {k!r}")
+    if misc["tid"] != doc["tid"]:
+        raise InvalidTrial(f"tid mismatch: {misc['tid']} != {doc['tid']}")
+    return doc
+
+
+def _bucket_cap(n: int) -> int:
+    """Smallest power-of-two bucket ≥ n (min _MIN_CAP) — bounds recompiles."""
+    cap = _MIN_CAP
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class PaddedHistory:
+    """Dense, padded structure-of-arrays view of trial history.
+
+    This is what the jitted suggesters consume: for each label an
+    ``(vals[cap], active[cap])`` pair plus ``losses[cap]`` and the live count
+    ``n``.  Padding slots have ``active=False`` and ``loss=+inf``; capacities
+    are power-of-two buckets so kernel shapes are stable.  The dense analog of
+    the reference's sparse per-label ``(idxs, vals)`` (SURVEY.md §7.1).
+    """
+
+    def __init__(self, labels):
+        self.labels = tuple(labels)
+        self.n = 0
+        self.cap = _MIN_CAP
+        self._vals = {l: np.zeros(self.cap, np.float32) for l in self.labels}
+        self._active = {l: np.zeros(self.cap, bool) for l in self.labels}
+        self._losses = np.full(self.cap, np.inf, np.float32)
+        self._has_loss = np.zeros(self.cap, bool)
+
+    def _grow(self, need):
+        new_cap = _bucket_cap(need)
+        if new_cap <= self.cap:
+            return
+        pad = new_cap - self.cap
+        for l in self.labels:
+            self._vals[l] = np.concatenate([self._vals[l], np.zeros(pad, np.float32)])
+            self._active[l] = np.concatenate([self._active[l], np.zeros(pad, bool)])
+        self._losses = np.concatenate([self._losses, np.full(pad, np.inf, np.float32)])
+        self._has_loss = np.concatenate([self._has_loss, np.zeros(pad, bool)])
+        self.cap = new_cap
+
+    def append(self, flat_vals: dict, loss):
+        """Record one finished trial (flat {label: value}; absent = inactive)."""
+        self._grow(self.n + 1)
+        i = self.n
+        for l in self.labels:
+            if l in flat_vals and flat_vals[l] is not None:
+                self._vals[l][i] = float(flat_vals[l])
+                self._active[l][i] = True
+        if loss is not None and math.isfinite(float(loss)):
+            self._losses[i] = float(loss)
+            self._has_loss[i] = True
+        self.n += 1
+
+    def device_view(self):
+        """Arrays for the jitted kernels (converted lazily by jnp.asarray)."""
+        return {
+            "vals": {l: self._vals[l] for l in self.labels},
+            "active": {l: self._active[l] for l in self.labels},
+            "losses": self._losses,
+            "has_loss": self._has_loss,
+            "n": self.n,
+            "cap": self.cap,
+        }
+
+
+class Ctrl:
+    """Control object handed to low-level objectives
+    (hyperopt/base.py sym: Ctrl: checkpoint, inject_results, current_trial)."""
+
+    def __init__(self, trials, current_trial=None):
+        self.trials = trials
+        self.current_trial = current_trial
+
+    @property
+    def attachments(self):
+        return self.trials.attachments
+
+    def checkpoint(self, result=None):
+        if self.current_trial is not None and result is not None:
+            self.current_trial["result"] = result
+
+    def inject_results(self, specs, results, miscs, new_tids=None):
+        if new_tids is None:
+            new_tids = self.trials.new_trial_ids(len(specs))
+        docs = self.trials.new_trial_docs(new_tids, specs, results, miscs)
+        for doc in docs:
+            doc["state"] = JOB_STATE_DONE
+        return self.trials.insert_trial_docs(docs)
+
+
+class Trials:
+    """In-memory trial store, document-compatible with the reference
+    (hyperopt/base.py sym: Trials), plus an incremental padded SoA history.
+
+    ``asynchronous=False``: ``fmin`` evaluates trials serially in-process.
+    Subclasses with ``asynchronous=True`` (see ``parallel/executor.py``)
+    dispatch evaluation elsewhere, the analog of MongoTrials/SparkTrials.
+    """
+
+    asynchronous = False
+
+    def __init__(self, exp_key=None, refresh=True):
+        self._ids = set()
+        self._dynamic_trials = []
+        self._exp_key = exp_key
+        self.attachments = {}
+        self._history = None  # PaddedHistory, built lazily once labels known
+        self._history_synced = 0  # number of docs folded into history
+        if refresh:
+            self.refresh()
+
+    # -- basic container protocol ----------------------------------------
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __iter__(self):
+        return iter(self._trials)
+
+    def __getitem__(self, item):
+        return self._trials[item]
+
+    # -- refresh / insert --------------------------------------------------
+
+    def refresh(self):
+        if self._exp_key is None:
+            self._trials = [d for d in self._dynamic_trials if d["state"] != JOB_STATE_ERROR]
+        else:
+            self._trials = [
+                d
+                for d in self._dynamic_trials
+                if d["state"] != JOB_STATE_ERROR and d["exp_key"] == self._exp_key
+            ]
+        self._ids.update(d["tid"] for d in self._dynamic_trials)
+
+    def insert_trial_doc(self, doc):
+        doc = _validate_trial_doc(doc)
+        self._dynamic_trials.append(doc)
+        return doc["tid"]
+
+    def insert_trial_docs(self, docs):
+        return [self.insert_trial_doc(d) for d in docs]
+
+    def delete_all(self):
+        self._dynamic_trials = []
+        self._ids = set()
+        self.attachments = {}
+        self._history = None
+        self._history_synced = 0
+        self.refresh()
+
+    # -- id/doc generation -------------------------------------------------
+
+    def new_trial_ids(self, n):
+        aa = len(self._ids)
+        rval = list(range(aa, aa + n))
+        self._ids.update(rval)
+        return rval
+
+    def new_trial_docs(self, tids, specs, results, miscs):
+        rval = []
+        for tid, spec, result, misc in zip(tids, specs, results, miscs):
+            doc = {
+                "state": JOB_STATE_NEW,
+                "tid": tid,
+                "spec": spec,
+                "result": result,
+                "misc": misc,
+                "exp_key": self._exp_key,
+                "owner": None,
+                "version": 0,
+                "book_time": None,
+                "refresh_time": None,
+            }
+            rval.append(doc)
+        return rval
+
+    def source_trial_docs(self, tids, specs, results, miscs, sources):
+        rval = self.new_trial_docs(tids, specs, results, miscs)
+        for doc in rval:
+            doc["from_tid"] = sources[0]["tid"] if sources else None
+        return rval
+
+    # -- properties (hyperopt/base.py sym: Trials.{trials,tids,...}) -------
+
+    @property
+    def trials(self):
+        return self._trials
+
+    @property
+    def tids(self):
+        return [d["tid"] for d in self._trials]
+
+    @property
+    def specs(self):
+        return [d["spec"] for d in self._trials]
+
+    @property
+    def results(self):
+        return [d["result"] for d in self._trials]
+
+    @property
+    def miscs(self):
+        return [d["misc"] for d in self._trials]
+
+    @property
+    def idxs_vals(self):
+        return miscs_to_idxs_vals(self.miscs)
+
+    @property
+    def idxs(self):
+        return self.idxs_vals[0]
+
+    @property
+    def vals(self):
+        return self.idxs_vals[1]
+
+    def losses(self, bandit=None):
+        return [r.get("loss") for r in self.results]
+
+    def statuses(self, bandit=None):
+        return [r.get("status") for r in self.results]
+
+    def count_by_state_synced(self, arg, trials=None):
+        if trials is None:
+            trials = self._trials
+        if isinstance(arg, int):
+            queue = [d for d in trials if d["state"] == arg]
+        else:
+            queue = [d for d in trials if d["state"] in arg]
+        return len(queue)
+
+    def count_by_state_unsynced(self, arg):
+        if self._exp_key is not None:
+            exp_trials = [d for d in self._dynamic_trials if d["exp_key"] == self._exp_key]
+        else:
+            exp_trials = self._dynamic_trials
+        return self.count_by_state_synced(arg, trials=exp_trials)
+
+    def average_best_error(self, domain=None):
+        """Mean true-loss of the best-scoring ok trials
+        (hyperopt/base.py sym: Trials.average_best_error)."""
+        if domain is None:
+            results = [r for r in self.results if r.get("status") == STATUS_OK]
+            losses = np.array([r["loss"] for r in results if r.get("loss") is not None])
+            if len(losses) == 0:
+                raise AllTrialsFailed()
+            return float(losses.min())
+        results = [r for r in self.results if domain.status(r) == STATUS_OK]
+        losses = np.array([domain.loss(r) for r in results], dtype=float)
+        if len(losses) == 0:
+            raise AllTrialsFailed()
+        vars_ = np.array([domain.loss_variance(r) or 0.0 for r in results], dtype=float)
+        true = np.array(
+            [
+                domain.true_loss(r) if domain.true_loss(r) is not None else l
+                for r, l in zip(results, losses)
+            ],
+            dtype=float,
+        )
+        thresh = losses.min() + 3 * np.sqrt(vars_[np.argmin(losses)] if len(vars_) else 0.0)
+        best = true[losses <= thresh]
+        return float(best.mean())
+
+    @property
+    def best_trial(self):
+        candidates = [
+            d
+            for d in self._trials
+            if d["result"].get("status") == STATUS_OK and d["result"].get("loss") is not None
+        ]
+        if not candidates:
+            raise AllTrialsFailed()
+        return min(candidates, key=lambda d: d["result"]["loss"])
+
+    @property
+    def argmin(self):
+        return spec_from_misc(self.best_trial["misc"])
+
+    def trial_attachments(self, trial):
+        """Per-trial attachment dict view keyed under ATTACH::<tid>::."""
+        tid = trial["tid"]
+        store = self.attachments
+        prefix = f"ATTACH::{tid}::"
+
+        class _View:
+            def __setitem__(_, k, v):
+                store[prefix + k] = v
+
+            def __getitem__(_, k):
+                return store[prefix + k]
+
+            def __contains__(_, k):
+                return (prefix + k) in store
+
+            def __delitem__(_, k):
+                del store[prefix + k]
+
+            def keys(_):
+                return [k[len(prefix):] for k in store if k.startswith(prefix)]
+
+        return _View()
+
+    # -- padded SoA history (TPU-native addition) --------------------------
+
+    def padded_history(self, labels):
+        """Incrementally fold DONE trials into the dense padded history and
+        return its device view.  O(new trials) per call."""
+        if self._history is None or self._history.labels != tuple(labels):
+            self._history = PaddedHistory(labels)
+            self._history_synced = 0
+        docs = self._dynamic_trials
+        while self._history_synced < len(docs):
+            doc = docs[self._history_synced]
+            self._history_synced += 1
+            if doc["state"] != JOB_STATE_DONE:
+                continue
+            result = doc["result"]
+            loss = result.get("loss") if result.get("status") == STATUS_OK else None
+            self._history.append(spec_from_misc(doc["misc"]), loss)
+        return self._history.device_view()
+
+    def fmin(
+        self,
+        fn,
+        space,
+        algo=None,
+        max_evals=None,
+        timeout=None,
+        loss_threshold=None,
+        max_queue_len=1,
+        rstate=None,
+        verbose=False,
+        pass_expr_memo_ctrl=None,
+        catch_eval_exceptions=False,
+        return_argmin=True,
+        show_progressbar=True,
+        early_stop_fn=None,
+        trials_save_file="",
+    ):
+        from .fmin import fmin as _fmin
+
+        return _fmin(
+            fn,
+            space,
+            algo=algo,
+            max_evals=max_evals,
+            timeout=timeout,
+            loss_threshold=loss_threshold,
+            trials=self,
+            rstate=rstate,
+            verbose=verbose,
+            allow_trials_fmin=False,
+            pass_expr_memo_ctrl=pass_expr_memo_ctrl,
+            catch_eval_exceptions=catch_eval_exceptions,
+            return_argmin=return_argmin,
+            max_queue_len=max_queue_len,
+            show_progressbar=show_progressbar,
+            early_stop_fn=early_stop_fn,
+            trials_save_file=trials_save_file,
+        )
+
+    # pickle: drop the numpy history (rebuilt lazily) for a compact file
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_history"] = None
+        state["_history_synced"] = 0
+        return state
+
+
+def trials_from_docs(docs, validate=True, **kwargs):
+    """Build Trials from documents (hyperopt/base.py sym: trials_from_docs)."""
+    rval = Trials(**kwargs)
+    if validate:
+        for doc in docs:
+            _validate_trial_doc(doc)
+    rval._dynamic_trials = list(docs)
+    rval.refresh()
+    return rval
+
+
+class Domain:
+    """Binds objective + compiled search space
+    (hyperopt/base.py sym: Domain.__init__, Domain.evaluate).
+
+    The pyll machinery (``self.expr``, ``VectorizeHelper``, ``s_idxs_vals``,
+    ``memo_from_config``) is replaced by a ``CompiledSpace``: a static param
+    table plus jitted samplers.  ``evaluate`` assembles the structured config
+    on host; ``evaluate_batch_traced`` vmaps objective evaluation on device
+    for JAX-traceable objectives (the reference has no analog — SURVEY.md
+    §2.2 row "Data parallel").
+    """
+
+    rec_eval_print_node_on_error = False
+
+    def __init__(
+        self,
+        fn,
+        expr,
+        workdir=None,
+        pass_expr_memo_ctrl=None,
+        name=None,
+        loss_target=None,
+    ):
+        self.fn = fn
+        self.space = expr
+        self.expr = as_expr(expr)
+        self.cs: CompiledSpace = compile_space(expr)
+        self.params = self.cs.params
+        self.workdir = workdir
+        self.name = name
+        self.loss_target = loss_target
+        self.pass_expr_memo_ctrl = bool(
+            pass_expr_memo_ctrl
+            if pass_expr_memo_ctrl is not None
+            else getattr(fn, "fmin_pass_expr_memo_ctrl", False)
+        )
+
+    @property
+    def labels(self):
+        return self.cs.labels
+
+    def evaluate(self, config, ctrl, attach_attachments=True):
+        """Run the objective on one flat config (hyperopt/base.py sym:
+        Domain.evaluate)."""
+        if self.pass_expr_memo_ctrl:
+            rval = self.fn(expr=self.expr, memo=dict(config), ctrl=ctrl)
+        else:
+            pyll_rval = self.cs.assemble(config)
+            rval = self.fn(pyll_rval)
+
+        if isinstance(rval, (float, int, np.floating, np.integer)) or (
+            isinstance(rval, (np.ndarray, jax.Array)) and np.ndim(rval) == 0
+        ):
+            loss = float(rval)
+            if math.isnan(loss):
+                raise InvalidLoss(f"objective returned NaN for config {config}")
+            dict_rval = {"loss": loss, "status": STATUS_OK}
+        else:
+            dict_rval = dict(rval)
+            status = dict_rval.get("status")
+            if status not in STATUS_STRINGS:
+                raise InvalidResultStatus(f"invalid status {status!r}")
+            if status == STATUS_OK:
+                if "loss" not in dict_rval:
+                    raise InvalidLoss("ok result without loss")
+                loss = float(dict_rval["loss"])
+                if math.isnan(loss):
+                    raise InvalidLoss(f"objective returned NaN for config {config}")
+                dict_rval["loss"] = loss
+
+        if attach_attachments and ctrl is not None:
+            attachments = dict_rval.pop("attachments", {})
+            if ctrl.current_trial is not None:
+                view = ctrl.trials.trial_attachments(ctrl.current_trial)
+                for k, v in attachments.items():
+                    view[k] = v
+        return dict_rval
+
+    def evaluate_async(self, config, ctrl, attach_attachments=True):
+        return self.evaluate(config, ctrl, attach_attachments)
+
+    def make_batch_eval(self):
+        """Return a jitted ``(flat_batch) -> losses`` for traceable objectives:
+        assembles under trace (lax.switch for choices) and vmaps the user fn."""
+
+        def one(flat):
+            structured = self.cs.assemble(flat, traced=True)
+            return self.fn(structured)
+
+        return jax.jit(jax.vmap(one))
+
+    def short_str(self):
+        return f"Domain{{{getattr(self.fn, '__name__', 'fn')}}}"
+
+    # -- result field accessors (hyperopt/base.py sym: Domain.loss etc.) ---
+
+    def loss(self, result, config=None):
+        return result.get("loss")
+
+    def loss_variance(self, result, config=None):
+        return result.get("loss_variance", 0.0)
+
+    def true_loss(self, result, config=None):
+        return result.get("true_loss", result.get("loss"))
+
+    def true_loss_variance(self, result, config=None):
+        return result.get("true_loss_variance", 0.0)
+
+    def status(self, result, config=None):
+        return result.get("status")
+
+    def new_result(self):
+        return {"status": STATUS_NEW}

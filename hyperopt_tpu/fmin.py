@@ -1,0 +1,420 @@
+"""The optimization driver: ``fmin`` and the ask→tell loop.
+
+Parity target: ``hyperopt/fmin.py`` (sym: fmin, FMinIter, space_eval,
+generate_trials_to_calculate, fmin_pass_expr_memo_ctrl), including timeout,
+loss_threshold, early_stop_fn, points_to_evaluate, trials_save_file and the
+``HYPEROPT_FMIN_SEED`` environment default.
+
+The loop itself is host-side control (as in the reference); all numeric work
+happens inside the suggester's jitted kernels.  For fully JAX-traceable
+objectives, ``device_fmin.fmin_device`` runs the entire loop on-device under
+``lax.scan`` instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+
+import numpy as np
+
+from . import progress as progress_mod
+from .base import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+    Trials,
+    spec_from_misc,
+    trials_from_docs,
+)
+from .exceptions import AllTrialsFailed, InvalidTrial
+from .spaces import space_eval  # re-export (hyperopt/fmin.py sym: space_eval)
+
+__all__ = [
+    "fmin",
+    "FMinIter",
+    "space_eval",
+    "fmin_pass_expr_memo_ctrl",
+    "generate_trials_to_calculate",
+    "partial",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def fmin_pass_expr_memo_ctrl(f):
+    """Decorator: objective wants (expr, memo, ctrl) instead of a sampled point
+    (hyperopt/fmin.py sym: fmin_pass_expr_memo_ctrl)."""
+    f.fmin_pass_expr_memo_ctrl = True
+    return f
+
+
+def generate_trial(tid, space_points):
+    """One NEW trial doc pinning explicit hyperparameter values
+    (hyperopt/fmin.py sym: generate_trial)."""
+    variables = space_points.keys()
+    idxs = {v: [tid] for v in variables}
+    vals = {v: [space_points[v]] for v in variables}
+    return {
+        "state": JOB_STATE_NEW,
+        "tid": tid,
+        "spec": None,
+        "result": {"status": "new"},
+        "misc": {
+            "tid": tid,
+            "cmd": ("domain_attachment", "FMinIter_Domain"),
+            "idxs": idxs,
+            "vals": vals,
+        },
+        "exp_key": None,
+        "owner": None,
+        "version": 0,
+        "book_time": None,
+        "refresh_time": None,
+    }
+
+
+def generate_trials_to_calculate(points):
+    """Trials pre-loaded with explicit points (hyperopt/fmin.py sym:
+    generate_trials_to_calculate) — implements ``points_to_evaluate``."""
+    return trials_from_docs([generate_trial(tid, x) for tid, x in enumerate(points)])
+
+
+class FMinIter:
+    """The ask→tell loop (hyperopt/fmin.py sym: FMinIter).
+
+    ``run(N)``: refresh → ask suggester for new trials → insert → evaluate
+    (serially in-process, or poll an asynchronous Trials backend) → check
+    stop conditions → optionally persist.
+    """
+
+    catch_eval_exceptions = False
+    pickle_protocol = -1
+
+    def __init__(
+        self,
+        algo,
+        domain,
+        trials,
+        rstate,
+        asynchronous=None,
+        max_queue_len=1,
+        poll_interval_secs=1.0,
+        max_evals=float("inf"),
+        timeout=None,
+        loss_threshold=None,
+        verbose=False,
+        show_progressbar=True,
+        early_stop_fn=None,
+        trials_save_file="",
+    ):
+        self.algo = algo
+        self.domain = domain
+        self.trials = trials
+        self.asynchronous = trials.asynchronous if asynchronous is None else asynchronous
+        self.rstate = rstate
+        self.max_queue_len = max_queue_len
+        self.poll_interval_secs = poll_interval_secs
+        self.max_evals = max_evals
+        self.timeout = timeout
+        self.loss_threshold = loss_threshold
+        self.start_time = time.time()
+        self.early_stop_fn = early_stop_fn
+        self.trials_save_file = trials_save_file
+        self.verbose = verbose
+        self.show_progressbar = show_progressbar
+        self.early_stop_args = []
+        self.is_cancelled = False
+
+        if self.asynchronous:
+            if "FMinIter_Domain" not in trials.attachments:
+                import cloudpickle
+
+                trials.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+        else:
+            trials.attachments["FMinIter_Domain"] = domain
+
+    def serial_evaluate(self, N=-1):
+        """Evaluate queued NEW trials in-process
+        (hyperopt/fmin.py sym: FMinIter.serial_evaluate)."""
+        for trial in self.trials._dynamic_trials:
+            if trial["state"] != JOB_STATE_NEW:
+                continue
+            trial["state"] = JOB_STATE_RUNNING
+            trial["book_time"] = time.time()
+            spec = spec_from_misc(trial["misc"])
+            ctrl = Ctrl(self.trials, current_trial=trial)
+            try:
+                result = self.domain.evaluate(spec, ctrl)
+            except Exception as e:
+                logger.error("job exception: %s", e)
+                trial["state"] = JOB_STATE_ERROR
+                trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["refresh_time"] = time.time()
+                if not self.catch_eval_exceptions:
+                    self.trials.refresh()
+                    raise
+            else:
+                trial["state"] = JOB_STATE_DONE
+                trial["result"] = result
+                trial["refresh_time"] = time.time()
+            N -= 1
+            if N == 0:
+                break
+        self.trials.refresh()
+
+    def block_until_done(self):
+        """Poll an asynchronous backend until no NEW/RUNNING trials remain
+        (hyperopt/fmin.py sym: FMinIter.block_until_done)."""
+        already_printed = False
+        if self.asynchronous:
+            unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
+
+            def get_queue_len():
+                return self.trials.count_by_state_unsynced(unfinished_states)
+
+            qlen = get_queue_len()
+            while qlen > 0:
+                if not already_printed and self.verbose:
+                    logger.info("Waiting for %d jobs to finish ...", qlen)
+                    already_printed = True
+                time.sleep(self.poll_interval_secs)
+                qlen = get_queue_len()
+            self.trials.refresh()
+        else:
+            self.serial_evaluate()
+
+    def run(self, N, block_until_done=True):
+        trials = self.trials
+        algo = self.algo
+        n_queued = 0
+
+        def get_queue_len():
+            return self.trials.count_by_state_unsynced(JOB_STATE_NEW)
+
+        def get_n_done():
+            return self.trials.count_by_state_unsynced(JOB_STATE_DONE)
+
+        def get_n_unfinished():
+            unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
+            return self.trials.count_by_state_unsynced(unfinished_states)
+
+        stopped = False
+        initial_n_done = get_n_done()
+        n_reported = initial_n_done
+        with progress_mod.get_progress_callback(self.show_progressbar)(
+            initial=initial_n_done, total=self.max_evals
+        ) as progress_ctx:
+            all_trials_complete = False
+            best_loss = float("inf")
+            while n_queued < N or (block_until_done and not all_trials_complete):
+                qlen = get_queue_len()
+                while (
+                    qlen < self.max_queue_len and n_queued < N and not self.is_cancelled
+                ):
+                    n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
+                    new_ids = trials.new_trial_ids(n_to_enqueue)
+                    self.trials.refresh()
+                    new_trials = algo(
+                        new_ids,
+                        self.domain,
+                        trials,
+                        self.rstate.integers(2**31 - 1)
+                        if hasattr(self.rstate, "integers")
+                        else self.rstate.randint(2**31 - 1),
+                    )
+                    assert len(new_ids) >= len(new_trials)
+                    if len(new_trials):
+                        self.trials.insert_trial_docs(new_trials)
+                        self.trials.refresh()
+                        n_queued += len(new_trials)
+                        qlen = get_queue_len()
+                    else:
+                        stopped = True
+                        break
+
+                if self.asynchronous:
+                    # wait for workers to fill in the trials
+                    time.sleep(self.poll_interval_secs)
+                else:
+                    self.serial_evaluate()
+
+                self.trials.refresh()
+                if self.trials_save_file != "":
+                    with open(self.trials_save_file, "wb") as f:
+                        pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+
+                if self.early_stop_fn is not None:
+                    stop, kwargs = self.early_stop_fn(
+                        self.trials, *self.early_stop_args
+                    )
+                    self.early_stop_args = kwargs
+                    if stop:
+                        logger.info("Early stop triggered")
+                        stopped = True
+
+                ok_losses = [
+                    r["loss"]
+                    for r in self.trials.results
+                    if r.get("status") == STATUS_OK and r.get("loss") is not None
+                ]
+                if ok_losses:
+                    new_best = min(ok_losses)
+                    if new_best < best_loss:
+                        best_loss = new_best
+                    progress_ctx.postfix = f"best loss: {best_loss:.6g}"
+                n_done_now = get_n_done()
+                progress_ctx.update(n_done_now - n_reported)
+                n_reported = n_done_now
+
+                if self.timeout is not None and time.time() - self.start_time >= self.timeout:
+                    stopped = True
+                if self.loss_threshold is not None and best_loss <= self.loss_threshold:
+                    stopped = True
+
+                all_trials_complete = get_n_unfinished() == 0
+                if stopped and (not block_until_done or all_trials_complete):
+                    break
+                if stopped and block_until_done:
+                    self.block_until_done()
+                    all_trials_complete = True
+                    break
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.run(1, block_until_done=self.asynchronous)
+        if len(self.trials) >= self.max_evals:
+            raise StopIteration()
+        return self.trials
+
+    def exhaust(self):
+        n_done = len(self.trials)
+        self.run(self.max_evals - n_done, block_until_done=self.asynchronous)
+        self.trials.refresh()
+        return self
+
+
+def fmin(
+    fn,
+    space,
+    algo=None,
+    max_evals=None,
+    timeout=None,
+    loss_threshold=None,
+    trials=None,
+    rstate=None,
+    allow_trials_fmin=True,
+    pass_expr_memo_ctrl=None,
+    catch_eval_exceptions=False,
+    verbose=False,
+    return_argmin=True,
+    points_to_evaluate=None,
+    max_queue_len=1,
+    show_progressbar=True,
+    early_stop_fn=None,
+    trials_save_file="",
+):
+    """Minimize ``fn`` over ``space`` (hyperopt/fmin.py sym: fmin).
+
+    Full keyword parity with the reference; seed defaults to the
+    ``HYPEROPT_FMIN_SEED`` environment variable when set.
+    """
+    if algo is None:
+        from .algos import tpe
+
+        algo = tpe.suggest
+
+    if rstate is None:
+        env_rseed = os.environ.get("HYPEROPT_FMIN_SEED", "")
+        if env_rseed:
+            rstate = np.random.default_rng(int(env_rseed))
+        else:
+            rstate = np.random.default_rng()
+    elif isinstance(rstate, (int, np.integer)):
+        rstate = np.random.default_rng(int(rstate))
+
+    validate_timeout(timeout)
+    validate_loss_threshold(loss_threshold)
+
+    if trials_save_file != "" and trials is None and os.path.exists(trials_save_file):
+        with open(trials_save_file, "rb") as f:
+            trials = pickle.load(f)
+
+    if trials is None:
+        if points_to_evaluate is None:
+            trials = Trials()
+        else:
+            assert isinstance(points_to_evaluate, list)
+            trials = generate_trials_to_calculate(points_to_evaluate)
+
+    if allow_trials_fmin and hasattr(trials, "fmin") and type(trials) is not Trials:
+        return trials.fmin(
+            fn,
+            space,
+            algo=algo,
+            max_evals=max_evals,
+            timeout=timeout,
+            loss_threshold=loss_threshold,
+            max_queue_len=max_queue_len,
+            rstate=rstate,
+            pass_expr_memo_ctrl=pass_expr_memo_ctrl,
+            verbose=verbose,
+            catch_eval_exceptions=catch_eval_exceptions,
+            return_argmin=return_argmin,
+            show_progressbar=show_progressbar,
+            early_stop_fn=early_stop_fn,
+            trials_save_file=trials_save_file,
+        )
+
+    domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
+
+    rval = FMinIter(
+        algo,
+        domain,
+        trials,
+        max_evals=max_evals if max_evals is not None else float("inf"),
+        timeout=timeout,
+        loss_threshold=loss_threshold,
+        rstate=rstate,
+        verbose=verbose,
+        max_queue_len=max_queue_len,
+        show_progressbar=show_progressbar,
+        early_stop_fn=early_stop_fn,
+        trials_save_file=trials_save_file,
+    )
+    rval.catch_eval_exceptions = catch_eval_exceptions
+    rval.exhaust()
+
+    if return_argmin:
+        if len(trials.trials) == 0:
+            raise AllTrialsFailed(
+                f"There are no evaluation tasks, cannot return argmin of task losses."
+            )
+        return trials.argmin
+    if max_evals is not None and len(trials) < max_evals:
+        return trials.argmin if return_argmin else None
+    return None
+
+
+def validate_timeout(timeout):
+    if timeout is not None and (timeout <= 0 or isinstance(timeout, bool)):
+        raise Exception(f"The timeout argument should be None or a positive value. Given value: {timeout}")
+
+
+def validate_loss_threshold(loss_threshold):
+    if loss_threshold is not None and not isinstance(loss_threshold, (int, float)):
+        raise Exception(
+            f"The loss_threshold argument should be None or a numeric value. Given value: {loss_threshold}"
+        )
+
+
+# convenience re-export so ``from hyperopt_tpu.fmin import partial`` idioms work
+from functools import partial  # noqa: E402

@@ -1,0 +1,537 @@
+"""Typed search-space IR compiled to jittable samplers.
+
+This module replaces the reference's interpreted pyll stack — the ``Apply``
+graph + ``rec_eval`` interpreter (``hyperopt/pyll/base.py`` sym: Apply,
+rec_eval), the stochastic node library (``hyperopt/pyll/stochastic.py`` sym:
+uniform..categorical, sample), the ``hp.*`` constructors
+(``hyperopt/pyll_utils.py`` sym: hp_uniform..hp_choice) and the vectorizer
+(``hyperopt/vectorize.py`` sym: VectorizeHelper) — with a TPU-first design:
+
+* A search space is a small **static expression tree** (``Expr``): ``Param``
+  leaves (labeled distributions), ``Choice`` branch points, arithmetic ``Op``
+  nodes, containers and literals.  The structure is fixed at build time, so
+  JAX's tracer plays the role of ``rec_eval``: ``compile_space`` lowers the
+  tree ONCE into a pure function ``sample_flat(key) -> {label: value}`` that
+  jits, vmaps and shards.  There is no runtime graph interpreter.
+* The reference's lazy ``switch`` evaluation of conditional spaces (rec_eval
+  special case, pyll/base.py) cannot exist under XLA's static dataflow.
+  Instead every parameter is drawn unconditionally and a boolean **active
+  mask** per label is computed from the drawn choice indices — the dense
+  analog of vectorize.py's sparse ``(idxs, vals)`` representation.
+* RNG: per-label ``jax.random.fold_in`` of a stable CRC32 label hash replaces
+  the reference's threading of one mutable numpy RandomState through the graph
+  (``hyperopt/pyll/stochastic.py`` sym: recursive_set_rng_kwarg).
+
+Distribution semantics match the reference's stochastic nodes
+(``hyperopt/pyll/stochastic.py``):
+
+* ``loguniform(low, high)``: ``exp(uniform(low, high))`` — bounds in log space.
+* ``q*``: ``round(x / q) * q`` in value space.
+* ``lognormal(mu, sigma)``: mu/sigma parameterize the underlying normal.
+* ``randint(low, high)``: integer in ``[low, high)``.
+* ``uniformint(low, high)``: integer in ``[low, high]`` via quantized uniform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .exceptions import DuplicateLabel, InvalidAnnotatedParameter
+
+__all__ = [
+    "Expr",
+    "Literal",
+    "Op",
+    "Container",
+    "Param",
+    "Choice",
+    "Dist",
+    "ParamInfo",
+    "CompiledSpace",
+    "as_expr",
+    "compile_space",
+    "sample",
+    "space_eval",
+    "expr_to_config",
+    "label_hash",
+]
+
+# Families whose flat value is integral (stored i32): branch indices and ints.
+INT_FAMILIES = frozenset({"randint", "uniformint", "categorical"})
+
+
+def label_hash(label: str) -> int:
+    """Stable 32-bit hash of a parameter label, used to fold RNG keys."""
+    return zlib.crc32(label.encode("utf-8")) & 0x7FFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Expression tree
+# ---------------------------------------------------------------------------
+
+
+class Expr:
+    """Base class for space expressions.
+
+    Supports the arithmetic the reference exposes via ``Apply`` operator
+    dunders (pyll/base.py sym: Apply.__add__ etc.) so idioms like
+    ``hp.uniform('x', 0, 1) + 1`` keep working; the ops are compiled, not
+    interpreted.
+    """
+
+    def __add__(self, other):
+        return Op("add", (self, as_expr(other)))
+
+    def __radd__(self, other):
+        return Op("add", (as_expr(other), self))
+
+    def __sub__(self, other):
+        return Op("sub", (self, as_expr(other)))
+
+    def __rsub__(self, other):
+        return Op("sub", (as_expr(other), self))
+
+    def __mul__(self, other):
+        return Op("mul", (self, as_expr(other)))
+
+    def __rmul__(self, other):
+        return Op("mul", (as_expr(other), self))
+
+    def __truediv__(self, other):
+        return Op("truediv", (self, as_expr(other)))
+
+    def __rtruediv__(self, other):
+        return Op("truediv", (as_expr(other), self))
+
+    def __floordiv__(self, other):
+        return Op("floordiv", (self, as_expr(other)))
+
+    def __pow__(self, other):
+        return Op("pow", (self, as_expr(other)))
+
+    def __rpow__(self, other):
+        return Op("pow", (as_expr(other), self))
+
+    def __neg__(self):
+        return Op("neg", (self,))
+
+    def __abs__(self):
+        return Op("abs", (self,))
+
+    def __getitem__(self, idx):
+        return Op("getitem", (self, as_expr(idx)))
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """A constant embedded in the space (pyll/base.py sym: Literal)."""
+
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Op(Expr):
+    """A pure elementwise operation over sub-expressions."""
+
+    op: str
+    args: tuple
+
+    def __post_init__(self):
+        if self.op not in _OP_TABLE:
+            raise InvalidAnnotatedParameter(f"unknown op {self.op!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Container(Expr):
+    """dict / list / tuple of sub-expressions (pyll ``scope.dict``/``pos_args``)."""
+
+    kind: str  # 'dict' | 'list' | 'tuple'
+    keys: tuple  # dict keys ('' entries for list/tuple)
+    children: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Dist(Expr):
+    """A distribution spec: family name + flat numeric params.
+
+    The greppable analog of the reference's stochastic scope ops
+    (``hyperopt/pyll/stochastic.py`` sym: uniform, quniform, loguniform,
+    qloguniform, normal, qnormal, lognormal, qlognormal, randint, categorical).
+    """
+
+    family: str
+    params: tuple  # family-specific floats (hashable → usable as static arg)
+
+
+@dataclasses.dataclass(frozen=True)
+class Param(Expr):
+    """A labeled hyperparameter: the analog of ``scope.hyperopt_param``
+    (``hyperopt/pyll_utils.py`` sym: hyperopt_param)."""
+
+    label: str
+    dist: Dist
+    cast: str = "float"  # 'float' | 'int'
+
+
+@dataclasses.dataclass(frozen=True)
+class Choice(Expr):
+    """A conditional branch point: ``hp.choice`` / ``hp.pchoice``.
+
+    The reference compiles choice to ``scope.switch(hyperopt_param(label,
+    randint(n)), *options)`` (``hyperopt/pyll_utils.py`` sym: hp_choice).
+    Here the selector is itself a Param (family 'randint' for choice,
+    'categorical' for pchoice) and the options are sub-expressions.
+    """
+
+    label: str
+    options: tuple
+    p: tuple | None = None  # pchoice probabilities (None → uniform prior)
+
+    @property
+    def selector_dist(self) -> Dist:
+        n = len(self.options)
+        if self.p is None:
+            return Dist("randint", (0.0, float(n)))
+        return Dist("categorical", tuple(float(x) for x in self.p))
+
+
+def as_expr(obj: Any) -> Expr:
+    """Convert a python structure into an Expr (pyll/base.py sym: as_apply)."""
+    if isinstance(obj, Expr):
+        return obj
+    if isinstance(obj, dict):
+        keys = tuple(sorted(obj.keys()))
+        return Container("dict", keys, tuple(as_expr(obj[k]) for k in keys))
+    if isinstance(obj, (list, tuple)):
+        kind = "list" if isinstance(obj, list) else "tuple"
+        return Container(kind, tuple("" for _ in obj), tuple(as_expr(o) for o in obj))
+    return Literal(obj)
+
+
+# ---------------------------------------------------------------------------
+# Op tables (host + traced)
+# ---------------------------------------------------------------------------
+
+_OP_TABLE: dict[str, Callable] = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "truediv": lambda a, b: a / b,
+    "floordiv": lambda a, b: a // b,
+    "pow": lambda a, b: a**b,
+    "neg": lambda a: -a,
+    "abs": lambda a: abs(a),
+    "getitem": lambda a, i: a[i],
+}
+
+_OP_TABLE_JNP: dict[str, Callable] = dict(
+    _OP_TABLE,
+    **{
+        "exp": jnp.exp,
+        "log": jnp.log,
+        "sqrt": jnp.sqrt,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "tan": jnp.tan,
+        "maximum": jnp.maximum,
+        "minimum": jnp.minimum,
+    },
+)
+
+_OP_TABLE_NP: dict[str, Callable] = dict(
+    _OP_TABLE,
+    **{
+        "exp": np.exp,
+        "log": np.log,
+        "sqrt": np.sqrt,
+        "sin": np.sin,
+        "cos": np.cos,
+        "tan": np.tan,
+        "maximum": np.maximum,
+        "minimum": np.minimum,
+    },
+)
+
+
+# Math helpers mirroring the reference's arithmetic scope ops so spaces can do
+# e.g. ``spaces.exp(hp.normal('x', 0, 1))`` (pyll scope: exp/log/sqrt/...).
+def _make_unary(name):
+    def f(x):
+        return Op(name, (as_expr(x),))
+
+    f.__name__ = name
+    return f
+
+
+def _make_binary(name):
+    def f(a, b):
+        return Op(name, (as_expr(a), as_expr(b)))
+
+    f.__name__ = name
+    return f
+
+
+exp = _make_unary("exp")
+log = _make_unary("log")
+sqrt = _make_unary("sqrt")
+sin = _make_unary("sin")
+cos = _make_unary("cos")
+tan = _make_unary("tan")
+maximum = _make_binary("maximum")
+minimum = _make_binary("minimum")
+for _n in ("exp", "log", "sqrt", "sin", "cos", "tan", "maximum", "minimum"):
+    __all__.append(_n)
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamInfo:
+    """Everything the suggesters need to know about one hyperparameter.
+
+    ``conditions`` is the activation path: a tuple of (choice_label,
+    branch_index) pairs; the parameter is *active* in a trial iff every listed
+    choice drew the listed branch.  This is the static-shape analog of the
+    sparse idxs bookkeeping in ``hyperopt/vectorize.py`` (sym:
+    VectorizeHelper.idxs_by_label).
+    """
+
+    label: str
+    dist: Dist
+    cast: str
+    conditions: tuple  # ((choice_label, branch_index), ...)
+
+    @property
+    def is_int(self) -> bool:
+        return self.dist.family in INT_FAMILIES or self.cast == "int"
+
+
+class CompiledSpace:
+    """A search space lowered to jittable functions.
+
+    Replaces ``Domain``'s vectorized sampler program (``hyperopt/base.py``
+    sym: Domain.__init__ → VectorizeHelper → s_idxs_vals) with:
+
+    * ``sample_flat(key) -> {label: scalar}`` — draw every parameter.
+    * ``active_flat(flat) -> {label: bool}`` — activation masks.
+    * ``assemble(flat)`` — rebuild the user-facing structure (host).
+    * ``sample(key)`` — one host-side structured sample (analog of
+      ``hyperopt/pyll/stochastic.py`` sym: sample).
+    """
+
+    def __init__(self, expr: Expr):
+        self.expr = expr
+        self.params: dict[str, ParamInfo] = {}
+        self._collect(expr, ())
+        self.labels: tuple[str, ...] = tuple(self.params.keys())
+        self._sample_flat_jit = jax.jit(self.sample_flat)
+
+    # -- construction -----------------------------------------------------
+
+    def _add_param(self, label: str, dist: Dist, cast: str, conditions: tuple):
+        if not isinstance(label, str):
+            raise InvalidAnnotatedParameter(f"label must be a string: {label!r}")
+        if label in self.params:
+            raise DuplicateLabel(label)
+        self.params[label] = ParamInfo(label, dist, cast, conditions)
+
+    def _collect(self, node: Expr, conditions: tuple):
+        if isinstance(node, Param):
+            self._add_param(node.label, node.dist, node.cast, conditions)
+        elif isinstance(node, Choice):
+            self._add_param(node.label, node.selector_dist, "int", conditions)
+            for i, opt in enumerate(node.options):
+                self._collect(opt, conditions + ((node.label, i),))
+        elif isinstance(node, Op):
+            for a in node.args:
+                self._collect(a, conditions)
+        elif isinstance(node, Container):
+            for c in node.children:
+                self._collect(c, conditions)
+        elif isinstance(node, Literal):
+            pass
+        else:
+            raise InvalidAnnotatedParameter(f"not a space expression: {node!r}")
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample_flat(self, key) -> dict:
+        """Draw every parameter unconditionally; pure & jittable."""
+        out = {}
+        for label, info in self.params.items():
+            k = jax.random.fold_in(key, label_hash(label))
+            out[label] = draw_dist(info.dist, k)
+        return out
+
+    def sample_flat_jit(self, key) -> dict:
+        return self._sample_flat_jit(key)
+
+    def active_flat(self, flat: dict) -> dict:
+        """Boolean activation per label, from the drawn choice indices.
+
+        Works on host ints and on tracers (returns jnp bools under trace).
+        """
+        out = {}
+        for label, info in self.params.items():
+            act = True
+            for (clabel, idx) in info.conditions:
+                act = act & (flat[clabel] == idx)
+            out[label] = (
+                jnp.asarray(act)
+                if any(isinstance(flat.get(c), jax.Array) for c, _ in info.conditions)
+                else bool(act) if isinstance(act, (bool, np.bool_)) else act
+            )
+        return out
+
+    # -- assembly ---------------------------------------------------------
+
+    def assemble(self, flat: dict, *, traced: bool = False):
+        """Rebuild the user-facing structure from flat per-label values.
+
+        Host mode picks choice branches with concrete ints (the analog of
+        rec_eval's lazy ``switch``); traced mode uses ``lax.switch`` so
+        jit/vmap'd objective evaluation works — requires homogeneous branch
+        pytrees, which is checked at call time by JAX itself.
+        """
+        table = _OP_TABLE_JNP if traced else _OP_TABLE_NP
+
+        def rec(node: Expr):
+            if isinstance(node, Literal):
+                return node.value
+            if isinstance(node, Param):
+                v = flat[node.label]
+                if traced:
+                    return v
+                v = np.asarray(v).item() if hasattr(v, "item") or isinstance(v, np.ndarray) else v
+                if node.cast == "int":
+                    v = int(round(v))
+                return v
+            if isinstance(node, Choice):
+                idx = flat[node.label]
+                if traced and isinstance(idx, jax.Array):
+                    branches = [(lambda opt: (lambda _: rec(opt)))(o) for o in node.options]
+                    return jax.lax.switch(jnp.asarray(idx, jnp.int32), branches, None)
+                idx = int(np.asarray(idx).item()) if not isinstance(idx, int) else idx
+                return rec(node.options[idx])
+            if isinstance(node, Op):
+                return table[node.op](*(rec(a) for a in node.args))
+            if isinstance(node, Container):
+                vals = [rec(c) for c in node.children]
+                if node.kind == "dict":
+                    return dict(zip(node.keys, vals))
+                return vals if node.kind == "list" else tuple(vals)
+            raise InvalidAnnotatedParameter(f"not a space expression: {node!r}")
+
+        return rec(self.expr)
+
+    def sample(self, key):
+        """One structured sample on host (pyll/stochastic.py sym: sample)."""
+        flat = {k: np.asarray(v) for k, v in self.sample_flat_jit(key).items()}
+        return self.assemble(flat)
+
+
+def compile_space(space: Any) -> CompiledSpace:
+    return CompiledSpace(as_expr(space))
+
+
+# ---------------------------------------------------------------------------
+# Distribution draws (jax) — semantics of hyperopt/pyll/stochastic.py
+# ---------------------------------------------------------------------------
+
+
+def _qround(x, q):
+    return jnp.round(x / q) * q
+
+
+def draw_dist(dist: Dist, key, shape=()):
+    """Draw from one distribution node; pure function of (dist, key).
+
+    Families/formulas follow ``hyperopt/pyll/stochastic.py`` (sym: uniform,
+    quniform, loguniform, qloguniform, normal, qnormal, lognormal, qlognormal,
+    randint, categorical).
+    """
+    fam, p = dist.family, dist.params
+    if fam == "uniform":
+        low, high = p
+        return jax.random.uniform(key, shape, minval=low, maxval=high)
+    if fam == "quniform":
+        low, high, q = p
+        return _qround(jax.random.uniform(key, shape, minval=low, maxval=high), q)
+    if fam == "loguniform":
+        low, high = p
+        return jnp.exp(jax.random.uniform(key, shape, minval=low, maxval=high))
+    if fam == "qloguniform":
+        low, high, q = p
+        return _qround(jnp.exp(jax.random.uniform(key, shape, minval=low, maxval=high)), q)
+    if fam == "normal":
+        mu, sigma = p
+        return mu + sigma * jax.random.normal(key, shape)
+    if fam == "qnormal":
+        mu, sigma, q = p
+        return _qround(mu + sigma * jax.random.normal(key, shape), q)
+    if fam == "lognormal":
+        mu, sigma = p
+        return jnp.exp(mu + sigma * jax.random.normal(key, shape))
+    if fam == "qlognormal":
+        mu, sigma, q = p
+        return _qround(jnp.exp(mu + sigma * jax.random.normal(key, shape)), q)
+    if fam == "randint":
+        low, high = p
+        return jax.random.randint(key, shape, int(low), int(high))
+    if fam == "uniformint":
+        low, high = p
+        return jax.random.randint(key, shape, int(low), int(high) + 1)
+    if fam == "categorical":
+        probs = jnp.asarray(p)
+        return jax.random.categorical(key, jnp.log(probs), shape=shape)
+    raise InvalidAnnotatedParameter(f"unknown family {fam!r}")
+
+
+# ---------------------------------------------------------------------------
+# Public helpers (API parity)
+# ---------------------------------------------------------------------------
+
+
+def sample(space: Any, key):
+    """Sample a structured point (``hyperopt.pyll.stochastic.sample``)."""
+    if isinstance(key, (int, np.integer)):
+        key = jax.random.PRNGKey(int(key))
+    return compile_space(space).sample(key)
+
+
+def space_eval(space: Any, hp_assignment: dict):
+    """Rebuild the structured point from ``{label: value}`` (choice values are
+    branch indices) — parity with ``hyperopt/fmin.py`` (sym: space_eval).
+
+    Accepts both scalars and the 1-element lists found in ``trials.vals``.
+    """
+    flat = {}
+    for k, v in hp_assignment.items():
+        if isinstance(v, (list, tuple, np.ndarray)):
+            if len(v) == 0:
+                continue
+            v = v[0]
+        flat[k] = v
+    return compile_space(space).assemble(flat)
+
+
+def expr_to_config(space: Any) -> dict:
+    """Summarize a space as ``{label: {'dist': Dist, 'conditions': (...)}}`` —
+    the analog of ``hyperopt/pyll_utils.py`` (sym: expr_to_config), used by
+    conditional-space-aware tooling.
+    """
+    cs = compile_space(space)
+    return {
+        label: {"dist": info.dist, "cast": info.cast, "conditions": info.conditions}
+        for label, info in cs.params.items()
+    }

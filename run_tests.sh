@@ -1,0 +1,5 @@
+#!/bin/sh
+# Hermetic test run: force CPU JAX and bypass the ambient axon TPU hook
+# (PALLAS_AXON_POOL_IPS triggers a remote-TPU claim in sitecustomize at every
+# interpreter start; tests must not contend for the single chip).
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

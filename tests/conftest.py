@@ -1,0 +1,24 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's doctrine of testing "distributed" as multi-process on
+one host (SURVEY.md §4): here, multi-chip sharding is tested on
+``--xla_force_host_platform_device_count=8`` CPU devices.  Must run before the
+first ``import jax`` in any test module.
+"""
+
+import os
+
+# Force CPU even when the ambient environment points JAX at a TPU tunnel
+# (JAX_PLATFORMS=axon): the test suite must be hermetic and fast.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

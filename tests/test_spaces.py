@@ -1,0 +1,188 @@
+"""Space IR + sampler tests.
+
+Modeled on the reference's DSL/stochastic-node tests
+(``hyperopt/pyll/tests/test_base.py``, ``test_stochastic.py``,
+``tests/test_pyll_utils.py`` — SURVEY.md §4): statistical assertions on
+bounds, quantization and moments; conditional-space config extraction;
+DuplicateLabel behavior.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp, spaces
+from hyperopt_tpu.exceptions import DuplicateLabel, InvalidAnnotatedParameter
+from hyperopt_tpu.spaces import compile_space, expr_to_config, space_eval
+
+N = 4000
+
+
+def batch_draw(space, n=N, seed=0):
+    cs = compile_space(space)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    flat = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    return cs, {k: np.asarray(v) for k, v in flat.items()}
+
+
+def test_uniform_bounds_and_moments():
+    _, flat = batch_draw(hp.uniform("x", -3.0, 7.0))
+    x = flat["x"]
+    assert x.min() >= -3.0 and x.max() <= 7.0
+    assert abs(x.mean() - 2.0) < 0.15
+    assert abs(x.std() - 10.0 / np.sqrt(12)) < 0.15
+
+
+def test_quniform_multiples():
+    _, flat = batch_draw(hp.quniform("x", 0.0, 10.0, 0.5))
+    x = flat["x"]
+    assert np.allclose(np.round(x / 0.5) * 0.5, x, atol=1e-5)
+
+
+def test_loguniform_log_bounds():
+    _, flat = batch_draw(hp.loguniform("x", np.log(1e-3), np.log(1e3)))
+    x = flat["x"]
+    assert x.min() >= 1e-3 - 1e-9 and x.max() <= 1e3 + 1e-3
+    lx = np.log(x)
+    assert abs(lx.mean()) < 0.3  # symmetric in log space
+
+
+def test_normal_moments():
+    _, flat = batch_draw(hp.normal("x", 5.0, 2.0))
+    x = flat["x"]
+    assert abs(x.mean() - 5.0) < 0.15
+    assert abs(x.std() - 2.0) < 0.15
+
+
+def test_lognormal_is_exp_normal():
+    _, flat = batch_draw(hp.lognormal("x", 1.0, 0.5))
+    lx = np.log(flat["x"])
+    assert abs(lx.mean() - 1.0) < 0.05
+    assert abs(lx.std() - 0.5) < 0.05
+
+
+def test_qlognormal_quantized_nonneg():
+    _, flat = batch_draw(hp.qlognormal("x", 0.0, 1.0, 2.0))
+    x = flat["x"]
+    assert np.allclose(np.round(x / 2.0) * 2.0, x, atol=1e-4)
+    assert x.min() >= 0.0
+
+
+def test_randint_range():
+    _, flat = batch_draw(hp.randint("i", 7))
+    i = flat["i"]
+    assert i.dtype.kind == "i"
+    assert i.min() >= 0 and i.max() <= 6
+    counts = np.bincount(i, minlength=7)
+    assert (counts > N / 7 * 0.7).all()
+
+
+def test_randint_low_high():
+    _, flat = batch_draw(hp.randint("i", 3, 9))
+    i = flat["i"]
+    assert i.min() >= 3 and i.max() <= 8
+
+
+def test_uniformint_inclusive():
+    _, flat = batch_draw(hp.uniformint("i", 1, 4))
+    i = flat["i"]
+    assert set(np.unique(i)) == {1, 2, 3, 4}
+
+
+def test_pchoice_frequencies():
+    space = hp.pchoice("c", [(0.1, "a"), (0.2, "b"), (0.7, "c")])
+    _, flat = batch_draw(space)
+    freq = np.bincount(flat["c"], minlength=3) / N
+    assert np.allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+
+
+def test_pchoice_bad_probs():
+    with pytest.raises(InvalidAnnotatedParameter):
+        hp.pchoice("c", [(0.5, "a"), (0.2, "b")])
+
+
+def test_choice_conditions_and_active():
+    space = {
+        "kind": hp.choice(
+            "kind",
+            [
+                {"name": "svm", "C": hp.loguniform("C", -5, 5)},
+                {"name": "rf", "depth": hp.randint("depth", 10)},
+            ],
+        )
+    }
+    cs = compile_space(space)
+    assert cs.params["C"].conditions == (("kind", 0),)
+    assert cs.params["depth"].conditions == (("kind", 1),)
+    assert cs.params["kind"].conditions == ()
+
+    flat = {k: np.asarray(v) for k, v in cs.sample_flat_jit(jax.random.PRNGKey(3)).items()}
+    act = cs.active_flat({k: v.item() for k, v in flat.items()})
+    k = flat["kind"].item()
+    assert act["C"] == (k == 0)
+    assert act["depth"] == (k == 1)
+
+    structured = cs.assemble({k: v.item() for k, v in flat.items()})
+    assert structured["kind"]["name"] == ("svm" if k == 0 else "rf")
+
+
+def test_duplicate_label_raises():
+    with pytest.raises(DuplicateLabel):
+        compile_space([hp.uniform("x", 0, 1), hp.normal("x", 0, 1)])
+
+
+def test_arithmetic_on_params():
+    space = hp.uniform("x", 0.0, 1.0) * 10 + 5
+    cs = compile_space(space)
+    flat = cs.sample_flat_jit(jax.random.PRNGKey(0))
+    v = cs.assemble({"x": np.asarray(flat["x"]).item()})
+    assert 5.0 <= v <= 15.0
+
+
+def test_space_eval_parity():
+    space = {
+        "lr": hp.loguniform("lr", -5, 0),
+        "arch": hp.choice("arch", [("mlp", hp.randint("width", 8)), ("cnn",)]),
+    }
+    out = space_eval(space, {"lr": [0.01], "arch": [0], "width": [3]})
+    assert out["lr"] == 0.01
+    assert out["arch"] == ("mlp", 3)
+    out2 = space_eval(space, {"lr": 0.5, "arch": 1})
+    assert out2["arch"] == ("cnn",)
+
+
+def test_expr_to_config():
+    space = hp.choice("c", [hp.uniform("a", 0, 1), hp.uniform("b", 0, 1)])
+    cfg = expr_to_config(space)
+    assert set(cfg) == {"c", "a", "b"}
+    assert cfg["a"]["conditions"] == (("c", 0),)
+    assert cfg["c"]["dist"].family == "randint"
+
+
+def test_sample_structured():
+    space = {"x": hp.uniform("x", 0, 1), "c": hp.choice("c", [1, 2])}
+    out = spaces.sample(space, 0)
+    assert 0 <= out["x"] <= 1
+    assert out["c"] in (1, 2)
+
+
+def test_traced_assemble_switch():
+    space = {"y": hp.choice("c", [hp.uniform("a", 0.0, 1.0) + 1.0, hp.uniform("b", 0.0, 1.0) + 3.0])}
+    cs = compile_space(space)
+
+    def f(key):
+        flat = cs.sample_flat(key)
+        return cs.assemble(flat, traced=True)["y"]
+
+    ys = np.asarray(jax.vmap(f)(jax.random.split(jax.random.PRNGKey(0), 512)))
+    assert (((1.0 <= ys) & (ys <= 2.0)) | ((3.0 <= ys) & (ys <= 4.0))).all()
+    assert ((1.0 <= ys) & (ys <= 2.0)).any() and ((3.0 <= ys) & (ys <= 4.0)).any()
+
+
+def test_sample_flat_deterministic():
+    cs = compile_space(hp.uniform("x", 0, 1))
+    a = cs.sample_flat_jit(jax.random.PRNGKey(42))["x"]
+    b = cs.sample_flat_jit(jax.random.PRNGKey(42))["x"]
+    assert jnp.array_equal(a, b)
