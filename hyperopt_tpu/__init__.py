@@ -7,9 +7,33 @@ search-space language including conditional ``hp.choice`` spaces, the
 ``algo=`` plugin boundary — with search spaces compiled to jitted samplers,
 device-resident trial history, and the TPE hot path running as vmapped /
 mesh-sharded XLA kernels.
+
+Public surface matches ``hyperopt/__init__.py`` (sym: fmin, tpe, rand,
+anneal, mix, hp, Trials, trials_from_docs, space_eval, STATUS_*,
+JOB_STATE_*), so ``from hyperopt_tpu import fmin, hp, tpe, Trials`` — the
+canonical reference idiom — works unchanged.
 """
 
-from . import hp, spaces
+from . import early_stop, hp, spaces
+from .algos import rand
+from .base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    JOB_STATES,
+    STATUS_FAIL,
+    STATUS_NEW,
+    STATUS_OK,
+    STATUS_RUNNING,
+    STATUS_STRINGS,
+    STATUS_SUSPENDED,
+    Ctrl,
+    Domain,
+    Trials,
+    trials_from_docs,
+)
 from .exceptions import (
     AllTrialsFailed,
     DuplicateLabel,
@@ -18,14 +42,50 @@ from .exceptions import (
     InvalidResultStatus,
     InvalidTrial,
 )
+from .fmin import FMinIter, fmin, fmin_pass_expr_memo_ctrl, generate_trials_to_calculate
 from .spaces import space_eval
 
-__version__ = "0.1.0"
+# Algo modules that may land incrementally are re-exported only when present,
+# so `from hyperopt_tpu import anneal` fails at the import site (ImportError)
+# rather than binding None and failing later at `anneal.suggest`.
+from . import algos as _algos
+
+_optional_algos = [
+    _name
+    for _name in ("tpe", "anneal", "mix", "atpe")
+    if hasattr(_algos, _name)
+]
+for _name in _optional_algos:
+    globals()[_name] = getattr(_algos, _name)
+
+__version__ = "0.2.0"
 
 __all__ = [
     "hp",
     "spaces",
+    "early_stop",
+    "fmin",
+    "FMinIter",
+    "fmin_pass_expr_memo_ctrl",
+    "generate_trials_to_calculate",
     "space_eval",
+    "rand",
+    "Trials",
+    "trials_from_docs",
+    "Ctrl",
+    "Domain",
+    "JOB_STATE_NEW",
+    "JOB_STATE_RUNNING",
+    "JOB_STATE_DONE",
+    "JOB_STATE_ERROR",
+    "JOB_STATE_CANCEL",
+    "JOB_STATES",
+    "STATUS_NEW",
+    "STATUS_RUNNING",
+    "STATUS_SUSPENDED",
+    "STATUS_OK",
+    "STATUS_FAIL",
+    "STATUS_STRINGS",
     "AllTrialsFailed",
     "DuplicateLabel",
     "InvalidAnnotatedParameter",
@@ -33,4 +93,4 @@ __all__ = [
     "InvalidResultStatus",
     "InvalidTrial",
     "__version__",
-]
+] + _optional_algos

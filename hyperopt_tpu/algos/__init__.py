@@ -9,4 +9,17 @@ so ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` keeps
 working as the configuration mechanism (SURVEY.md §5 "Config / flag system").
 """
 
-from . import anneal, mix, rand, tpe  # noqa: F401
+from . import rand  # noqa: F401
+
+# Optional algo modules are imported if present so a partial checkout of the
+# algos package never breaks `import hyperopt_tpu` (round-1 regression).
+# Only "this exact module does not exist" is tolerated; a genuine import
+# failure *inside* an existing module must surface, not silently demote the
+# default optimizer to random search.
+for _name in ("tpe", "anneal", "mix", "atpe"):
+    try:
+        globals()[_name] = __import__(f"{__name__}.{_name}", fromlist=[_name])
+    except ModuleNotFoundError as e:
+        if e.name != f"{__name__}.{_name}":
+            raise
+del _name

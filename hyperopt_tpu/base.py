@@ -540,11 +540,19 @@ class Trials:
             trials_save_file=trials_save_file,
         )
 
-    # pickle: drop the numpy history (rebuilt lazily) for a compact file
+    # pickle: drop the numpy history (rebuilt lazily) for a compact file, and
+    # drop the live Domain attachment FMinIter installs — it closes over the
+    # user objective (often a lambda) and jitted handles; fmin re-installs it
+    # on resume.  Cloudpickled byte blobs (the async form) are kept.
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_history"] = None
         state["_history_synced"] = 0
+        attachments = dict(state.get("attachments", {}))
+        dom = attachments.get("FMinIter_Domain")
+        if dom is not None and not isinstance(dom, (bytes, bytearray)):
+            del attachments["FMinIter_Domain"]
+        state["attachments"] = attachments
         return state
 
 

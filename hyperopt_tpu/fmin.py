@@ -23,6 +23,7 @@ import numpy as np
 from . import progress as progress_mod
 from .base import (
     Ctrl,
+    coarse_utcnow,
     Domain,
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
@@ -147,7 +148,7 @@ class FMinIter:
             if trial["state"] != JOB_STATE_NEW:
                 continue
             trial["state"] = JOB_STATE_RUNNING
-            trial["book_time"] = time.time()
+            trial["book_time"] = coarse_utcnow()
             spec = spec_from_misc(trial["misc"])
             ctrl = Ctrl(self.trials, current_trial=trial)
             try:
@@ -156,14 +157,14 @@ class FMinIter:
                 logger.error("job exception: %s", e)
                 trial["state"] = JOB_STATE_ERROR
                 trial["misc"]["error"] = (str(type(e)), str(e))
-                trial["refresh_time"] = time.time()
+                trial["refresh_time"] = coarse_utcnow()
                 if not self.catch_eval_exceptions:
                     self.trials.refresh()
                     raise
             else:
                 trial["state"] = JOB_STATE_DONE
                 trial["result"] = result
-                trial["refresh_time"] = time.time()
+                trial["refresh_time"] = coarse_utcnow()
             N -= 1
             if N == 0:
                 break
@@ -247,8 +248,7 @@ class FMinIter:
 
                 self.trials.refresh()
                 if self.trials_save_file != "":
-                    with open(self.trials_save_file, "wb") as f:
-                        pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+                    self._save_trials()
 
                 if self.early_stop_fn is not None:
                     stop, kwargs = self.early_stop_fn(
@@ -285,6 +285,15 @@ class FMinIter:
                     self.block_until_done()
                     all_trials_complete = True
                     break
+
+    def _save_trials(self):
+        """Checkpoint trials atomically: write a temp file, then rename, so a
+        crash mid-dump never truncates an existing checkpoint (round-1 bug:
+        a failed dump left a 0-byte file and EOFError on resume)."""
+        tmp = self.trials_save_file + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+        os.replace(tmp, self.trials_save_file)
 
     def __iter__(self):
         return self
@@ -328,9 +337,17 @@ def fmin(
     ``HYPEROPT_FMIN_SEED`` environment variable when set.
     """
     if algo is None:
-        from .algos import tpe
+        try:
+            from .algos import tpe
 
-        algo = tpe.suggest
+            algo = tpe.suggest
+        except ModuleNotFoundError as e:  # partial checkout only
+            if e.name not in ("hyperopt_tpu.algos.tpe",):
+                raise
+            from .algos import rand
+
+            logger.warning("tpe module not present; fmin defaulting to random search")
+            algo = rand.suggest
 
     if rstate is None:
         env_rseed = os.environ.get("HYPEROPT_FMIN_SEED", "")
@@ -396,11 +413,9 @@ def fmin(
     if return_argmin:
         if len(trials.trials) == 0:
             raise AllTrialsFailed(
-                f"There are no evaluation tasks, cannot return argmin of task losses."
+                "There are no evaluation tasks, cannot return argmin of task losses."
             )
         return trials.argmin
-    if max_evals is not None and len(trials) < max_evals:
-        return trials.argmin if return_argmin else None
     return None
 
 

@@ -144,7 +144,7 @@ class Op(Expr):
     args: tuple
 
     def __post_init__(self):
-        if self.op not in _OP_TABLE:
+        if self.op not in _VALID_OPS:
             raise InvalidAnnotatedParameter(f"unknown op {self.op!r}")
 
 
@@ -259,6 +259,10 @@ _OP_TABLE_NP: dict[str, Callable] = dict(
     },
 )
 
+# The full validation set for Op.__post_init__: every op evaluable in both the
+# host (numpy) and traced (jnp) tables, incl. the math families round 1 missed.
+_VALID_OPS = frozenset(_OP_TABLE_JNP) & frozenset(_OP_TABLE_NP)
+
 
 # Math helpers mirroring the reference's arithmetic scope ops so spaces can do
 # e.g. ``spaces.exp(hp.normal('x', 0, 1))`` (pyll scope: exp/log/sqrt/...).
@@ -334,7 +338,15 @@ class CompiledSpace:
         self.params: dict[str, ParamInfo] = {}
         self._collect(expr, ())
         self.labels: tuple[str, ...] = tuple(self.params.keys())
-        self._sample_flat_jit = jax.jit(self.sample_flat)
+        self._sample_flat_jit = None  # compiled lazily; dropped on pickle
+
+    # pickle support: jitted handles are process-local, rebuild lazily.  This
+    # is what makes Domain (and thus fmin's trials_save_file checkpoint, which
+    # stores the live Domain in trials.attachments) picklable.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_sample_flat_jit"] = None
+        return state
 
     # -- construction -----------------------------------------------------
 
@@ -374,6 +386,8 @@ class CompiledSpace:
         return out
 
     def sample_flat_jit(self, key) -> dict:
+        if self._sample_flat_jit is None:
+            self._sample_flat_jit = jax.jit(self.sample_flat)
         return self._sample_flat_jit(key)
 
     def active_flat(self, flat: dict) -> dict:
