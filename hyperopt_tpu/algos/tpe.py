@@ -1,0 +1,532 @@
+"""Tree-structured Parzen Estimator — the jitted TPU hot path.
+
+Parity target: ``hyperopt/tpe.py`` (sym: suggest, adaptive_parzen_normal,
+linear_forgetting_weights, GMM1, GMM1_lpdf, LGMM1, LGMM1_lpdf,
+ap_split_trials, broadcast_best, build_posterior, _default_*).
+
+TPU-first redesign (SURVEY.md §7.1):
+
+* The reference rebuilds a pyll posterior *graph* on every call and interprets
+  it with ``rec_eval`` — O(#trials) numpy work per suggestion, one candidate
+  batch of 24.  Here the whole posterior — below/above split, adaptive-Parzen
+  fit for every hyperparameter, candidate sampling, mixture log-pdfs and the
+  EI argmax — is ONE jitted function of ``(history arrays, key)``.  Structure
+  (labels, distribution families, static params) is baked in at trace time;
+  only the padded history arrays are data.
+* Truncated GMM sampling is **inverse-CDF** (component choice reweighted by
+  per-component truncated mass, then ``ndtri`` on a uniform in the truncated
+  CDF interval) instead of the reference's rejection resampling loop — exact
+  same distribution, but bounded, branchless and vmappable.
+* Variable-length observation sets become fixed-capacity arrays + boolean
+  masks (``Trials.padded_history``), so shapes are stable and the kernel
+  recompiles only when the power-of-two capacity bucket grows.
+* Multiple ``new_ids`` are proposed by ``vmap`` over folded RNG keys; the
+  candidate axis scales to thousands (the reference is fixed at 24).
+
+Behavioral parity is *distributional*, not bitwise: jax.random (threefry) ≠
+numpy MT19937, and truncation-by-inversion ≠ truncation-by-rejection sample
+paths.  Statistical tests (tests/test_tpe.py) check lpdf normalization,
+sampler/lpdf agreement and optimizer performance, mirroring the reference's
+own test doctrine (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp, ndtri
+
+from ..spaces import Dist, label_hash
+from . import rand
+
+__all__ = [
+    "EPS",
+    "suggest",
+    "adaptive_parzen_normal",
+    "linear_forgetting_weights",
+    "normal_cdf",
+    "lognormal_cdf",
+    "gmm1_sample",
+    "gmm1_lpdf",
+    "lgmm1_sample",
+    "lgmm1_lpdf",
+    "categorical_posterior",
+    "split_below_above",
+    "build_propose",
+]
+
+# -- reference defaults (hyperopt/tpe.py ≈L20-40, sym: _default_*) -----------
+EPS = 1e-12
+_default_prior_weight = 1.0
+_default_n_startup_jobs = 20
+_default_n_EI_candidates = 24
+_default_gamma = 0.25
+_default_linear_forgetting = 25
+
+# f32-safe clip for inverse-CDF inputs (SURVEY.md §7.4: keep ndtri away from
+# {0,1}); 1e-7 is ~16 ulp at 1.0 in float32.
+_U_TINY = 1e-7
+
+
+# ---------------------------------------------------------------------------
+# scalar cdf helpers (hyperopt/tpe.py sym: normal_cdf, lognormal_cdf)
+# ---------------------------------------------------------------------------
+
+
+def normal_cdf(x, mu, sigma):
+    z = (x - mu) / (jnp.sqrt(2.0) * sigma)
+    return 0.5 * (1.0 + jax.lax.erf(z))
+
+
+def lognormal_cdf(x, mu, sigma):
+    """CDF at x>=0 of exp(N(mu, sigma)); 0 for x<=0."""
+    x = jnp.maximum(x, 0.0)
+    safe = jnp.maximum(x, EPS)
+    return jnp.where(x > 0, normal_cdf(jnp.log(safe), mu, sigma), 0.0)
+
+
+def _normal_logpdf(x, mu, sigma):
+    return -0.5 * ((x - mu) / sigma) ** 2 - jnp.log(sigma) - 0.5 * jnp.log(2.0 * jnp.pi)
+
+
+# ---------------------------------------------------------------------------
+# adaptive Parzen fit (hyperopt/tpe.py sym: adaptive_parzen_normal,
+# linear_forgetting_weights)
+# ---------------------------------------------------------------------------
+
+
+def linear_forgetting_weights(obs_mask, LF):
+    """Per-slot forgetting weight, insertion order (tpe.py sym:
+    linear_forgetting_weights).
+
+    The reference ramps the oldest ``N-LF`` observations linearly from ``1/N``
+    to 1 and keeps the newest ``LF`` at weight 1 (``np.linspace(1/N, 1,
+    N-LF)`` + ones).  Here: positions are cumsum ranks over the boolean mask,
+    so padding slots cost nothing and shapes stay static.
+    """
+    obs_mask = obs_mask.astype(jnp.float32)
+    n = jnp.sum(obs_mask)
+    pos = jnp.cumsum(obs_mask) - 1.0  # rank among live obs, insertion order
+    n_ramp = n - LF
+    denom = jnp.maximum(n_ramp - 1.0, 1.0)
+    ramp = 1.0 / jnp.maximum(n, 1.0) + pos * (1.0 - 1.0 / jnp.maximum(n, 1.0)) / denom
+    w = jnp.where(pos >= n_ramp, 1.0, ramp)
+    w = jnp.where(n <= LF, 1.0, w)
+    return w * obs_mask
+
+
+def adaptive_parzen_normal(obs, obs_mask, prior_weight, prior_mu, prior_sigma, LF):
+    """Fit the adaptive Parzen estimator (tpe.py sym: adaptive_parzen_normal).
+
+    Returns ``(weights, mus, sigmas)`` of static length ``cap+1`` — the obs
+    (masked) plus the prior component, sorted by location.  Semantics follow
+    the reference: the prior is inserted at its sorted position with
+    ``sigma=prior_sigma`` and weight ``prior_weight``; each observation's
+    sigma is its larger neighbor gap in the sorted order, clipped to
+    ``[prior_sigma / min(100, 1 + m), prior_sigma]`` with ``m`` the number of
+    live components; observation weights use linear forgetting; weights are
+    normalized to sum to 1.  (The reference's special-cased 1-observation
+    branch — obs sigma = prior_sigma/2 — is subsumed by the general clip.)
+
+    Dead (padding) slots get weight 0, mu=prior_mu, sigma=prior_sigma so no
+    NaN/Inf can leak into downstream kernels.
+    """
+    cap = obs.shape[0]
+    obs_mask = obs_mask.astype(bool)
+    m_obs = jnp.sum(obs_mask)          # live observations
+    m = m_obs + 1                      # live components incl. prior
+
+    lfw = linear_forgetting_weights(obs_mask, LF) * obs_mask
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
+    vals_c = jnp.concatenate([jnp.where(obs_mask, obs, big), jnp.array([prior_mu])])
+    wts_c = jnp.concatenate([lfw, jnp.array([jnp.float32(prior_weight)])])
+    prior_c = jnp.concatenate([jnp.zeros(cap, bool), jnp.array([True])])
+
+    order = jnp.argsort(vals_c)
+    svals = vals_c[order]
+    swts = wts_c[order]
+    sprior = prior_c[order]
+
+    idx = jnp.arange(cap + 1)
+    prev_gap = svals - jnp.concatenate([svals[:1], svals[:-1]])
+    next_gap = jnp.concatenate([svals[1:], svals[-1:]]) - svals
+    prev_ok = (idx >= 1) & (idx < m)
+    next_ok = idx < (m - 1)
+    neg = jnp.float32(-1.0)
+    sigma = jnp.maximum(
+        jnp.where(prev_ok, prev_gap, neg), jnp.where(next_ok, next_gap, neg)
+    )
+    # single live component (prior only, m==1): no neighbor info -> prior
+    # sigma.  With m>1 a zero gap (duplicate observations) stays 0 and is
+    # raised to minsigma by the clip below — NOT to prior_sigma, else the
+    # below-model could never concentrate on repeated good values.
+    sigma = jnp.where(m == 1, prior_sigma, jnp.maximum(sigma, 0.0))
+
+    maxsigma = jnp.float32(prior_sigma)
+    minsigma = prior_sigma / jnp.minimum(100.0, 1.0 + m.astype(jnp.float32))
+    sigma = jnp.clip(sigma, minsigma, maxsigma)
+    sigma = jnp.where(sprior, prior_sigma, sigma)
+
+    live = idx < m
+    svals = jnp.where(live, svals, prior_mu)
+    sigma = jnp.where(live, sigma, prior_sigma)
+    swts = jnp.where(live, swts, 0.0)
+    swts = swts / jnp.sum(swts)
+    return swts, svals, sigma
+
+
+# ---------------------------------------------------------------------------
+# truncated GMM sample + lpdf (hyperopt/tpe.py sym: GMM1, GMM1_lpdf,
+# LGMM1, LGMM1_lpdf) — inverse-CDF truncation instead of rejection
+# ---------------------------------------------------------------------------
+
+
+def _trunc_masses(weights, mus, sigmas, low, high):
+    """Per-component in-bounds CDF mass and the mixture acceptance prob
+    (the reference's ``p_accept``).  ``low``/``high`` are STATIC Python
+    floats (±inf for unbounded) so truncation branches resolve at trace
+    time — `jnp.float32(x)` inside a trace would produce a Tracer and break
+    `math.isinf` checks."""
+    alpha = normal_cdf(low, mus, sigmas) if math.isfinite(low) else jnp.zeros_like(mus)
+    beta = normal_cdf(high, mus, sigmas) if math.isfinite(high) else jnp.ones_like(mus)
+    mass = jnp.clip(beta - alpha, 0.0, 1.0)
+    p_accept = jnp.sum(weights * mass)
+    return alpha, beta, mass, p_accept
+
+
+def gmm1_sample(key, weights, mus, sigmas, low, high, q, n_samples):
+    """Draw ``n_samples`` from the truncated (optionally quantized) mixture.
+
+    Reference (tpe.py sym: GMM1) truncates by rejection-resampling; here the
+    component is drawn from weights reweighted by per-component truncated
+    mass, then the sample is ``mu + sigma * ndtri(U(alpha, beta))`` — the
+    exact truncated-mixture law, no loops.
+    """
+    low, high = float(low), float(high)
+    alpha, beta, mass, _ = _trunc_masses(weights, mus, sigmas, low, high)
+    logw = jnp.log(jnp.maximum(weights * mass, EPS)) + jnp.where(
+        weights * mass > 0, 0.0, -jnp.inf
+    )
+    k_comp, k_u = jax.random.split(key)
+    comp = jax.random.categorical(k_comp, logw, shape=(n_samples,))
+    u0 = jax.random.uniform(k_u, (n_samples,))
+    u = alpha[comp] + u0 * (beta[comp] - alpha[comp])
+    u = jnp.clip(u, _U_TINY, 1.0 - _U_TINY)
+    x = mus[comp] + sigmas[comp] * ndtri(u)
+    if math.isfinite(low):
+        x = jnp.maximum(x, low)
+    if math.isfinite(high):
+        # clamp strictly inside the half-open [low, high) support: a sample
+        # clamped to exactly `high` would score lpdf -inf under both models
+        # and poison the EI argmax with NaN
+        x = jnp.minimum(x, float(np.nextafter(np.float32(high), np.float32(low))))
+    if q is not None:
+        x = jnp.round(x / q) * q
+    return x
+
+
+def gmm1_lpdf(x, weights, mus, sigmas, low, high, q):
+    """Log-density of the truncated (quantized) mixture at ``x``
+    (tpe.py sym: GMM1_lpdf).  Quantized case integrates each bin
+    ``[x-q/2, x+q/2] ∩ [low, high]`` via cdf differences."""
+    low, high = float(low), float(high)
+    _, _, _, p_accept = _trunc_masses(weights, mus, sigmas, low, high)
+    x2 = x[..., None]  # broadcast over components
+    if q is None:
+        comp = jnp.log(jnp.maximum(weights, EPS)) + _normal_logpdf(x2, mus, sigmas)
+        comp = jnp.where(weights > 0, comp, -jnp.inf)
+        out = logsumexp(comp, axis=-1) - jnp.log(jnp.maximum(p_accept, EPS))
+        inb = jnp.ones(x.shape, bool)
+        if math.isfinite(low):
+            inb = inb & (x >= low)
+        if math.isfinite(high):
+            inb = inb & (x < high)
+        return jnp.where(inb, out, -jnp.inf)
+    ub = x2 + q / 2
+    lb = x2 - q / 2
+    if math.isfinite(high):
+        ub = jnp.minimum(ub, high)
+    if math.isfinite(low):
+        lb = jnp.maximum(lb, low)
+    prob = jnp.sum(weights * (normal_cdf(ub, mus, sigmas) - normal_cdf(lb, mus, sigmas)), axis=-1)
+    return jnp.log(jnp.maximum(prob, EPS)) - jnp.log(jnp.maximum(p_accept, EPS))
+
+
+def lgmm1_sample(key, weights, mus, sigmas, low, high, q, n_samples):
+    """Truncated lognormal mixture draw (tpe.py sym: LGMM1): the underlying
+    normal is truncated to the log-space interval ``[low, high]``, the sample
+    is its exp, optionally quantized in value space."""
+    z = gmm1_sample(key, weights, mus, sigmas, low, high, None, n_samples)
+    x = jnp.exp(z)
+    if q is not None:
+        x = jnp.round(x / q) * q
+    return x
+
+
+def lgmm1_lpdf(x, weights, mus, sigmas, low, high, q):
+    """Log-density of the truncated lognormal mixture (tpe.py sym:
+    LGMM1_lpdf).  ``low/high`` are log-space truncation bounds; the quantized
+    case integrates value-space bins via ``lognormal_cdf`` with the lower
+    edge clamped at 0 (the reference's qlognormal-includes-zero case)."""
+    low, high = float(low), float(high)
+    _, _, _, p_accept = _trunc_masses(weights, mus, sigmas, low, high)
+    x2 = x[..., None]
+    if q is None:
+        safe = jnp.maximum(x, EPS)
+        logx = jnp.log(safe)
+        comp = jnp.log(jnp.maximum(weights, EPS)) + _normal_logpdf(logx[..., None], mus, sigmas)
+        comp = jnp.where(weights > 0, comp, -jnp.inf)
+        out = logsumexp(comp, axis=-1) - logx - jnp.log(jnp.maximum(p_accept, EPS))
+        inb = x > 0
+        if math.isfinite(low):
+            inb = inb & (logx >= low)
+        if math.isfinite(high):
+            inb = inb & (logx < high)
+        return jnp.where(inb, out, -jnp.inf)
+    ub = x2 + q / 2
+    lb = jnp.maximum(x2 - q / 2, 0.0)
+    if math.isfinite(high):
+        ub = jnp.minimum(ub, math.exp(high))
+    if math.isfinite(low):
+        lb = jnp.maximum(lb, math.exp(low))
+    prob = jnp.sum(
+        weights * (lognormal_cdf(ub, mus, sigmas) - lognormal_cdf(lb, mus, sigmas)), axis=-1
+    )
+    return jnp.log(jnp.maximum(prob, EPS)) - jnp.log(jnp.maximum(p_accept, EPS))
+
+
+# ---------------------------------------------------------------------------
+# categorical / randint posterior (tpe.py sym: ap_categorical_sampler)
+# ---------------------------------------------------------------------------
+
+
+def categorical_posterior(obs, obs_mask, prior_p, prior_weight, LF):
+    """Pseudocount-smoothed posterior over ``K = len(prior_p)`` buckets:
+    ``counts(weighted by linear forgetting) + K * prior_weight * prior_p``,
+    normalized (tpe.py sym: ap_categorical_sampler)."""
+    K = prior_p.shape[0]
+    lfw = linear_forgetting_weights(obs_mask, LF)
+    onehot = jax.nn.one_hot(obs.astype(jnp.int32), K, dtype=jnp.float32)
+    counts = jnp.sum(onehot * lfw[:, None], axis=0)
+    pseudo = counts + K * prior_weight * prior_p
+    return pseudo / jnp.sum(pseudo)
+
+
+# ---------------------------------------------------------------------------
+# below/above split (tpe.py sym: ap_split_trials)
+# ---------------------------------------------------------------------------
+
+
+def split_below_above(losses, has_loss, gamma, LF):
+    """Boolean masks of the best ``n_below = min(ceil(gamma*sqrt(N)), LF)``
+    trials vs. the rest, over trials that reported a loss."""
+    cap = losses.shape[0]
+    N = jnp.sum(has_loss)
+    n_below = jnp.minimum(
+        jnp.ceil(gamma * jnp.sqrt(N.astype(jnp.float32))), jnp.float32(LF)
+    ).astype(jnp.int32)
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
+    order = jnp.argsort(jnp.where(has_loss, losses, big))
+    rank = jnp.zeros(cap, jnp.int32).at[order].set(jnp.arange(cap, dtype=jnp.int32))
+    below = (rank < n_below) & has_loss
+    above = has_loss & ~below
+    return below, above
+
+
+# ---------------------------------------------------------------------------
+# per-family proposal (tpe.py sym: ap_uniform_sampler .. build_posterior)
+# ---------------------------------------------------------------------------
+
+
+def _parzen_from(dist: Dist):
+    """Static (prior_mu, prior_sigma, low, high, q, log_space, obs_transform)
+    for the numeric families (tpe.py sym: ap_*_sampler registry)."""
+    fam, p = dist.family, dist.params
+    inf = float("inf")
+    if fam == "uniform":
+        low, high = p
+        return 0.5 * (low + high), high - low, low, high, None, False
+    if fam == "quniform":
+        low, high, q = p
+        return 0.5 * (low + high), high - low, low, high, q, False
+    if fam == "uniformint":
+        # reference lowers hp.uniformint to quniform(low-0.5, high+0.5, q=1)
+        low, high = p[0] - 0.5, p[1] + 0.5
+        return 0.5 * (low + high), high - low, low, high, 1.0, False
+    if fam == "loguniform":
+        low, high = p  # log-space bounds
+        return 0.5 * (low + high), high - low, low, high, None, True
+    if fam == "qloguniform":
+        low, high, q = p
+        return 0.5 * (low + high), high - low, low, high, q, True
+    if fam == "normal":
+        mu, sigma = p
+        return mu, sigma, -inf, inf, None, False
+    if fam == "qnormal":
+        mu, sigma, q = p
+        return mu, sigma, -inf, inf, q, False
+    if fam == "lognormal":
+        mu, sigma = p
+        return mu, sigma, -inf, inf, None, True
+    if fam == "qlognormal":
+        mu, sigma, q = p
+        return mu, sigma, -inf, inf, q, True
+    raise ValueError(f"no parzen prior for family {dist.family!r}")
+
+
+def _prior_probs(dist: Dist) -> np.ndarray:
+    """Static prior bucket probabilities for the discrete families."""
+    if dist.family == "categorical":
+        p = np.asarray(dist.params, np.float32)
+        return p / p.sum()
+    if dist.family == "randint":
+        low, high = dist.params
+        K = int(high) - int(low)
+        return np.full(K, 1.0 / K, np.float32)
+    raise ValueError(f"not a discrete family: {dist.family!r}")
+
+
+def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
+    """Sample candidates from the below model, score EI = llik_below −
+    llik_above, return the argmax candidate (tpe.py sym: broadcast_best)."""
+    prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(dist)
+    obs = vals
+    if log_space:
+        obs = jnp.log(jnp.maximum(vals, EPS))
+    fit = functools.partial(
+        adaptive_parzen_normal,
+        prior_weight=cfg["prior_weight"],
+        prior_mu=jnp.float32(prior_mu),
+        prior_sigma=jnp.float32(prior_sigma),
+        LF=cfg["LF"],
+    )
+    wb, mb, sb = fit(obs, below_mask)
+    wa, ma, sa = fit(obs, above_mask)
+    n_cand = cfg["n_EI_candidates"]
+    if log_space:
+        samples = lgmm1_sample(key, wb, mb, sb, low, high, q, n_cand)
+        ll_b = lgmm1_lpdf(samples, wb, mb, sb, low, high, q)
+        ll_a = lgmm1_lpdf(samples, wa, ma, sa, low, high, q)
+    else:
+        samples = gmm1_sample(key, wb, mb, sb, low, high, q, n_cand)
+        ll_b = gmm1_lpdf(samples, wb, mb, sb, low, high, q)
+        ll_a = gmm1_lpdf(samples, wa, ma, sa, low, high, q)
+    ei = ll_b - ll_a
+    ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)  # -inf − -inf must never win
+    return samples[jnp.argmax(ei)]
+
+
+def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
+    prior_p = jnp.asarray(_prior_probs(dist))
+    offset = 0
+    if dist.family == "randint":
+        offset = int(dist.params[0])
+    obs = vals.astype(jnp.int32) - offset
+    pb = categorical_posterior(obs, below_mask, prior_p, cfg["prior_weight"], cfg["LF"])
+    pa = categorical_posterior(obs, above_mask, prior_p, cfg["prior_weight"], cfg["LF"])
+    n_cand = cfg["n_EI_candidates"]
+    samples = jax.random.categorical(key, jnp.log(pb), shape=(n_cand,))
+    ei = jnp.log(pb[samples]) - jnp.log(pa[samples])
+    return samples[jnp.argmax(ei)] + offset
+
+
+def build_propose(cs, cfg):
+    """Compile one proposal step for a CompiledSpace.
+
+    Returns a pure function ``propose(history, key) -> {label: value}``:
+    the full TPE posterior for every hyperparameter, evaluated jointly in one
+    XLA program — the jitted replacement for the reference's per-call
+    ``build_posterior`` graph surgery + ``rec_eval`` interpretation
+    (tpe.py sym: build_posterior, suggest).
+    """
+
+    def propose(history, key):
+        losses = jnp.asarray(history["losses"])
+        has_loss = jnp.asarray(history["has_loss"])
+        below, above = split_below_above(losses, has_loss, cfg["gamma"], cfg["LF"])
+        out = {}
+        for label in cs.labels:
+            info = cs.params[label]
+            vals = jnp.asarray(history["vals"][label])
+            active = jnp.asarray(history["active"][label])
+            k = jax.random.fold_in(key, label_hash(label))
+            b = below & active
+            a = above & active
+            if info.dist.family in ("categorical", "randint"):
+                out[label] = _propose_discrete(k, info.dist, vals, b, a, cfg)
+            else:
+                out[label] = _propose_numeric(k, info.dist, vals, b, a, cfg)
+        return out
+
+    return propose
+
+
+def _get_propose_jit(domain, cfg_key, cfg):
+    """Per-domain cache of the jitted (and vmapped-over-keys) proposal fn."""
+    cache = getattr(domain, "_tpe_propose_cache", None)
+    if cache is None:
+        cache = domain._tpe_propose_cache = {}
+    fn = cache.get(cfg_key)
+    if fn is None:
+        propose = build_propose(domain.cs, cfg)
+        fn = jax.jit(jax.vmap(propose, in_axes=(None, 0)))
+        cache[cfg_key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# the plugin entry point (tpe.py sym: suggest)
+# ---------------------------------------------------------------------------
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    verbose=False,
+):
+    """Propose new trials by TPE (hyperopt/tpe.py sym: suggest).
+
+    Signature-compatible with the reference plugin boundary, incl.
+    ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` tuning.
+    The first ``n_startup_jobs`` trials delegate to random search; after that
+    every proposal is one jitted device program, vmapped over ``new_ids``.
+    """
+    if len(trials.trials) < n_startup_jobs:
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    cfg = {
+        "prior_weight": float(prior_weight),
+        "n_EI_candidates": int(n_EI_candidates),
+        "gamma": float(gamma),
+        "LF": int(linear_forgetting),
+    }
+    cfg_key = tuple(sorted(cfg.items()))
+    history = trials.padded_history(domain.cs.labels)
+    hist_arrays = {
+        "losses": history["losses"],
+        "has_loss": history["has_loss"],
+        "vals": history["vals"],
+        "active": history["active"],
+    }
+
+    propose = _get_propose_jit(domain, cfg_key, cfg)
+    base_key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+        jnp.asarray([int(i) & 0x7FFFFFFF for i in new_ids], jnp.uint32)
+    )
+    batch = propose(hist_arrays, keys)
+    host = {k: np.asarray(v) for k, v in batch.items()}
+    flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
+    return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

@@ -1,0 +1,237 @@
+"""Benchmark domain zoo — shared fixtures for tests and bench.py.
+
+Parity target: ``hyperopt/tests/test_domains.py`` (sym: quadratic1,
+q1_lognormal, q1_choice, n_arms, distractor, gauss_wave, gauss_wave2, branin,
+many_dists) — the reference keeps these in its test tree; here they live in
+the package so ``bench.py`` and ``__graft_entry__`` reuse them.
+
+Each entry is a ``DomainZoo`` record: a search space, an objective over the
+structured sample, the known optimum (when analytic), and a ``traceable``
+flag — True when the objective is pure jnp math, so it can run under
+``jit``/``vmap``/``lax.scan`` (the on-device fmin and batched-eval paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from . import hp
+
+__all__ = ["DomainZoo", "ZOO", "branin", "hartmann6", "rosenbrock"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DomainZoo:
+    name: str
+    space: Any
+    objective: Callable
+    loss_target: float  # a loss an OK optimizer reaches within ~100 evals
+    optimum: float | None = None
+    traceable: bool = False
+
+
+def branin(x, y):
+    """Branin-Hoo (BASELINE config #2); global min ≈ 0.397887."""
+    a = 1.0
+    b = 5.1 / (4.0 * math.pi**2)
+    c = 5.0 / math.pi
+    r = 6.0
+    s = 10.0
+    t = 1.0 / (8.0 * math.pi)
+    return a * (y - b * x**2 + c * x - r) ** 2 + s * (1 - t) * jnp.cos(x) + s
+
+
+def hartmann6(x):
+    """6-D Hartmann (BASELINE config #3); global min ≈ -3.32237."""
+    alpha = jnp.array([1.0, 1.2, 3.0, 3.2])
+    A = jnp.array(
+        [
+            [10, 3, 17, 3.5, 1.7, 8],
+            [0.05, 10, 17, 0.1, 8, 14],
+            [3, 3.5, 1.7, 10, 17, 8],
+            [17, 8, 0.05, 10, 0.1, 14],
+        ],
+        jnp.float32,
+    )
+    P = 1e-4 * jnp.array(
+        [
+            [1312, 1696, 5569, 124, 8283, 5886],
+            [2329, 4135, 8307, 3736, 1004, 9991],
+            [2348, 1451, 3522, 2883, 3047, 6650],
+            [4047, 8828, 8732, 5743, 1091, 381],
+        ],
+        jnp.float32,
+    )
+    inner = jnp.sum(A * (jnp.asarray(x) - P) ** 2, axis=1)
+    return -jnp.sum(alpha * jnp.exp(-inner))
+
+
+def rosenbrock(xs):
+    xs = jnp.asarray(xs)
+    return jnp.sum(100.0 * (xs[1:] - xs[:-1] ** 2) ** 2 + (1.0 - xs[:-1]) ** 2)
+
+
+def _quadratic1():
+    return DomainZoo(
+        name="quadratic1",
+        space={"x": hp.uniform("x", -5, 5)},
+        objective=lambda d: (d["x"] - 3.0) ** 2,
+        loss_target=0.1,
+        optimum=0.0,
+        traceable=True,
+    )
+
+
+def _q1_lognormal():
+    return DomainZoo(
+        name="q1_lognormal",
+        space={"x": hp.qlognormal("x", 0.0, 2.0, 1.0)},
+        objective=lambda d: max(-(d["x"] ** 2), -100.0) if not isinstance(d["x"], jnp.ndarray)
+        else jnp.maximum(-(d["x"] ** 2), -100.0),
+        loss_target=-9.0,
+        optimum=-100.0,
+    )
+
+
+def _q1_choice():
+    return DomainZoo(
+        name="q1_choice",
+        space=hp.choice(
+            "case",
+            [{"x": hp.uniform("x1", -5, 5)}, {"x": hp.uniform("x2", -10, -3)}],
+        ),
+        objective=lambda d: (d["x"] + 2.0) ** 2,
+        loss_target=0.5,
+        optimum=0.0,
+    )
+
+
+def _n_arms(n=2):
+    return DomainZoo(
+        name="n_arms",
+        space=hp.choice("arm", list(range(n))),
+        objective=lambda arm: 0.0 if arm == 0 else 1.0,
+        loss_target=0.0,
+        optimum=0.0,
+    )
+
+
+def _distractor():
+    # global min at x=3 (deep narrow), distractor basin at x=-3 (wide shallow)
+    def obj(d):
+        x = d["x"]
+        f = -math.exp(-((x - 3.0) ** 2)) - 1.2 * math.exp(-0.05 * (x + 3.0) ** 2)
+        return f
+
+    return DomainZoo(
+        name="distractor",
+        space={"x": hp.uniform("x", -15, 15)},
+        objective=obj,
+        loss_target=-1.1,
+        optimum=None,
+    )
+
+
+def _gauss_wave2():
+    def obj(d):
+        x = d["x"]
+        t = d["hf"]
+        f = math.sin(x) * (1.0 if t == "sin" else 0.0) + 0.1 * x**2
+        return f
+
+    return DomainZoo(
+        name="gauss_wave2",
+        space={
+            "x": hp.uniform("x", -20, 20),
+            "hf": hp.choice("hf", ["sin", "flat"]),
+        },
+        objective=obj,
+        loss_target=0.0,
+    )
+
+
+def _branin_domain():
+    return DomainZoo(
+        name="branin",
+        space={"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)},
+        objective=lambda d: float(branin(d["x"], d["y"])),
+        loss_target=0.9,
+        optimum=0.397887,
+        traceable=True,
+    )
+
+
+def _hartmann6_domain():
+    return DomainZoo(
+        name="hartmann6",
+        space={f"x{i}": hp.uniform(f"x{i}", 0, 1) for i in range(6)},
+        objective=lambda d: float(hartmann6([d[f"x{i}"] for i in range(6)])),
+        loss_target=-2.0,
+        optimum=-3.32237,
+        traceable=True,
+    )
+
+
+def _rosenbrock4():
+    return DomainZoo(
+        name="rosenbrock4",
+        space={f"x{i}": hp.uniform(f"x{i}", -2, 2) for i in range(4)},
+        objective=lambda d: float(rosenbrock([d[f"x{i}"] for i in range(4)])),
+        loss_target=30.0,
+        optimum=0.0,
+        traceable=True,
+    )
+
+
+def _many_dists():
+    """One of every hp.* family incl. nested choice
+    (hyperopt/tests/test_domains.py sym: many_dists)."""
+    space = {
+        "a": hp.choice("a", [0, 1, 2]),
+        "b": hp.randint("b", 10),
+        "c": hp.uniform("c", 4, 7),
+        "d": hp.loguniform("d", -2, 0),
+        "e": hp.quniform("e", 0, 10, 3),
+        "f": hp.qloguniform("f", 0, 3, 2),
+        "g": hp.normal("g", 4, 7),
+        "h": hp.lognormal("h", -2, 2),
+        "i": hp.qnormal("i", 0, 10, 2),
+        "j": hp.qlognormal("j", 0, 2, 1),
+        "k": hp.pchoice("k", [(0.1, 0), (0.9, 1)]),
+        "z": hp.choice(
+            "z", [{"m": hp.uniform("m", -1, 1)}, {"n": hp.uniformint("n", 1, 5)}]
+        ),
+    }
+
+    def obj(d):
+        z = d["z"]
+        zv = z.get("m", 0.0) + z.get("n", 0)
+        return (
+            abs(d["c"] - 5.0)
+            + 0.1 * abs(d["g"])
+            + 0.01 * (d["a"] + d["b"] + d["e"] + d["k"])
+            + 0.001 * (d["d"] + d["f"] + d["h"] + d["i"] + abs(d["j"]) + zv)
+        )
+
+    return DomainZoo(name="many_dists", space=space, objective=obj, loss_target=2.5)
+
+
+ZOO = {
+    d.name: d
+    for d in (
+        _quadratic1(),
+        _q1_lognormal(),
+        _q1_choice(),
+        _n_arms(),
+        _distractor(),
+        _gauss_wave2(),
+        _branin_domain(),
+        _hartmann6_domain(),
+        _rosenbrock4(),
+        _many_dists(),
+    )
+}

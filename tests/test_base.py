@@ -1,0 +1,237 @@
+"""Core-runtime tests (parity target: hyperopt/tests/test_base.py)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperopt_tpu import (
+    Ctrl,
+    Domain,
+    InvalidTrial,
+    JOB_STATE_DONE,
+    JOB_STATE_NEW,
+    STATUS_OK,
+    Trials,
+    hp,
+    trials_from_docs,
+)
+from hyperopt_tpu.base import (
+    SONify,
+    coarse_utcnow,
+    miscs_to_idxs_vals,
+    miscs_update_idxs_vals,
+    spec_from_misc,
+)
+from hyperopt_tpu.algos import rand
+
+
+def _make_doc(tid, vals, loss=None, state=JOB_STATE_NEW):
+    result = {"status": STATUS_OK, "loss": loss} if loss is not None else {"status": "new"}
+    return {
+        "tid": tid,
+        "spec": None,
+        "result": result,
+        "misc": {
+            "tid": tid,
+            "cmd": ("domain_attachment", "FMinIter_Domain"),
+            "idxs": {k: [tid] for k in vals},
+            "vals": {k: [v] for k, v in vals.items()},
+        },
+        "state": state,
+        "exp_key": None,
+        "owner": None,
+        "version": 0,
+        "book_time": None,
+        "refresh_time": None,
+    }
+
+
+def test_sonify():
+    out = SONify({"a": np.int64(3), "b": np.float32(0.5), "c": (1, 2),
+                  "d": np.arange(3), "e": None, "f": True})
+    assert out == {"a": 3, "b": 0.5, "c": [1, 2], "d": [0, 1, 2], "e": None, "f": True}
+    assert isinstance(out["a"], int) and isinstance(out["b"], float)
+    with pytest.raises(TypeError):
+        SONify(object())
+
+
+def test_sonify_jax_array():
+    import jax.numpy as jnp
+
+    assert SONify(jnp.asarray(2.5)) == 2.5
+
+
+def test_coarse_utcnow_granularity():
+    t = coarse_utcnow()
+    assert t.microsecond % 1000 == 0
+
+
+def test_trial_doc_validation():
+    t = Trials()
+    with pytest.raises(InvalidTrial):
+        t.insert_trial_doc({"tid": 0})
+    bad = _make_doc(0, {"x": 1.0})
+    bad["state"] = 99
+    with pytest.raises(InvalidTrial):
+        t.insert_trial_doc(bad)
+    mismatched = _make_doc(0, {"x": 1.0})
+    mismatched["misc"]["tid"] = 5
+    with pytest.raises(InvalidTrial):
+        t.insert_trial_doc(mismatched)
+
+
+def test_trials_insert_refresh_len():
+    t = Trials()
+    t.insert_trial_docs([_make_doc(i, {"x": float(i)}, loss=float(i),
+                                   state=JOB_STATE_DONE) for i in range(5)])
+    assert len(t) == 0  # not refreshed yet
+    t.refresh()
+    assert len(t) == 5
+    assert t.tids == list(range(5))
+    assert t.losses() == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert t.argmin == {"x": 0.0}
+    assert t.best_trial["tid"] == 0
+    assert t.average_best_error() == 0.0
+
+
+def test_trials_new_trial_ids_monotonic():
+    t = Trials()
+    a = t.new_trial_ids(3)
+    b = t.new_trial_ids(2)
+    assert a == [0, 1, 2]
+    assert b == [3, 4]
+
+
+def test_trials_exp_key_scoping():
+    t = Trials(exp_key="e1")
+    doc = _make_doc(0, {"x": 1.0}, loss=1.0, state=JOB_STATE_DONE)
+    doc["exp_key"] = "e1"
+    other = _make_doc(1, {"x": 2.0}, loss=2.0, state=JOB_STATE_DONE)
+    other["exp_key"] = "e2"
+    t.insert_trial_docs([doc, other])
+    t.refresh()
+    assert len(t) == 1
+    assert t.count_by_state_unsynced(JOB_STATE_DONE) == 1
+
+
+def test_trials_pickle_roundtrip():
+    t = Trials()
+    t.insert_trial_docs([_make_doc(i, {"x": float(i)}, loss=float(i),
+                                   state=JOB_STATE_DONE) for i in range(3)])
+    t.refresh()
+    t2 = pickle.loads(pickle.dumps(t))
+    assert len(t2) == 3
+    assert t2.losses() == t.losses()
+    assert t2.argmin == t.argmin
+    # history rebuilds after unpickle
+    h = t2.padded_history(("x",))
+    assert h["n"] == 3
+
+
+def test_trials_from_docs():
+    docs = [_make_doc(i, {"x": float(i)}, loss=float(i), state=JOB_STATE_DONE)
+            for i in range(4)]
+    t = trials_from_docs(docs)
+    assert len(t) == 4
+    with pytest.raises(InvalidTrial):
+        trials_from_docs([{"nope": 1}])
+
+
+def test_miscs_round_trip():
+    docs = [_make_doc(i, {"x": float(i), "y": float(-i)}) for i in range(3)]
+    miscs = [d["misc"] for d in docs]
+    idxs, vals = miscs_to_idxs_vals(miscs)
+    assert idxs["x"] == [0, 1, 2]
+    assert vals["y"] == [0.0, -1.0, -2.0]
+    # wipe and write back
+    for m in miscs:
+        m["idxs"] = {"x": [], "y": []}
+        m["vals"] = {"x": [], "y": []}
+    miscs_update_idxs_vals(miscs, idxs, vals)
+    idxs2, vals2 = miscs_to_idxs_vals(miscs)
+    assert idxs2 == idxs and vals2 == vals
+
+
+def test_spec_from_misc_skips_inactive():
+    misc = {"tid": 0, "cmd": None, "idxs": {"x": [0], "y": []},
+            "vals": {"x": [1.5], "y": []}}
+    assert spec_from_misc(misc) == {"x": 1.5}
+
+
+def test_padded_history_growth_and_masks():
+    t = Trials()
+    n = 70  # crosses the 64-slot capacity bucket
+    docs = []
+    for i in range(n):
+        vals = {"x": float(i)} if i % 2 == 0 else {}
+        d = _make_doc(i, vals, loss=float(i), state=JOB_STATE_DONE)
+        docs.append(d)
+    t.insert_trial_docs(docs)
+    t.refresh()
+    h = t.padded_history(("x",))
+    assert h["n"] == n
+    assert h["cap"] == 128
+    assert h["active"]["x"].sum() == (n + 1) // 2
+    assert h["has_loss"].sum() == n
+    # incremental: appending more only folds the new ones
+    t.insert_trial_docs([_make_doc(n, {"x": 1.0}, loss=0.5, state=JOB_STATE_DONE)])
+    t.refresh()
+    h2 = t.padded_history(("x",))
+    assert h2["n"] == n + 1
+
+
+def test_domain_evaluate_scalar_and_dict():
+    d = Domain(lambda cfg: cfg["x"] ** 2, {"x": hp.uniform("x", -1, 1)})
+    out = d.evaluate({"x": 2.0}, None)
+    assert out == {"loss": 4.0, "status": STATUS_OK}
+
+    d2 = Domain(lambda cfg: {"loss": cfg["x"], "status": STATUS_OK},
+                {"x": hp.uniform("x", -1, 1)})
+    assert d2.evaluate({"x": 0.5}, None)["loss"] == 0.5
+
+
+def test_domain_invalid_results():
+    from hyperopt_tpu import InvalidLoss, InvalidResultStatus
+
+    d = Domain(lambda cfg: float("nan"), {"x": hp.uniform("x", -1, 1)})
+    with pytest.raises(InvalidLoss):
+        d.evaluate({"x": 0.0}, None)
+    d2 = Domain(lambda cfg: {"status": "bogus"}, {"x": hp.uniform("x", -1, 1)})
+    with pytest.raises(InvalidResultStatus):
+        d2.evaluate({"x": 0.0}, None)
+    d3 = Domain(lambda cfg: {"status": STATUS_OK}, {"x": hp.uniform("x", -1, 1)})
+    with pytest.raises(InvalidLoss):
+        d3.evaluate({"x": 0.0}, None)
+
+
+def test_domain_pickles_without_jit_handles():
+    d = Domain(None, {"x": hp.uniform("x", -1, 1)})
+    d.cs.sample_flat_jit(jax.random.PRNGKey(0))  # force-compile
+    d2 = pickle.loads(pickle.dumps(d))
+    # usable after reload
+    v = d2.cs.sample_flat_jit(jax.random.PRNGKey(0))
+    assert "x" in v
+
+
+def test_ctrl_inject_results():
+    t = Trials()
+    ctrl = Ctrl(t)
+    misc = {"tid": 0, "cmd": None, "idxs": {"x": [0]}, "vals": {"x": [1.0]}}
+    ctrl.inject_results([None], [{"status": STATUS_OK, "loss": 1.0}], [misc],
+                        new_tids=[0])
+    t.refresh()
+    assert len(t) == 1
+    assert t.trials[0]["state"] == JOB_STATE_DONE
+
+
+def test_delete_all():
+    t = Trials()
+    t.insert_trial_docs([_make_doc(0, {"x": 1.0}, loss=1.0, state=JOB_STATE_DONE)])
+    t.refresh()
+    t.attachments["blob"] = b"x"
+    t.delete_all()
+    assert len(t) == 0
+    assert t.attachments == {}

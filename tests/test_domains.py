@@ -1,0 +1,47 @@
+"""Domain-zoo tests (parity target: hyperopt/tests/test_domains.py sym:
+CasePerDomain) — every zoo domain runs under random search; the optimizing
+suggesters hit their loss targets on representative domains."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.zoo import ZOO, branin, hartmann6
+
+
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_domain_runs_under_rand(name):
+    domain = ZOO[name]
+    t = Trials()
+    fmin(domain.objective, domain.space, algo=rand.suggest, max_evals=20,
+         trials=t, rstate=np.random.default_rng(0), show_progressbar=False)
+    losses = [l for l in t.losses() if l is not None]
+    assert len(losses) == 20
+    assert np.all(np.isfinite(losses))
+
+
+@pytest.mark.parametrize("name", ["quadratic1", "branin", "q1_choice"])
+def test_tpe_hits_loss_target(name):
+    domain = ZOO[name]
+    best = np.inf
+    for seed in range(3):
+        t = Trials()
+        fmin(domain.objective, domain.space, algo=tpe.suggest, max_evals=100,
+             trials=t, rstate=np.random.default_rng(seed), show_progressbar=False)
+        best = min(best, min(l for l in t.losses() if l is not None))
+        if best < domain.loss_target:
+            break
+    assert best < domain.loss_target
+
+
+def test_branin_value():
+    # known optima of Branin-Hoo
+    assert float(branin(-np.pi, 12.275)) == pytest.approx(0.397887, abs=1e-4)
+    assert float(branin(np.pi, 2.275)) == pytest.approx(0.397887, abs=1e-4)
+    assert float(branin(9.42478, 2.475)) == pytest.approx(0.397887, abs=1e-4)
+
+
+def test_hartmann6_value():
+    xstar = [0.20169, 0.150011, 0.476874, 0.275332, 0.311652, 0.6573]
+    assert float(hartmann6(xstar)) == pytest.approx(-3.32237, abs=1e-3)

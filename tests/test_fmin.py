@@ -1,0 +1,227 @@
+"""Driver-loop tests (parity target: hyperopt/tests/test_fmin.py)."""
+
+import os
+import pickle
+import tempfile
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import (
+    AllTrialsFailed,
+    STATUS_FAIL,
+    STATUS_OK,
+    Trials,
+    fmin,
+    generate_trials_to_calculate,
+    hp,
+    space_eval,
+)
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.early_stop import no_progress_loss
+
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2
+
+
+def test_fmin_converges_rand():
+    best = fmin(quad, SPACE, algo=rand.suggest, max_evals=80,
+                rstate=np.random.default_rng(0), show_progressbar=False)
+    assert abs(best["x"] - 1.0) < 1.0
+
+
+def test_fmin_default_algo_is_tpe():
+    best = fmin(quad, SPACE, max_evals=25, rstate=np.random.default_rng(0),
+                show_progressbar=False)
+    assert "x" in best
+
+
+def test_fmin_trials_capture():
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=10, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) == 10
+    assert all(s == STATUS_OK for s in t.statuses())
+    assert min(t.losses()) == t.best_trial["result"]["loss"]
+
+
+def test_fmin_seed_reproducible():
+    r1 = fmin(quad, SPACE, algo=rand.suggest, max_evals=10,
+              rstate=np.random.default_rng(42), show_progressbar=False)
+    r2 = fmin(quad, SPACE, algo=rand.suggest, max_evals=10,
+              rstate=np.random.default_rng(42), show_progressbar=False)
+    assert r1 == r2
+
+
+def test_fmin_env_seed(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_FMIN_SEED", "7")
+    r1 = fmin(quad, SPACE, algo=rand.suggest, max_evals=5, show_progressbar=False)
+    r2 = fmin(quad, SPACE, algo=rand.suggest, max_evals=5, show_progressbar=False)
+    assert r1 == r2
+
+
+def test_fmin_timeout():
+    import time
+
+    calls = []
+
+    def slow(d):
+        calls.append(1)
+        time.sleep(0.25)
+        return d["x"] ** 2
+
+    t = Trials()
+    fmin(slow, SPACE, algo=rand.suggest, max_evals=1000, trials=t, timeout=1,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert 0 < len(t) < 1000
+
+
+def test_fmin_timeout_validation():
+    with pytest.raises(Exception):
+        fmin(quad, SPACE, algo=rand.suggest, max_evals=5, timeout=-1,
+             show_progressbar=False)
+    with pytest.raises(Exception):
+        fmin(quad, SPACE, algo=rand.suggest, max_evals=5, timeout=True,
+             show_progressbar=False)
+
+
+def test_fmin_loss_threshold():
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=1000, trials=t,
+         loss_threshold=5.0, rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) < 1000
+    assert min(t.losses()) <= 5.0
+
+
+def test_fmin_loss_threshold_validation():
+    with pytest.raises(Exception):
+        fmin(quad, SPACE, algo=rand.suggest, max_evals=5, loss_threshold="x",
+             show_progressbar=False)
+
+
+def test_fmin_early_stop_fn():
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=500, trials=t,
+         early_stop_fn=no_progress_loss(10), rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    assert len(t) < 500
+
+
+def test_fmin_points_to_evaluate():
+    t = generate_trials_to_calculate([{"x": 0.0}, {"x": 1.0}])
+    best = fmin(quad, SPACE, algo=rand.suggest, max_evals=12, trials=t,
+                rstate=np.random.default_rng(0), show_progressbar=False)
+    # trial 1 pinned exactly at the optimum x=1
+    assert t.trials[1]["misc"]["vals"]["x"] == [1.0]
+    assert best["x"] == 1.0
+
+    best2 = fmin(quad, SPACE, algo=rand.suggest, max_evals=5,
+                 points_to_evaluate=[{"x": 1.0}],
+                 rstate=np.random.default_rng(0), show_progressbar=False)
+    assert best2["x"] == 1.0
+
+
+def test_fmin_trials_save_file_roundtrip(tmp_path):
+    f = str(tmp_path / "trials.pkl")
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=6, trials_save_file=f,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    with open(f, "rb") as fh:
+        t = pickle.load(fh)
+    assert len(t) == 6
+    # resume continues from the checkpoint
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=10, trials_save_file=f,
+         rstate=np.random.default_rng(1), show_progressbar=False)
+    with open(f, "rb") as fh:
+        t2 = pickle.load(fh)
+    assert len(t2) == 10
+
+
+def test_fmin_exception_propagates():
+    def bad(d):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        fmin(bad, SPACE, algo=rand.suggest, max_evals=3,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+
+
+def test_fmin_catch_eval_exceptions():
+    def flaky(d):
+        if d["x"] < 0:
+            raise RuntimeError("boom")
+        return d["x"]
+
+    t = Trials()
+    fmin(flaky, SPACE, algo=rand.suggest, max_evals=20, trials=t,
+         catch_eval_exceptions=True, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    # failed trials are excluded from the refreshed view but were attempted
+    assert len(t) <= 20
+    assert all(l >= 0 for l in t.losses() if l is not None)
+
+
+def test_fmin_all_trials_failed():
+    def bad(d):
+        return {"status": STATUS_FAIL}
+
+    with pytest.raises(AllTrialsFailed):
+        fmin(bad, SPACE, algo=rand.suggest, max_evals=3,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+
+
+def test_fmin_return_argmin_false():
+    out = fmin(quad, SPACE, algo=rand.suggest, max_evals=3, return_argmin=False,
+               rstate=np.random.default_rng(0), show_progressbar=False)
+    assert out is None
+
+
+def test_fmin_dict_result_with_extras():
+    def obj(d):
+        return {"loss": d["x"] ** 2, "status": STATUS_OK, "custom": 42}
+
+    t = Trials()
+    fmin(obj, SPACE, algo=rand.suggest, max_evals=4, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert t.results[0]["custom"] == 42
+
+
+def test_fmin_attachments():
+    def obj(d):
+        return {"loss": d["x"] ** 2, "status": STATUS_OK,
+                "attachments": {"blob": b"\x00\x01"}}
+
+    t = Trials()
+    fmin(obj, SPACE, algo=rand.suggest, max_evals=2, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert t.trial_attachments(t.trials[0])["blob"] == b"\x00\x01"
+
+
+def test_fmin_max_queue_len():
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=12, trials=t, max_queue_len=4,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) == 12
+
+
+def test_space_eval_roundtrip():
+    space = hp.choice("c", [
+        {"kind": "a", "x": hp.uniform("x", -1, 1)},
+        {"kind": "b", "y": hp.loguniform("y", -2, 2)},
+    ])
+    out = space_eval(space, {"c": 0, "x": 0.5})
+    assert out == {"kind": "a", "x": 0.5}
+    out = space_eval(space, {"c": [1], "y": [1.5]})
+    assert out["kind"] == "b"
+    assert out["y"] == pytest.approx(1.5)
+
+
+def test_trials_fmin_method():
+    t = Trials()
+    best = t.fmin(quad, SPACE, algo=rand.suggest, max_evals=8,
+                  rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) == 8
+    assert "x" in best

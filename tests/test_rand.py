@@ -1,0 +1,85 @@
+"""Random-suggester tests (parity target: hyperopt/tests/test_rand.py)."""
+
+import numpy as np
+
+from hyperopt_tpu import Domain, Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+
+def _collect(space, n=400, seed=0, batch=False):
+    domain = Domain(None, space)
+    trials = Trials()
+    ids = trials.new_trial_ids(n)
+    fn = rand.suggest_batch if batch else rand.suggest
+    docs = fn(ids, domain, trials, seed)
+    return docs, domain
+
+
+def test_suggest_bounds_and_quantization():
+    space = {
+        "u": hp.uniform("u", -2, 3),
+        "qu": hp.quniform("qu", 0, 10, 2.5),
+        "lu": hp.loguniform("lu", -2, 2),
+        "ri": hp.randint("ri", 3, 9),
+        "ui": hp.uniformint("ui", 1, 4),
+    }
+    docs, _ = _collect(space)
+    u = np.array([d["misc"]["vals"]["u"][0] for d in docs])
+    qu = np.array([d["misc"]["vals"]["qu"][0] for d in docs])
+    lu = np.array([d["misc"]["vals"]["lu"][0] for d in docs])
+    ri = np.array([d["misc"]["vals"]["ri"][0] for d in docs])
+    ui = np.array([d["misc"]["vals"]["ui"][0] for d in docs])
+    assert u.min() >= -2 and u.max() <= 3
+    np.testing.assert_allclose(qu, np.round(qu / 2.5) * 2.5, atol=1e-5)
+    assert lu.min() >= np.exp(-2) - 1e-5 and lu.max() <= np.exp(2) + 1e-5
+    assert set(np.unique(ri)) <= set(range(3, 9))
+    assert set(np.unique(ui)) <= {1, 2, 3, 4}
+    # rough uniformity of the uniform draw
+    assert abs(u.mean() - 0.5) < 0.3
+
+
+def test_suggest_conditional_sparsity():
+    space = hp.choice("c", [{"x": hp.uniform("x", 0, 1)},
+                            {"y": hp.uniform("y", 0, 1)}])
+    docs, _ = _collect(space, n=200)
+    for d in docs:
+        vals = d["misc"]["vals"]
+        branch = vals["c"][0]
+        if branch == 0:
+            assert len(vals["x"]) == 1 and len(vals["y"]) == 0
+        else:
+            assert len(vals["x"]) == 0 and len(vals["y"]) == 1
+    branches = np.array([d["misc"]["vals"]["c"][0] for d in docs])
+    assert 0.3 < branches.mean() < 0.7
+
+
+def test_suggest_batch_matches_serial_distribution():
+    space = {"u": hp.uniform("u", 0, 1)}
+    serial, _ = _collect(space, n=300, seed=5)
+    batch, _ = _collect(space, n=300, seed=5, batch=True)
+    a = np.array([d["misc"]["vals"]["u"][0] for d in serial])
+    b = np.array([d["misc"]["vals"]["u"][0] for d in batch])
+    # same fold_in construction → identical draws
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_pchoice_frequencies():
+    space = hp.pchoice("p", [(0.8, "a"), (0.2, "b")])
+    docs, _ = _collect(space, n=1000)
+    idx = np.array([d["misc"]["vals"]["p"][0] for d in docs])
+    assert abs((idx == 0).mean() - 0.8) < 0.06
+
+
+def test_rand_fmin_on_conditional_space():
+    space = hp.choice("c", [
+        {"kind": "a", "x": hp.uniform("xa", -5, 5)},
+        {"kind": "b", "y": hp.uniform("yb", 0, 1)},
+    ])
+
+    def obj(d):
+        return (d["x"] - 2) ** 2 if d["kind"] == "a" else 5 + d["y"]
+
+    t = Trials()
+    fmin(obj, space, algo=rand.suggest, max_evals=60, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert t.best_trial["result"]["loss"] < 5

@@ -1,0 +1,320 @@
+"""Statistical tests for the jitted TPE kernels.
+
+Mirrors the reference's test doctrine (``hyperopt/tests/test_tpe.py``,
+SURVEY.md §4): seed-pinned but *statistical* assertions — lpdf normalization
+over the truncated support, sampler↔lpdf agreement, and
+optimizer-beats-random — never bitwise golden values (threefry ≠ MT19937,
+inversion ≠ rejection).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.zoo import ZOO
+
+
+def _mask(n, cap=64):
+    m = np.zeros(cap, bool)
+    m[:n] = True
+    return jnp.asarray(m)
+
+
+def _obs(values, cap=64):
+    v = np.zeros(cap, np.float32)
+    v[: len(values)] = values
+    return jnp.asarray(v), _mask(len(values), cap)
+
+
+# ---------------------------------------------------------------------------
+# linear forgetting
+# ---------------------------------------------------------------------------
+
+
+def test_linear_forgetting_all_ones_when_small():
+    w = tpe.linear_forgetting_weights(_mask(10), LF=25)
+    np.testing.assert_allclose(np.asarray(w)[:10], 1.0)
+    np.testing.assert_allclose(np.asarray(w)[10:], 0.0)
+
+
+def test_linear_forgetting_ramp():
+    n, LF = 40, 25
+    w = np.asarray(tpe.linear_forgetting_weights(_mask(n), LF=LF))[:n]
+    # newest LF at weight 1; oldest n-LF ramp from 1/n up
+    np.testing.assert_allclose(w[n - LF :], 1.0)
+    ref_ramp = np.linspace(1.0 / n, 1.0, n - LF)
+    np.testing.assert_allclose(w[: n - LF], ref_ramp, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# adaptive parzen fit
+# ---------------------------------------------------------------------------
+
+
+def test_adaptive_parzen_empty_is_prior():
+    obs, mask = _obs([])
+    w, mu, sig = tpe.adaptive_parzen_normal(obs, mask, 1.0, 0.5, 2.0, 25)
+    w, mu, sig = map(np.asarray, (w, mu, sig))
+    assert w.sum() == pytest.approx(1.0)
+    live = w > 0
+    assert live.sum() == 1
+    assert mu[live][0] == pytest.approx(0.5)
+    assert sig[live][0] == pytest.approx(2.0)
+
+
+def test_adaptive_parzen_shapes_and_clipping():
+    values = [1.0, 1.1, 4.0, -2.0, 0.3]
+    obs, mask = _obs(values)
+    prior_mu, prior_sigma = 0.0, 10.0
+    w, mu, sig = tpe.adaptive_parzen_normal(obs, mask, 1.0, prior_mu, prior_sigma, 25)
+    w, mu, sig = map(np.asarray, (w, mu, sig))
+    assert w.sum() == pytest.approx(1.0, abs=1e-5)
+    m = len(values) + 1
+    assert (w > 0).sum() == m
+    live_mu = mu[w > 0]
+    assert np.all(np.diff(live_mu) >= 0)  # sorted
+    np.testing.assert_allclose(live_mu, np.sort(values + [prior_mu]), atol=1e-5)
+    minsigma = prior_sigma / min(100.0, 1.0 + m)
+    assert np.all(sig[w > 0] >= minsigma - 1e-6)
+    assert np.all(sig[w > 0] <= prior_sigma + 1e-6)
+
+
+def test_adaptive_parzen_duplicate_obs_get_min_sigma():
+    # duplicates have zero neighbor gaps; their sigma must clip to MINsigma,
+    # not fall back to prior_sigma (else TPE can't concentrate on repeated
+    # good values of quantized params)
+    obs, mask = _obs([5.0, 5.0, 5.0, 5.0])
+    w, mu, sig = tpe.adaptive_parzen_normal(obs, mask, 1.0, 5.0, 10.0, 25)
+    w, mu, sig = map(np.asarray, (w, mu, sig))
+    minsigma = 10.0 / min(100.0, 1.0 + 5)
+    dup = (w > 0) & (np.abs(mu - 5.0) < 1e-6)
+    assert (sig[dup] <= minsigma + 1e-5).sum() >= 4
+
+
+def test_gmm1_sample_boundary_candidates_score_finite():
+    # tight component at the upper bound: inverse-CDF samples clamp just
+    # inside [low, high) so their lpdf stays finite (no NaN EI)
+    obs, mask = _obs([4.999, 4.9995, 4.9999])
+    w, mu, sig = tpe.adaptive_parzen_normal(obs, mask, 1.0, 2.5, 5.0, 25)
+    xs = tpe.gmm1_sample(jax.random.PRNGKey(0), w, mu, sig, 0.0, 5.0, None, 10_000)
+    lp = tpe.gmm1_lpdf(xs, w, mu, sig, 0.0, 5.0, None)
+    assert bool(jnp.all(jnp.isfinite(lp)))
+    assert float(jnp.max(xs)) < 5.0
+
+
+def test_adaptive_parzen_prior_keeps_prior_sigma():
+    obs, mask = _obs([0.001, 0.002, 0.003])
+    w, mu, sig = tpe.adaptive_parzen_normal(obs, mask, 1.0, 0.0, 5.0, 25)
+    mu, sig, w = map(np.asarray, (mu, sig, w))
+    # the component at the prior location keeps sigma = prior_sigma
+    prior_idx = np.argmin(np.abs(mu - 0.0) + (w <= 0) * 1e9)
+    assert sig[prior_idx] == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# GMM sample + lpdf
+# ---------------------------------------------------------------------------
+
+
+def _fit(values, prior_mu, prior_sigma, cap=64):
+    obs, mask = _obs(values, cap)
+    return tpe.adaptive_parzen_normal(obs, mask, 1.0, prior_mu, prior_sigma, 25)
+
+
+def test_gmm1_lpdf_integrates_to_one():
+    w, mu, sig = _fit([1.0, 2.0, 4.5, -1.0], 0.0, 6.0)
+    low, high = -5.0, 5.0
+    xs = jnp.linspace(low, high, 20001)
+    lp = tpe.gmm1_lpdf(xs, w, mu, sig, low, high, None)
+    integral = jnp.trapezoid(jnp.exp(lp), xs)
+    assert float(integral) == pytest.approx(1.0, abs=2e-3)
+
+
+def test_gmm1_lpdf_quantized_sums_to_one():
+    q = 0.5
+    low, high = 0.0, 10.0
+    w, mu, sig = _fit([2.0, 2.5, 7.0], 5.0, 10.0)
+    bins = jnp.arange(0.0, 10.0 + q / 2, q)
+    lp = tpe.gmm1_lpdf(bins, w, mu, sig, low, high, q)
+    total = jnp.sum(jnp.exp(lp))
+    assert float(total) == pytest.approx(1.0, abs=2e-3)
+
+
+def test_gmm1_sample_within_bounds_and_matches_lpdf():
+    w, mu, sig = _fit([1.0, 2.0, 4.5], 2.5, 5.0)
+    low, high = 0.0, 5.0
+    key = jax.random.PRNGKey(0)
+    xs = np.asarray(tpe.gmm1_sample(key, w, mu, sig, low, high, None, 200_000))
+    assert xs.min() >= low and xs.max() <= high
+    # compare empirical bin masses against lpdf-integrated masses
+    edges = np.linspace(low, high, 21)
+    emp, _ = np.histogram(xs, bins=edges, density=False)
+    emp = emp / emp.sum()
+    centers = (edges[:-1] + edges[1:]) / 2
+    lp = np.asarray(tpe.gmm1_lpdf(jnp.asarray(centers), w, mu, sig, low, high, None))
+    model = np.exp(lp)
+    model = model / model.sum()
+    assert np.max(np.abs(emp - model)) < 0.01
+
+
+def test_gmm1_sample_quantized_on_grid():
+    w, mu, sig = _fit([2.0, 3.0], 2.5, 5.0)
+    xs = np.asarray(
+        tpe.gmm1_sample(jax.random.PRNGKey(1), w, mu, sig, 0.0, 5.0, 0.5, 10_000)
+    )
+    np.testing.assert_allclose(xs, np.round(xs / 0.5) * 0.5, atol=1e-5)
+
+
+def test_lgmm1_lpdf_integrates_to_one():
+    # log-space bounds [-1, 2] -> value support [e^-1, e^2]
+    w, mu, sig = _fit(np.log([1.0, 2.0, 5.0]), 0.5, 3.0)
+    low, high = -1.0, 2.0
+    xs = jnp.linspace(np.exp(low) + 1e-4, np.exp(high) - 1e-4, 40001)
+    lp = tpe.lgmm1_lpdf(xs, w, mu, sig, low, high, None)
+    integral = jnp.trapezoid(jnp.exp(lp), xs)
+    assert float(integral) == pytest.approx(1.0, abs=5e-3)
+
+
+def test_lgmm1_sample_bounds_and_histogram():
+    w, mu, sig = _fit(np.log([1.0, 3.0]), 0.5, 3.0)
+    low, high = -1.0, 2.0
+    xs = np.asarray(
+        tpe.lgmm1_sample(jax.random.PRNGKey(2), w, mu, sig, low, high, None, 200_000)
+    )
+    assert xs.min() >= np.exp(low) - 1e-4
+    assert xs.max() <= np.exp(high) + 1e-4
+    edges = np.linspace(np.exp(low), np.exp(high), 21)
+    emp, _ = np.histogram(xs, bins=edges)
+    emp = emp / emp.sum()
+    centers = (edges[:-1] + edges[1:]) / 2
+    model = np.exp(np.asarray(tpe.lgmm1_lpdf(jnp.asarray(centers), w, mu, sig, low, high, None)))
+    model = model / model.sum()
+    assert np.max(np.abs(emp - model)) < 0.015
+
+
+def test_lgmm1_lpdf_quantized_includes_zero_bin():
+    w, mu, sig = _fit(np.log([1.0, 2.0]), 0.0, 2.0)
+    q = 1.0
+    bins = jnp.arange(0.0, 2000.0, q)  # heavy lognormal tail: go far out
+    lp = tpe.lgmm1_lpdf(bins, w, mu, sig, -jnp.inf, jnp.inf, q)
+    total = float(jnp.sum(jnp.exp(lp)))
+    assert total == pytest.approx(1.0, abs=5e-3)
+    # the zero bin [0, q/2) carries real mass and a finite lpdf
+    assert np.isfinite(float(lp[0]))
+
+
+# ---------------------------------------------------------------------------
+# categorical posterior
+# ---------------------------------------------------------------------------
+
+
+def test_categorical_posterior_prior_only():
+    obs, mask = _obs([])
+    p = jnp.asarray([0.2, 0.3, 0.5])
+    post = np.asarray(tpe.categorical_posterior(obs, mask, p, 1.0, 25))
+    np.testing.assert_allclose(post, [0.2, 0.3, 0.5], atol=1e-6)
+
+
+def test_categorical_posterior_counts_dominate():
+    obs, mask = _obs([1.0] * 50)
+    p = jnp.asarray([1 / 3, 1 / 3, 1 / 3])
+    post = np.asarray(tpe.categorical_posterior(obs, mask, p, 1.0, 100))
+    assert post[1] > 0.9
+    assert post.sum() == pytest.approx(1.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# below/above split
+# ---------------------------------------------------------------------------
+
+
+def test_split_below_above_counts():
+    cap = 64
+    losses = np.full(cap, np.inf, np.float32)
+    has = np.zeros(cap, bool)
+    N = 36
+    rng = np.random.default_rng(0)
+    losses[:N] = rng.normal(size=N)
+    has[:N] = True
+    below, above = tpe.split_below_above(
+        jnp.asarray(losses), jnp.asarray(has), 0.25, 25
+    )
+    below, above = np.asarray(below), np.asarray(above)
+    n_below = min(int(np.ceil(0.25 * np.sqrt(N))), 25)
+    assert below.sum() == n_below
+    assert above.sum() == N - n_below
+    # below trials are exactly the n_below smallest losses
+    assert losses[below].max() <= losses[above].min()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: TPE beats random within a fixed budget
+# ---------------------------------------------------------------------------
+
+
+def _best_loss(domain, algo, seed, max_evals):
+    t = Trials()
+    fmin(
+        domain.objective,
+        domain.space,
+        algo=algo,
+        max_evals=max_evals,
+        trials=t,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+    )
+    return min(l for l in t.losses() if l is not None)
+
+
+@pytest.mark.parametrize("name,budget", [("quadratic1", 60), ("branin", 75)])
+def test_tpe_beats_random(name, budget):
+    domain = ZOO[name]
+    seeds = range(4)
+    tpe_best = np.mean([_best_loss(domain, tpe.suggest, s, budget) for s in seeds])
+    rand_best = np.mean([_best_loss(domain, rand.suggest, s, budget) for s in seeds])
+    assert tpe_best <= rand_best * 1.05 + 1e-3, (tpe_best, rand_best)
+
+
+def test_tpe_reaches_branin_target():
+    domain = ZOO["branin"]
+    best = min(_best_loss(domain, tpe.suggest, s, 100) for s in range(3))
+    assert best < domain.loss_target
+
+
+def test_tpe_conditional_space_picks_good_branch():
+    space = hp.choice(
+        "c",
+        [
+            {"kind": "a", "x": hp.uniform("xa", -5, 5)},
+            {"kind": "b", "y": hp.uniform("yb", 5, 10)},
+        ],
+    )
+
+    def obj(d):
+        return (d["x"] - 2.0) ** 2 if d["kind"] == "a" else d["y"]
+
+    t = Trials()
+    fmin(obj, space, algo=tpe.suggest, max_evals=60, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    best = t.best_trial
+    assert best["result"]["loss"] < 1.0
+    assert best["misc"]["vals"]["c"] == [0]
+
+
+def test_tpe_partial_tuning_works():
+    import functools
+
+    domain = ZOO["quadratic1"]
+    algo = functools.partial(tpe.suggest, gamma=0.5, n_EI_candidates=64, n_startup_jobs=10)
+    loss = _best_loss(domain, algo, 0, 40)
+    assert loss < 1.0
+
+
+def test_tpe_many_dists_smoke():
+    domain = ZOO["many_dists"]
+    loss = _best_loss(domain, tpe.suggest, 0, 40)
+    assert np.isfinite(loss)
