@@ -1,0 +1,238 @@
+"""Benchmark: jitted TPE proposal throughput vs the NumPy reference path.
+
+Run by the driver on real TPU hardware with the ambient env.  Prints exactly
+ONE JSON line on stdout:
+
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+``vs_baseline`` is the speedup of the jitted candidate-proposal path over a
+faithful NumPy reimplementation of the reference hot loop
+(``hyperopt/tpe.py`` sym: adaptive_parzen_normal, GMM1 with
+rejection-resampling truncation, GMM1_lpdf, broadcast_best) on the same
+observation history.  BASELINE.md's north-star target is >=1000x.
+
+Supplementary measurements (Branin fmin wall-clock, per-config details) go
+to stderr as human-readable JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# NumPy reference-equivalent TPE hot path (the baseline being beaten).
+# Faithful to hyperopt/tpe.py's implementation strategy: python/numpy mix,
+# per-sample rejection-resampling loop for truncated GMM draws.
+# ---------------------------------------------------------------------------
+
+
+def np_linear_forgetting_weights(N, LF):
+    if N < LF:
+        return np.ones(N)
+    ramp = np.linspace(1.0 / N, 1.0, num=N - LF) if N - LF > 0 else np.zeros(0)
+    return np.concatenate([ramp, np.ones(LF)])
+
+
+def np_adaptive_parzen_normal(mus, prior_weight, prior_mu, prior_sigma, LF=25):
+    """hyperopt/tpe.py sym: adaptive_parzen_normal (numpy, variable length)."""
+    mus = np.asarray(mus, dtype=float)
+    order = np.argsort(mus)
+    prior_pos = int(np.searchsorted(mus[order], prior_mu))
+    srtd_mus = np.insert(mus[order], prior_pos, prior_mu)
+    m = len(srtd_mus)
+    sigma = np.zeros(m)
+    if m == 1:
+        sigma[:] = prior_sigma
+    else:
+        sigma[1:-1] = np.maximum(srtd_mus[1:-1] - srtd_mus[:-2],
+                                 srtd_mus[2:] - srtd_mus[1:-1])
+        sigma[0] = srtd_mus[1] - srtd_mus[0]
+        sigma[-1] = srtd_mus[-1] - srtd_mus[-2]
+    maxsigma = prior_sigma
+    minsigma = prior_sigma / min(100.0, 1.0 + m)
+    sigma = np.clip(sigma, minsigma, maxsigma)
+    sigma[prior_pos] = prior_sigma
+    weights = np_linear_forgetting_weights(len(mus), LF)[order]
+    weights = np.insert(weights, prior_pos, prior_weight)
+    weights = weights / weights.sum()
+    return weights, srtd_mus, sigma
+
+
+def np_gmm1(rng, weights, mus, sigmas, low, high, size):
+    """hyperopt/tpe.py sym: GMM1 — truncation by per-sample rejection."""
+    samples = []
+    while len(samples) < size:
+        active = np.argmax(rng.multinomial(1, weights))
+        draw = rng.normal(loc=mus[active], scale=sigmas[active])
+        if low <= draw < high:
+            samples.append(draw)
+    return np.asarray(samples)
+
+
+def np_normal_cdf(x, mu, sigma):
+    from scipy.special import erf
+
+    return 0.5 * (1.0 + erf((np.asarray(x)[..., None] - mu) / (np.sqrt(2) * sigma)))
+
+
+def np_gmm1_lpdf(x, weights, mus, sigmas, low, high):
+    """hyperopt/tpe.py sym: GMM1_lpdf."""
+    p_accept = np.sum(weights * (
+        0.5 * (1 + np.vectorize(math.erf)((high - mus) / (np.sqrt(2) * sigmas)))
+        - 0.5 * (1 + np.vectorize(math.erf)((low - mus) / (np.sqrt(2) * sigmas)))
+    ))
+    x = np.asarray(x)[:, None]
+    comp = (
+        np.log(weights)
+        - 0.5 * ((x - mus) / sigmas) ** 2
+        - np.log(sigmas)
+        - 0.5 * np.log(2 * np.pi)
+    )
+    mx = comp.max(axis=1, keepdims=True)
+    lpdf = mx[:, 0] + np.log(np.sum(np.exp(comp - mx), axis=1))
+    return lpdf - np.log(p_accept)
+
+
+def np_tpe_propose(rng, obs_below, obs_above, low, high, n_cand,
+                   prior_weight=1.0, LF=25):
+    """One reference-equivalent proposal for one hp.uniform parameter."""
+    prior_mu, prior_sigma = 0.5 * (low + high), high - low
+    wb, mb, sb = np_adaptive_parzen_normal(obs_below, prior_weight, prior_mu, prior_sigma, LF)
+    wa, ma, sa = np_adaptive_parzen_normal(obs_above, prior_weight, prior_mu, prior_sigma, LF)
+    samples = np_gmm1(rng, wb, mb, sb, low, high, n_cand)
+    ll_b = np_gmm1_lpdf(samples, wb, mb, sb, low, high)
+    ll_a = np_gmm1_lpdf(samples, wa, ma, sa, low, high)
+    return samples[np.argmax(ll_b - ll_a)]
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+
+def bench_numpy(n_obs=60, n_cand=24, repeats=20, seed=0):
+    rng = np.random.default_rng(seed)
+    losses = rng.normal(size=n_obs)
+    vals = rng.uniform(-5, 5, size=n_obs)
+    n_below = min(int(np.ceil(0.25 * np.sqrt(n_obs))), 25)
+    order = np.argsort(losses)
+    obs_below = vals[order[:n_below]]
+    obs_above = vals[order[n_below:]]
+    # warmup
+    np_tpe_propose(rng, obs_below, obs_above, -5.0, 5.0, n_cand)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        np_tpe_propose(rng, obs_below, obs_above, -5.0, 5.0, n_cand)
+    dt = (time.perf_counter() - t0) / repeats
+    return {"proposals_per_sec": 1.0 / dt, "candidates_per_sec": n_cand / dt,
+            "n_obs": n_obs, "n_cand": n_cand, "sec_per_proposal": dt}
+
+
+def bench_jax(n_obs=60, n_cand=8192, repeats=50, seed=0, n_params=1, batch=None):
+    """Measure the jitted proposal path.
+
+    ``batch``: propose for this many trial ids per dispatch (vmap over keys) —
+    the framework's parallel-suggest design point (BASELINE config #5: 10k
+    parallel trials).  ``None`` = single proposal per dispatch, the
+    reference-shaped workload.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.spaces import compile_space
+    from hyperopt_tpu.algos import tpe
+
+    if n_params == 1:
+        space = {"x": hp.uniform("x", -5, 5)}
+    else:
+        space = {f"x{i}": hp.uniform(f"x{i}", -5, 5) for i in range(n_params)}
+    cs = compile_space(space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": n_cand, "gamma": 0.25, "LF": 25}
+    propose_one = tpe.build_propose(cs, cfg)
+    if batch:
+        propose = jax.jit(jax.vmap(propose_one, in_axes=(None, 0)))
+    else:
+        propose = jax.jit(propose_one)
+
+    cap = 64
+    while cap < n_obs:
+        cap *= 2
+    rng = np.random.default_rng(seed)
+    losses = np.full(cap, np.inf, np.float32)
+    has = np.zeros(cap, bool)
+    losses[:n_obs] = rng.normal(size=n_obs)
+    has[:n_obs] = True
+    hist = {
+        "losses": jnp.asarray(losses),
+        "has_loss": jnp.asarray(has),
+        "vals": {l: jnp.asarray(
+            np.where(has, rng.uniform(-5, 5, size=cap), 0).astype(np.float32))
+            for l in cs.labels},
+        "active": {l: jnp.asarray(has) for l in cs.labels},
+    }
+    key = jax.random.PRNGKey(0)
+    if batch:
+        key = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i))(
+            jnp.arange(batch, dtype=jnp.uint32))
+    out = propose(hist, key)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(repeats):
+        k = jax.random.fold_in(jax.random.PRNGKey(0), i)
+        out = propose(hist, jax.vmap(
+            lambda j: jax.random.fold_in(k, j))(jnp.arange(batch, dtype=jnp.uint32))
+            if batch else k)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / repeats
+    eff = n_cand * n_params * (batch or 1)
+    return {"proposals_per_sec": (batch or 1) / dt,
+            "candidates_per_sec": eff / dt,
+            "n_obs": n_obs, "n_cand": n_cand, "n_params": n_params,
+            "batch": batch or 1, "sec_per_dispatch": dt,
+            "backend": jax.devices()[0].platform}
+
+
+def bench_branin_fmin(max_evals=100, seed=0):
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    t0 = time.perf_counter()
+    trials = Trials()
+    fmin(dom.objective, dom.space, algo=tpe.suggest, max_evals=max_evals,
+         trials=trials, rstate=np.random.default_rng(seed), show_progressbar=False)
+    dt = time.perf_counter() - t0
+    best = min(l for l in trials.losses() if l is not None)
+    return {"wall_clock_sec": dt, "best_loss": best, "max_evals": max_evals}
+
+
+def main():
+    detail = {}
+    detail["numpy_cpu"] = bench_numpy()
+    detail["jax_same_grid"] = bench_jax(n_cand=24)
+    detail["jax_scaled"] = bench_jax(n_cand=8192)
+    detail["jax_batched"] = bench_jax(n_cand=8192, batch=64, repeats=20)
+    detail["branin_fmin_tpe"] = bench_branin_fmin()
+    print(json.dumps(detail, indent=2, default=float), file=sys.stderr)
+
+    speedup = (
+        detail["jax_batched"]["candidates_per_sec"]
+        / detail["numpy_cpu"]["candidates_per_sec"]
+    )
+    print(json.dumps({
+        "metric": "tpe_candidate_proposal_throughput",
+        "value": round(detail["jax_batched"]["candidates_per_sec"], 1),
+        "unit": "candidates/sec",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -141,7 +141,7 @@ def adaptive_parzen_normal(obs, obs_mask, prior_weight, prior_mu, prior_sigma, L
     m_obs = jnp.sum(obs_mask)          # live observations
     m = m_obs + 1                      # live components incl. prior
 
-    lfw = linear_forgetting_weights(obs_mask, LF) * obs_mask
+    lfw = linear_forgetting_weights(obs_mask, LF)  # already masked
     big = jnp.float32(jnp.finfo(jnp.float32).max)
     vals_c = jnp.concatenate([jnp.where(obs_mask, obs, big), jnp.array([prior_mu])])
     wts_c = jnp.concatenate([lfw, jnp.array([jnp.float32(prior_weight)])])
@@ -393,7 +393,9 @@ def _prior_probs(dist: Dist) -> np.ndarray:
 
 def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
     """Sample candidates from the below model, score EI = llik_below −
-    llik_above, return the argmax candidate (tpe.py sym: broadcast_best)."""
+    llik_above, return ``(argmax candidate, its EI)`` (tpe.py sym:
+    broadcast_best).  The EI score is what cross-shard argmax reductions
+    consume (parallel/sharding.py)."""
     prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(dist)
     obs = vals
     if log_space:
@@ -418,7 +420,8 @@ def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
         ll_a = gmm1_lpdf(samples, wa, ma, sa, low, high, q)
     ei = ll_b - ll_a
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)  # -inf − -inf must never win
-    return samples[jnp.argmax(ei)]
+    i = jnp.argmax(ei)
+    return samples[i], ei[i]
 
 
 def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
@@ -432,7 +435,9 @@ def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
     n_cand = cfg["n_EI_candidates"]
     samples = jax.random.categorical(key, jnp.log(pb), shape=(n_cand,))
     ei = jnp.log(pb[samples]) - jnp.log(pa[samples])
-    return samples[jnp.argmax(ei)] + offset
+    ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
+    i = jnp.argmax(ei)
+    return samples[i] + offset, ei[i]
 
 
 def build_propose(cs, cfg):
@@ -458,9 +463,9 @@ def build_propose(cs, cfg):
             b = below & active
             a = above & active
             if info.dist.family in ("categorical", "randint"):
-                out[label] = _propose_discrete(k, info.dist, vals, b, a, cfg)
+                out[label], _ = _propose_discrete(k, info.dist, vals, b, a, cfg)
             else:
-                out[label] = _propose_numeric(k, info.dist, vals, b, a, cfg)
+                out[label], _ = _propose_numeric(k, info.dist, vals, b, a, cfg)
         return out
 
     return propose

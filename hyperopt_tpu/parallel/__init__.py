@@ -1,0 +1,17 @@
+"""Parallel / distributed execution: mesh sharding and async trial evaluation.
+
+The reference's parallelism is embarrassingly-parallel trial evaluation over
+MongoDB workers or Spark executors (``hyperopt/mongoexp.py`` sym: MongoTrials,
+``hyperopt/spark.py`` sym: SparkTrials).  The TPU-native equivalents
+(SURVEY.md §2.2):
+
+* ``sharding`` — the two scaling axes of HPO, sharded over a
+  ``jax.sharding.Mesh``: the **trial batch** (data-parallel ``vmap`` over new
+  ids, one shard per device) and the **candidate axis** (``shard_map`` over
+  ``n_EI_candidates`` with an all-gather EI argmax — the sequence-parallel
+  analog).
+* ``executor`` (planned next) — host-side async trial evaluation behind the
+  reference's ``Trials.asynchronous`` protocol.
+"""
+
+from . import sharding  # noqa: F401

@@ -1,0 +1,141 @@
+"""Mesh sharding for the TPE proposal kernels.
+
+Two axes scale in an HPO workload (SURVEY.md §5 "long-context" row):
+
+* the **trial batch** — how many new trials are proposed per step
+  (the reference serializes these; MongoTrials/SparkTrials parallelize only
+  the *evaluation*), and
+* the **candidate axis** — ``n_EI_candidates`` posterior draws per proposal
+  (fixed at 24 in the reference).
+
+``suggest_batch_sharded`` shards the first over a mesh axis (pure data
+parallelism: per-trial RNG keys are split across devices, history is
+replicated, no cross-device traffic).  ``propose_sharded_candidates`` shards
+the second with ``jax.shard_map``: each device draws and EI-scores a local
+candidate slice, then an ``all_gather`` of per-device (best EI, best value)
+pairs resolves the global argmax — collectives ride ICI, the dense analog of
+a sequence-parallel reduction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..algos import tpe
+from ..spaces import label_hash
+
+__all__ = [
+    "make_mesh",
+    "suggest_batch_sharded",
+    "propose_sharded_candidates",
+    "replicate_history",
+]
+
+TRIALS_AXIS = "trials"
+CAND_AXIS = "cand"
+
+
+def make_mesh(n_devices=None, n_cand_shards=1):
+    """A ``(trials, cand)`` mesh over the first ``n_devices`` devices.
+
+    ``n_cand_shards`` devices along the candidate axis, the rest along the
+    trial-batch axis.  With the defaults this is a pure data-parallel mesh.
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if n % n_cand_shards:
+        raise ValueError(f"{n} devices not divisible by n_cand_shards={n_cand_shards}")
+    arr = np.array(devs[:n]).reshape(n // n_cand_shards, n_cand_shards)
+    return Mesh(arr, (TRIALS_AXIS, CAND_AXIS))
+
+
+def replicate_history(history, mesh):
+    """Place the padded-history pytree fully replicated on the mesh."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), history)
+
+
+def suggest_batch_sharded(cs, cfg, mesh):
+    """Data-parallel batched proposal: keys sharded over every mesh device,
+    history replicated.  Returns ``fn(history, keys) -> {label: [batch]}``.
+
+    Mathematically identical to the unsharded ``vmap`` (each proposal is
+    independent), so results match a single-device run bitwise — the dryrun
+    asserts exactly that.
+    """
+    propose = jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0))
+    key_sharding = NamedSharding(mesh, P((TRIALS_AXIS, CAND_AXIS)))
+    rep = NamedSharding(mesh, P())
+    hist_shardings = jax.tree.map(lambda _: rep, {
+        "losses": 0, "has_loss": 0,
+        "vals": {l: 0 for l in cs.labels},
+        "active": {l: 0 for l in cs.labels},
+    })
+    out_sharding = {l: key_sharding for l in cs.labels}
+    return jax.jit(
+        propose,
+        in_shardings=(hist_shardings, key_sharding),
+        out_shardings=out_sharding,
+    )
+
+
+def propose_sharded_candidates(cs, cfg, mesh):
+    """One proposal with the candidate axis sharded over ``mesh``'s ``cand``
+    axis via ``shard_map``.
+
+    Each device fits the same below/above Parzen models (history replicated),
+    draws ``n_EI_candidates / n_shards`` candidates with a device-folded key,
+    EI-scores them locally, and contributes its (best EI, best value) to an
+    ``all_gather``; the global argmax picks the winner.  Scales
+    ``n_EI_candidates`` past single-chip memory/latency limits (the
+    sequence-parallel analog for HPO: SURVEY.md §2.2 last row).
+    """
+    n_shards = mesh.shape[CAND_AXIS]
+    n_cand = cfg["n_EI_candidates"]
+    if n_cand % n_shards:
+        raise ValueError(f"n_EI_candidates={n_cand} not divisible by {n_shards} shards")
+    local_cfg = dict(cfg, n_EI_candidates=n_cand // n_shards)
+
+    def local_best(history, key):
+        """Per-device: local candidates + local EI max (runs inside shard_map)."""
+        shard = jax.lax.axis_index(CAND_AXIS)
+        key = jax.random.fold_in(key, shard)
+        losses = jnp.asarray(history["losses"])
+        has_loss = jnp.asarray(history["has_loss"])
+        below, above = tpe.split_below_above(
+            losses, has_loss, local_cfg["gamma"], local_cfg["LF"]
+        )
+        best_ei = {}
+        best_val = {}
+        for label in cs.labels:
+            info = cs.params[label]
+            vals = jnp.asarray(history["vals"][label])
+            active = jnp.asarray(history["active"][label])
+            k = jax.random.fold_in(key, label_hash(label))
+            b = below & active
+            a = above & active
+            if info.dist.family in ("categorical", "randint"):
+                val, ei = tpe._propose_discrete(k, info.dist, vals, b, a, local_cfg)
+            else:
+                val, ei = tpe._propose_numeric(k, info.dist, vals, b, a, local_cfg)
+            best_ei[label] = ei[None]
+            best_val[label] = val[None]
+        return best_ei, best_val
+
+    def propose(history, key):
+        ei_g, val_g = jax.shard_map(
+            local_best,
+            mesh=mesh,
+            in_specs=(P(), P()),
+            out_specs=(P(CAND_AXIS), P(CAND_AXIS)),
+        )(history, key)
+        # ei_g/val_g: [n_shards] per label; global argmax over shards
+        return {
+            l: val_g[l][jnp.argmax(ei_g[l])] for l in cs.labels
+        }
+
+    return jax.jit(propose)

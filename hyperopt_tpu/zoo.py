@@ -90,10 +90,10 @@ def _q1_lognormal():
     return DomainZoo(
         name="q1_lognormal",
         space={"x": hp.qlognormal("x", 0.0, 2.0, 1.0)},
-        objective=lambda d: max(-(d["x"] ** 2), -100.0) if not isinstance(d["x"], jnp.ndarray)
-        else jnp.maximum(-(d["x"] ** 2), -100.0),
+        objective=lambda d: jnp.maximum(-(d["x"] ** 2), -100.0),
         loss_target=-9.0,
         optimum=-100.0,
+        traceable=True,
     )
 
 
@@ -155,10 +155,14 @@ def _gauss_wave2():
 
 
 def _branin_domain():
+    # pure-jnp objective: returns a 0-d jax array, which Domain.evaluate
+    # accepts on host and which traces under jit/vmap/lax.scan (the
+    # batched-eval and on-device fmin paths rely on `traceable=True` being
+    # literally true)
     return DomainZoo(
         name="branin",
         space={"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)},
-        objective=lambda d: float(branin(d["x"], d["y"])),
+        objective=lambda d: branin(d["x"], d["y"]),
         loss_target=0.9,
         optimum=0.397887,
         traceable=True,
@@ -169,7 +173,7 @@ def _hartmann6_domain():
     return DomainZoo(
         name="hartmann6",
         space={f"x{i}": hp.uniform(f"x{i}", 0, 1) for i in range(6)},
-        objective=lambda d: float(hartmann6([d[f"x{i}"] for i in range(6)])),
+        objective=lambda d: hartmann6(jnp.stack([d[f"x{i}"] for i in range(6)])),
         loss_target=-2.0,
         optimum=-3.32237,
         traceable=True,
@@ -180,7 +184,7 @@ def _rosenbrock4():
     return DomainZoo(
         name="rosenbrock4",
         space={f"x{i}": hp.uniform(f"x{i}", -2, 2) for i in range(4)},
-        objective=lambda d: float(rosenbrock([d[f"x{i}"] for i in range(4)])),
+        objective=lambda d: rosenbrock(jnp.stack([d[f"x{i}"] for i in range(4)])),
         loss_target=30.0,
         optimum=0.0,
         traceable=True,

@@ -2,21 +2,34 @@
 
 Mirrors the reference's doctrine of testing "distributed" as multi-process on
 one host (SURVEY.md §4): here, multi-chip sharding is tested on
-``--xla_force_host_platform_device_count=8`` CPU devices.  Must run before the
-first ``import jax`` in any test module.
+``--xla_force_host_platform_device_count=8`` CPU devices.
+
+The ambient environment registers an 'axon' TPU-tunnel PJRT plugin via
+sitecustomize at interpreter start, so by conftest time ``jax`` may already
+be imported with ``JAX_PLATFORMS=axon`` captured.  Env vars alone are too
+late; ``jax.config.update`` still wins as long as no backend has been
+initialized — which is guaranteed here because conftest runs before any test
+imports.  The suite must be hermetic and fast, and must never contend for
+the one real TPU chip.
 """
 
 import os
 
-# Force CPU even when the ambient environment points JAX at a TPU tunnel
-# (JAX_PLATFORMS=axon): the test suite must be hermetic and fast.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", (
+    "test suite must run on CPU, got " + jax.devices()[0].platform
+)
 
 
 @pytest.fixture

@@ -35,6 +35,26 @@ def test_tpe_hits_loss_target(name):
     assert best < domain.loss_target
 
 
+@pytest.mark.parametrize(
+    "name", [n for n, d in sorted(ZOO.items()) if d.traceable]
+)
+def test_traceable_domains_actually_trace(name):
+    # `traceable=True` must literally mean the objective jits and vmaps over
+    # flat label dicts (the batched-eval / on-device fmin contract)
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.spaces import compile_space
+
+    domain = ZOO[name]
+    cs = compile_space(domain.space)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    flats = jax.vmap(cs.sample_flat)(keys)
+    out = jax.jit(jax.vmap(lambda f: domain.objective(cs.assemble(f, traced=True))))(flats)
+    assert out.shape == (4,)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
 def test_branin_value():
     # known optima of Branin-Hoo
     assert float(branin(-np.pi, 12.275)) == pytest.approx(0.397887, abs=1e-4)

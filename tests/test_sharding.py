@@ -1,0 +1,114 @@
+"""Multi-device sharding tests on the 8-device CPU mesh from conftest.
+
+Mirrors the reference doctrine of testing "distributed" as multi-process on
+one host (SURVEY.md §4): here multi-chip is 8 virtual CPU devices.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.parallel import sharding
+from hyperopt_tpu.spaces import compile_space
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -4, 0),
+    "k": hp.randint("k", 4),
+}
+CFG = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25, "LF": 25}
+
+
+def _history(cs, n=30, cap=64, seed=0):
+    rng = np.random.default_rng(seed)
+    losses = np.full(cap, np.inf, np.float32)
+    has = np.zeros(cap, bool)
+    losses[:n] = rng.normal(size=n)
+    has[:n] = True
+    vals = {}
+    for label in cs.labels:
+        fam = cs.params[label].dist.family
+        if fam == "randint":
+            v = rng.integers(0, 4, size=cap)
+        elif fam == "loguniform":
+            v = np.exp(rng.uniform(-4, 0, size=cap))
+        else:
+            v = rng.uniform(-5, 5, size=cap)
+        vals[label] = jnp.asarray(np.where(has, v, 0).astype(np.float32))
+    return {
+        "losses": jnp.asarray(losses),
+        "has_loss": jnp.asarray(has),
+        "vals": vals,
+        "active": {l: jnp.asarray(has) for l in cs.labels},
+    }
+
+
+def test_make_mesh_shapes():
+    mesh = sharding.make_mesh(8, n_cand_shards=2)
+    assert dict(mesh.shape) == {"trials": 4, "cand": 2}
+    with pytest.raises(ValueError):
+        sharding.make_mesh(8, n_cand_shards=3)
+
+
+def test_suggest_batch_sharded_matches_single_device():
+    cs = compile_space(SPACE)
+    hist = _history(cs)
+    mesh = sharding.make_mesh(8)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i))(
+        jnp.arange(16, dtype=jnp.uint32)
+    )
+    hist_dev = sharding.replicate_history(hist, mesh)
+    out_sharded = sharding.suggest_batch_sharded(cs, CFG, mesh)(hist_dev, keys)
+    out_plain = jax.jit(jax.vmap(tpe.build_propose(cs, CFG), in_axes=(None, 0)))(
+        hist, keys
+    )
+    for label in cs.labels:
+        np.testing.assert_allclose(
+            np.asarray(out_sharded[label]), np.asarray(out_plain[label]),
+            rtol=1e-5, atol=1e-5,
+        )
+    # the batch really is laid out across all 8 devices
+    assert len(out_sharded["x"].sharding.device_set) == 8
+
+
+def test_propose_sharded_candidates_valid_and_deterministic():
+    cs = compile_space(SPACE)
+    hist = _history(cs)
+    mesh = sharding.make_mesh(8, n_cand_shards=2)
+    hist_dev = sharding.replicate_history(hist, mesh)
+    fn = sharding.propose_sharded_candidates(cs, CFG, mesh)
+    out1 = jax.tree.map(np.asarray, fn(hist_dev, jax.random.PRNGKey(1)))
+    out2 = jax.tree.map(np.asarray, fn(hist_dev, jax.random.PRNGKey(1)))
+    for label in cs.labels:
+        np.testing.assert_array_equal(out1[label], out2[label])
+    assert -5 <= out1["x"] <= 5
+    assert np.exp(-4) - 1e-5 <= out1["lr"] <= 1 + 1e-5
+    assert out1["k"] in range(4)
+
+
+def test_propose_sharded_candidates_rejects_indivisible():
+    cs = compile_space(SPACE)
+    mesh = sharding.make_mesh(8, n_cand_shards=2)
+    with pytest.raises(ValueError):
+        sharding.propose_sharded_candidates(
+            cs, dict(CFG, n_EI_candidates=63), mesh
+        )
+
+
+def test_graft_entry_single_chip_and_multichip():
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert set(out) == set(compile_space(__graft_entry__._flagship_space()).labels)
+    __graft_entry__.dryrun_multichip(8)
