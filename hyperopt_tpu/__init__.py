@@ -45,6 +45,14 @@ from .exceptions import (
 from .fmin import FMinIter, fmin, fmin_pass_expr_memo_ctrl, generate_trials_to_calculate
 from .spaces import space_eval
 
+# device_fmin needs algos.tpe; keep the partial-checkout guard intact (the
+# name is simply absent — not None — when tpe.py is missing)
+try:
+    from .device_fmin import fmin_device
+except ModuleNotFoundError as _e:  # pragma: no cover
+    if _e.name != "hyperopt_tpu.algos.tpe":
+        raise
+
 # Algo modules that may land incrementally are re-exported only when present,
 # so `from hyperopt_tpu import anneal` fails at the import site (ImportError)
 # rather than binding None and failing later at `anneal.suggest`.
@@ -93,4 +101,4 @@ __all__ = [
     "InvalidResultStatus",
     "InvalidTrial",
     "__version__",
-] + _optional_algos
+] + _optional_algos + (["fmin_device"] if "fmin_device" in globals() else [])

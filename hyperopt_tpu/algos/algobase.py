@@ -1,0 +1,79 @@
+"""Shared scaffolding for suggest algorithms.
+
+Parity target: ``hyperopt/algobase.py`` (sym: SuggestAlgo, ExprEvaluator).
+The reference's ``SuggestAlgo`` walks the vectorized pyll graph with
+per-node-type dispatch; in the compiled-space design there is no graph to
+walk — the static ``ParamInfo`` table plays that role — so the base class
+here owns the *runtime* plumbing shared by suggesters instead: padded
+history retrieval, per-id RNG key folding, jit caching per config, and
+emission of reference-shaped trial documents.
+
+A suggester subclasses ``SuggestAlgo``, implements ``build(cs, cfg)``
+returning a pure ``propose(history, key) -> {label: value}``, and gains a
+reference-compatible ``__call__(new_ids, domain, trials, seed)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import rand
+
+__all__ = ["SuggestAlgo"]
+
+
+class SuggestAlgo:
+    """Base class turning a jitted per-proposal kernel into a
+    ``suggest(new_ids, domain, trials, seed)`` plugin."""
+
+    #: subclasses: number of observed trials below which we delegate to rand
+    n_startup_jobs = 0
+
+    def __init__(self, **cfg):
+        self.cfg = cfg
+
+    # -- to be provided by subclasses -------------------------------------
+
+    def build(self, cs, cfg):
+        """Return ``propose(history, key) -> {label: value}`` (pure, jittable)."""
+        raise NotImplementedError
+
+    # -- shared runtime ----------------------------------------------------
+
+    def _get_jit(self, domain, cfg):
+        cache_attr = f"_algo_cache_{type(self).__name__}"
+        cache = getattr(domain, cache_attr, None)
+        if cache is None:
+            cache = {}
+            setattr(domain, cache_attr, cache)
+        key = tuple(sorted(cfg.items()))
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(jax.vmap(self.build(domain.cs, cfg), in_axes=(None, 0)))
+            cache[key] = fn
+        return fn
+
+    def __call__(self, new_ids, domain, trials, seed, **overrides):
+        cfg = dict(self.cfg, **overrides)
+        n_startup = cfg.pop("n_startup_jobs", self.n_startup_jobs)
+        if len(trials.trials) < n_startup:
+            return rand.suggest(new_ids, domain, trials, seed)
+        history = trials.padded_history(domain.cs.labels)
+        hist_arrays = {
+            "losses": history["losses"],
+            "has_loss": history["has_loss"],
+            "vals": history["vals"],
+            "active": history["active"],
+        }
+        propose = self._get_jit(domain, cfg)
+        base_key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+            jnp.asarray([int(i) & 0x7FFFFFFF for i in new_ids], jnp.uint32)
+        )
+        batch = propose(hist_arrays, keys)
+        host = {k: np.asarray(v) for k, v in batch.items()}
+        flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
+        return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

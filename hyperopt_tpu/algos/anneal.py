@@ -1,0 +1,129 @@
+"""Simulated-annealing-flavored suggester.
+
+Parity target: ``hyperopt/anneal.py`` (sym: AnnealSuggest, suggest;
+defaults ``avg_best_idx=2.0``, ``shrink_coef=0.1``).
+
+Semantics preserved from the reference:
+
+* Each proposal anchors on a previously observed **good** trial: per
+  hyperparameter, trials where that parameter was active and a loss was
+  recorded are ranked by loss, and the anchor rank is drawn geometrically
+  with mean ``avg_best_idx`` (so rank 0 — the best — is most likely).
+* The prior distribution is then **shrunk** around the anchor value by
+  ``s(T) = 1 / (1 + T * shrink_coef)`` where ``T`` is the number of active
+  observations: uniform-family widths scale by ``s``, normal-family sigmas
+  scale by ``s``, and discrete posteriors mix ``(1-s)·onehot(anchor) +
+  s·prior``.  With no observations ``s = 1`` and the proposal is a prior
+  draw.
+
+TPU-first: the whole proposal — per-label ranking, geometric anchor draw,
+shrunk-distribution sampling for every family — is one jitted function of
+the padded history arrays, vmapped over new ids (same harness as TPE via
+``algobase.SuggestAlgo``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..spaces import label_hash
+from .algobase import SuggestAlgo
+from .tpe import EPS, _parzen_from, _prior_probs
+
+__all__ = ["AnnealSuggest", "suggest"]
+
+_default_avg_best_idx = 2.0
+_default_shrink_coef = 0.1
+
+
+def _geometric_rank(key, u_mean, n):
+    """Rank ~ Geometric with mean ``u_mean``, clipped to [0, n-1]."""
+    # P(rank >= r) = (1 - p)^r with p = 1/u_mean
+    p = 1.0 / u_mean
+    u = jax.random.uniform(key, minval=EPS, maxval=1.0)
+    r = jnp.floor(jnp.log(u) / math.log(1.0 - p + 1e-12)).astype(jnp.int32)
+    return jnp.clip(r, 0, jnp.maximum(n - 1, 0))
+
+
+def _anchor(key, vals, obs_mask, losses, avg_best_idx):
+    """(anchor value, T) — value of the geometrically-ranked best active
+    trial; arbitrary (weight-irrelevant) when T == 0."""
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
+    masked = jnp.where(obs_mask, losses, big)
+    order = jnp.argsort(masked)
+    T = jnp.sum(obs_mask.astype(jnp.int32))
+    r = _geometric_rank(key, avg_best_idx, T)
+    return vals[order[r]], T
+
+
+def _shrink(T, shrink_coef):
+    return 1.0 / (1.0 + T.astype(jnp.float32) * shrink_coef)
+
+
+class AnnealSuggest(SuggestAlgo):
+    """hyperopt/anneal.py sym: AnnealSuggest."""
+
+    def __init__(self, avg_best_idx=_default_avg_best_idx,
+                 shrink_coef=_default_shrink_coef):
+        super().__init__(avg_best_idx=float(avg_best_idx),
+                         shrink_coef=float(shrink_coef))
+
+    def build(self, cs, cfg):
+        avg_best_idx = cfg["avg_best_idx"]
+        shrink_coef = cfg["shrink_coef"]
+
+        def propose_label(key, info, vals, obs_mask, losses):
+            fam = info.dist.family
+            k_anchor, k_draw = jax.random.split(key)
+
+            if fam in ("categorical", "randint"):
+                prior_p = jnp.asarray(_prior_probs(info.dist))
+                offset = int(info.dist.params[0]) if fam == "randint" else 0
+                a, T = _anchor(k_anchor, vals.astype(jnp.int32) - offset,
+                               obs_mask, losses, avg_best_idx)
+                s = _shrink(T, shrink_coef)
+                onehot = jax.nn.one_hot(a, prior_p.shape[0], dtype=jnp.float32)
+                p = (1.0 - s) * onehot + s * prior_p
+                return jax.random.categorical(k_draw, jnp.log(p)) + offset
+
+            prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(info.dist)
+            obs = jnp.log(jnp.maximum(vals, EPS)) if log_space else vals
+            a, T = _anchor(k_anchor, obs, obs_mask, losses, avg_best_idx)
+            s = _shrink(T, shrink_coef)
+            a = jnp.where(T > 0, a, prior_mu)
+
+            if math.isfinite(low) and math.isfinite(high):
+                # uniform family: width (high-low)*s centered on the anchor,
+                # slid (not clipped) to stay inside [low, high] so the
+                # proposal density stays uniform over a full-width window
+                width = (high - low) * s
+                lo = jnp.clip(a - width / 2, low, high - width)
+                x = jax.random.uniform(k_draw, minval=0.0, maxval=1.0) * width + lo
+            else:
+                # normal family: sigma shrinks by s
+                x = a + prior_sigma * s * jax.random.normal(k_draw)
+            if log_space:
+                x = jnp.exp(x)
+            if q is not None:
+                x = jnp.round(x / q) * q
+            return x
+
+        def propose(history, key):
+            losses = jnp.asarray(history["losses"])
+            has_loss = jnp.asarray(history["has_loss"])
+            out = {}
+            for label in cs.labels:
+                info = cs.params[label]
+                vals = jnp.asarray(history["vals"][label])
+                active = jnp.asarray(history["active"][label])
+                k = jax.random.fold_in(key, label_hash(label))
+                out[label] = propose_label(k, info, vals, active & has_loss, losses)
+            return out
+
+        return propose
+
+
+suggest = AnnealSuggest()

@@ -1,0 +1,27 @@
+"""Mixture-of-suggesters.
+
+Parity target: ``hyperopt/mix.py`` (sym: suggest): per new id, draw one
+sub-suggester from a categorical over ``p_suggest = [(p, suggest_fn), ...]``
+and delegate.  Used e.g. to blend random exploration into TPE.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["suggest"]
+
+
+def suggest(new_ids, domain, trials, seed, p_suggest):
+    """``p_suggest``: list of ``(probability, suggest_fn)`` pairs summing
+    to 1 (hyperopt/mix.py sym: suggest)."""
+    ps = np.asarray([p for p, _ in p_suggest], dtype=float)
+    if not np.isclose(ps.sum(), 1.0, atol=1e-6):
+        raise ValueError(f"p_suggest probabilities sum to {ps.sum()}, expected 1")
+    rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
+    docs = []
+    for new_id in new_ids:
+        idx = int(rng.choice(len(ps), p=ps))
+        _, sub = p_suggest[idx]
+        docs.extend(sub([new_id], domain, trials, int(rng.integers(2**31 - 1))))
+    return docs

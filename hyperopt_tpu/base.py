@@ -492,6 +492,11 @@ class Trials:
         docs = self._dynamic_trials
         while self._history_synced < len(docs):
             doc = docs[self._history_synced]
+            if doc["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+                # in-flight (async backend): stop at the first unsettled doc
+                # so it is revisited once it completes — advancing past it
+                # would drop the trial from the posterior forever
+                break
             self._history_synced += 1
             if doc["state"] != JOB_STATE_DONE:
                 continue

@@ -1,0 +1,200 @@
+"""Fully on-device optimization loop for JAX-traceable objectives.
+
+The reference's ``fmin`` (hyperopt/fmin.py sym: FMinIter.run) is a host loop:
+every trial pays a suggest→evaluate→record round-trip through Python.  When
+the objective itself is jnp math, the entire ask→tell loop — TPE posterior
+fit, candidate sampling, EI argmax, objective evaluation, history update —
+can run as ONE ``lax.scan`` program on the accelerator, with zero host
+round-trips.  This module has no reference analog; it is the design point
+BASELINE.md's sub-second-Branin target asks for (SURVEY.md §7.1 row "one
+suggestion per call").
+
+The loop state is the same padded SoA history the host ``Trials`` keeps
+(vals/active per label, losses, has_loss), at a fixed capacity of
+``max_evals``, so every step is shape-stable and the whole run compiles
+once.  Startup trials draw from the prior (rand analog); later steps run the
+jitted TPE proposal under ``lax.cond``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .algos import tpe
+from .base import JOB_STATE_DONE, STATUS_OK, Trials
+from .spaces import compile_space, draw_dist, label_hash
+
+__all__ = ["fmin_device"]
+
+# compiled-run cache: (space expr, objective, capacity, cfg) -> jitted run.
+# Expr trees are frozen dataclasses (hashable); objectives hash by identity.
+# LRU-bounded: each entry pins the user's closure AND a compiled XLA program,
+# so an unbounded dict would leak memory across sweeps of per-call lambdas.
+_RUN_CACHE_MAX = 16
+_RUN_CACHE: "dict" = {}
+
+
+def _cache_get(key):
+    fn = _RUN_CACHE.pop(key, None)
+    if fn is not None:
+        _RUN_CACHE[key] = fn  # re-insert: most-recently-used at the end
+    return fn
+
+
+def _cache_put(key, fn):
+    while len(_RUN_CACHE) >= _RUN_CACHE_MAX:
+        _RUN_CACHE.pop(next(iter(_RUN_CACHE)))  # evict least-recently-used
+    _RUN_CACHE[key] = fn
+
+
+def _build_step(cs, fn, cap, cfg, n_startup):
+    """One ask→tell step: carry = (vals, active, losses, has_loss, key)."""
+    propose = tpe.build_propose(cs, cfg)
+    int_labels = {
+        l for l, info in cs.params.items()
+        if info.dist.family in ("categorical", "randint")
+    }
+
+    def rand_flat(key):
+        out = {}
+        for label, info in cs.params.items():
+            k = jax.random.fold_in(key, label_hash(label))
+            out[label] = draw_dist(info.dist, k).astype(jnp.float32)
+        return out
+
+    def tpe_flat(history, key):
+        out = propose(history, key)
+        return {l: v.astype(jnp.float32) for l, v in out.items()}
+
+    def typed(flat):
+        """Per-label values with evaluation dtypes (discrete → i32)."""
+        return {
+            l: jnp.round(v).astype(jnp.int32) if l in int_labels else v
+            for l, v in flat.items()
+        }
+
+    def step(carry, i):
+        vals, active, losses, has_loss, key = carry
+        key, k_prop = jax.random.split(key)
+        history = {"losses": losses, "has_loss": has_loss,
+                   "vals": vals, "active": active}
+        flat = jax.lax.cond(
+            i < n_startup,
+            lambda k: rand_flat(k),
+            lambda k: tpe_flat(history, k),
+            k_prop,
+        )
+        tflat = typed(flat)
+        act = cs.active_flat(tflat)
+        loss = jnp.asarray(fn(cs.assemble(tflat, traced=True)), jnp.float32)
+        ok = jnp.isfinite(loss)  # NaN/Inf objective -> trial recorded, no loss
+        vals = {l: vals[l].at[i].set(flat[l]) for l in cs.labels}
+        active = {l: active[l].at[i].set(jnp.asarray(act[l], bool)) for l in cs.labels}
+        losses = losses.at[i].set(jnp.where(ok, loss, jnp.inf))
+        has_loss = has_loss.at[i].set(ok)
+        return (vals, active, losses, has_loss, key), loss
+
+    return step
+
+
+def fmin_device(
+    fn,
+    space,
+    max_evals,
+    seed=0,
+    n_startup_jobs=tpe._default_n_startup_jobs,
+    n_EI_candidates=tpe._default_n_EI_candidates,
+    gamma=tpe._default_gamma,
+    linear_forgetting=tpe._default_linear_forgetting,
+    prior_weight=tpe._default_prior_weight,
+    return_trials=False,
+):
+    """Minimize a traceable ``fn`` over ``space`` entirely on device.
+
+    ``fn`` receives the assembled structured point built from traced values
+    (``lax.switch`` for choices) and must return a scalar jnp loss.
+
+    Returns ``(best_flat, best_loss)`` — or a reference-shaped ``Trials``
+    when ``return_trials=True`` (every trial materialized as a document, so
+    downstream tooling/plots work unchanged).
+    """
+    cs = compile_space(space)
+    cap = int(max_evals)
+    cfg = {
+        "prior_weight": float(prior_weight),
+        "n_EI_candidates": int(n_EI_candidates),
+        "gamma": float(gamma),
+        "LF": int(linear_forgetting),
+    }
+
+    cache_key = (cs.expr, fn, cap, int(n_startup_jobs), tuple(sorted(cfg.items())))
+    run = _cache_get(cache_key)
+    if run is None:
+        step = _build_step(cs, fn, cap, cfg, int(n_startup_jobs))
+
+        @jax.jit
+        def run(key):
+            vals = {l: jnp.zeros(cap, jnp.float32) for l in cs.labels}
+            active = {l: jnp.zeros(cap, bool) for l in cs.labels}
+            losses = jnp.full(cap, jnp.inf, jnp.float32)
+            has_loss = jnp.zeros(cap, bool)
+            carry = (vals, active, losses, has_loss, key)
+            carry, trace = jax.lax.scan(step, carry, jnp.arange(cap, dtype=jnp.int32))
+            vals, active, losses, has_loss, _ = carry
+            return vals, active, losses, has_loss, trace
+
+        _cache_put(cache_key, run)
+
+    key = seed if isinstance(seed, jax.Array) else jax.random.PRNGKey(int(seed))
+    vals, active, losses, has_loss, trace = run(key)
+
+    vals = {l: np.asarray(v) for l, v in vals.items()}
+    active = {l: np.asarray(v) for l, v in active.items()}
+    losses = np.asarray(losses)
+    best_i = int(np.argmin(losses))
+    best_flat = {
+        l: (int(round(float(vals[l][best_i])))
+            if cs.params[l].is_int else float(vals[l][best_i]))
+        for l in cs.labels
+        if active[l][best_i]
+    }
+    best_loss = float(losses[best_i])
+
+    if not return_trials:
+        return best_flat, best_loss
+
+    trials = Trials()
+    docs = []
+    for i in range(cap):
+        idxs, vs = {}, {}
+        for l in cs.labels:
+            if active[l][i]:
+                v = vals[l][i]
+                v = int(round(float(v))) if cs.params[l].is_int else float(v)
+                idxs[l], vs[l] = [i], [v]
+            else:
+                idxs[l], vs[l] = [], []
+        loss = float(losses[i])
+        result = (
+            {"loss": loss, "status": STATUS_OK}
+            if np.isfinite(loss)
+            else {"status": "fail"}
+        )
+        docs.append({
+            "state": JOB_STATE_DONE,
+            "tid": i,
+            "spec": None,
+            "result": result,
+            "misc": {"tid": i, "cmd": ("device_fmin", None), "idxs": idxs, "vals": vs},
+            "exp_key": None,
+            "owner": None,
+            "version": 0,
+            "book_time": None,
+            "refresh_time": None,
+        })
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return trials

@@ -106,7 +106,7 @@ class FMinIter:
         rstate,
         asynchronous=None,
         max_queue_len=1,
-        poll_interval_secs=1.0,
+        poll_interval_secs=None,
         max_evals=float("inf"),
         timeout=None,
         loss_threshold=None,
@@ -121,6 +121,11 @@ class FMinIter:
         self.asynchronous = trials.asynchronous if asynchronous is None else asynchronous
         self.rstate = rstate
         self.max_queue_len = max_queue_len
+        # precedence: explicit argument > backend attribute > 1.0s default.
+        # An async Trials backend may dictate its own polling cadence (the
+        # SparkTrials pattern); in-process pools poll much faster than a DB.
+        if poll_interval_secs is None:
+            poll_interval_secs = getattr(trials, "poll_interval_secs", 1.0)
         self.poll_interval_secs = poll_interval_secs
         self.max_evals = max_evals
         self.timeout = timeout

@@ -10,8 +10,11 @@ MongoDB workers or Spark executors (``hyperopt/mongoexp.py`` sym: MongoTrials,
   ids, one shard per device) and the **candidate axis** (``shard_map`` over
   ``n_EI_candidates`` with an all-gather EI argmax — the sequence-parallel
   analog).
-* ``executor`` (planned next) — host-side async trial evaluation behind the
-  reference's ``Trials.asynchronous`` protocol.
+* ``executor`` — host-side async trial evaluation behind the reference's
+  ``Trials.asynchronous`` protocol (``ExecutorTrials``: worker pool for
+  arbitrary objectives, one vmapped device call per queue for traceable
+  ones).
 """
 
-from . import sharding  # noqa: F401
+from . import executor, sharding  # noqa: F401
+from .executor import ExecutorTrials  # noqa: F401

@@ -1,0 +1,214 @@
+"""Asynchronous trial evaluation — the Mongo/Spark-backend analog.
+
+Parity targets: ``hyperopt/mongoexp.py`` (sym: MongoTrials, MongoJobs.reserve,
+MongoWorker.run_one) and ``hyperopt/spark.py`` (sym: SparkTrials).  The
+reference moves ``Domain.evaluate`` across a process/cluster boundary via DB
+polling (Mongo) or driver→executor RPC (Spark); the single-claim guarantee is
+Mongo's atomic ``find_one_and_update``.
+
+Here the boundary is a host-side worker pool feeding the one JAX process
+that owns the accelerator (single-controller model — SURVEY.md §5 "race
+detection" row):
+
+* ``ExecutorTrials`` is a ``Trials`` with ``asynchronous=True``: inserting
+  NEW trials dispatches evaluation onto a ``ThreadPoolExecutor``.  Claiming
+  NEW→RUNNING happens under one lock (the atomic-claim analog; a test
+  asserts no double-claim).  Workers write results, flip DONE/ERROR and bump
+  ``refresh_time`` (the heartbeat analog); ``fmin``'s poll loop sees state
+  changes exactly as it would see Mongo state changes.
+* With ``traceable=True`` the pool evaluates a whole queue of trials as ONE
+  vmapped device call (``Domain.make_batch_eval``) — the TPU-native form of
+  trial parallelism the reference cannot express: instead of N processes
+  each computing one objective, one XLA program computes N.
+
+The domain reaches workers the same way Mongo workers get it: a cloudpickle
+blob stored by ``FMinIter`` under ``attachments['FMinIter_Domain']``
+(misc.cmd = ('domain_attachment', 'FMinIter_Domain')).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+    Ctrl,
+    Trials,
+    coarse_utcnow,
+    spec_from_misc,
+)
+
+__all__ = ["ExecutorTrials"]
+
+logger = logging.getLogger(__name__)
+
+
+class ExecutorTrials(Trials):
+    """Trials whose evaluation runs on a worker pool (asynchronous=True)."""
+
+    asynchronous = True
+    poll_interval_secs = 0.05  # in-process pool: poll fast (FMinIter reads this)
+
+    def __init__(self, n_workers=4, traceable=False, exp_key=None, refresh=True):
+        self.n_workers = int(n_workers)
+        self.traceable = bool(traceable)
+        self._lock = threading.RLock()
+        self._pool = None
+        self._domain_cache = None
+        self._batch_eval_cache = None
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    # -- pool / domain plumbing -------------------------------------------
+
+    def _get_pool(self):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.n_workers, thread_name_prefix="hyperopt-worker"
+            )
+        return self._pool
+
+    def _get_domain(self):
+        """Unpickle the domain attachment once (MongoWorker.run_one analog)."""
+        if self._domain_cache is None:
+            blob = self.attachments.get("FMinIter_Domain")
+            if blob is None:
+                return None
+            if isinstance(blob, (bytes, bytearray)):
+                import cloudpickle
+
+                self._domain_cache = cloudpickle.loads(bytes(blob))
+            else:
+                self._domain_cache = blob
+        return self._domain_cache
+
+    # -- claim / evaluate --------------------------------------------------
+
+    def _claim(self, trial):
+        """Atomically move NEW -> RUNNING (MongoJobs.reserve analog)."""
+        with self._lock:
+            if trial["state"] != JOB_STATE_NEW:
+                return False
+            trial["state"] = JOB_STATE_RUNNING
+            trial["book_time"] = coarse_utcnow()
+            trial["owner"] = threading.current_thread().name
+            return True
+
+    def _finish(self, trial, result=None, error=None):
+        with self._lock:
+            # write result BEFORE state: the driver thread reads docs without
+            # this lock, and must never observe DONE with a stale result
+            if error is not None:
+                trial["misc"]["error"] = (str(type(error)), str(error))
+                trial["state"] = JOB_STATE_ERROR
+            else:
+                trial["result"] = result
+                trial["state"] = JOB_STATE_DONE
+            trial["refresh_time"] = coarse_utcnow()
+
+    def _run_one(self, trial):
+        """Evaluate one claimed trial (MongoWorker.run_one analog)."""
+        domain = self._get_domain()
+        if domain is None or not self._claim(trial):
+            return
+        try:
+            spec = spec_from_misc(trial["misc"])
+            result = domain.evaluate(spec, Ctrl(self, current_trial=trial))
+        except Exception as e:  # worker crash must not kill the driver
+            logger.error("async job exception: %s", e)
+            self._finish(trial, error=e)
+        else:
+            self._finish(trial, result=result)
+
+    def _run_batch(self, trials_batch):
+        """Evaluate a queue of trials as ONE vmapped device program."""
+        domain = self._get_domain()
+        if domain is None:
+            return
+        claimed = [t for t in trials_batch if self._claim(t)]
+        if not claimed:
+            return
+        try:
+            import jax.numpy as jnp
+
+            if self._batch_eval_cache is None:
+                self._batch_eval_cache = domain.make_batch_eval()
+            labels = domain.cs.labels
+            specs = [spec_from_misc(t["misc"]) for t in claimed]
+            flat_batch = {
+                l: jnp.asarray(
+                    np.array([s.get(l, 0.0) for s in specs], np.float32)
+                    if not domain.cs.params[l].is_int
+                    else np.array([int(s.get(l, 0)) for s in specs], np.int32)
+                )
+                for l in labels
+            }
+            losses = np.asarray(self._batch_eval_cache(flat_batch), np.float64)
+        except Exception as e:
+            logger.error("batched async eval exception: %s", e)
+            for t in claimed:
+                self._finish(t, error=e)
+            return
+        for t, loss in zip(claimed, losses):
+            if np.isfinite(loss):
+                self._finish(t, result={"loss": float(loss), "status": STATUS_OK})
+            else:
+                self._finish(t, error=ValueError(f"non-finite loss {loss}"))
+
+    # -- Trials overrides --------------------------------------------------
+
+    def insert_trial_docs(self, docs):
+        with self._lock:
+            tids = super().insert_trial_docs(docs)
+            new = [d for d in self._dynamic_trials if d["state"] == JOB_STATE_NEW]
+        pool = self._get_pool()
+        if self.traceable and len(new) > 1:
+            pool.submit(self._run_batch, new)
+        else:
+            for trial in new:
+                pool.submit(self._run_one, trial)
+        return tids
+
+    def refresh(self):
+        with self._lock:
+            super().refresh()
+            pending = [d for d in self._dynamic_trials if d["state"] == JOB_STATE_NEW]
+        # redispatch anything still NEW (e.g. inserted before the domain
+        # attachment existed — the Mongo-worker poll-again analog).  The
+        # atomic claim makes redundant submissions harmless.
+        if pending and self._get_domain() is not None:
+            pool = self._get_pool()
+            if self.traceable and len(pending) > 1:
+                pool.submit(self._run_batch, pending)
+            else:
+                for trial in pending:
+                    pool.submit(self._run_one, trial)
+
+    def count_by_state_unsynced(self, arg):
+        with self._lock:
+            return super().count_by_state_unsynced(arg)
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # pickle: drop pool/lock/caches along with base-class exclusions
+    def __getstate__(self):
+        state = super().__getstate__()
+        state["_pool"] = None
+        state["_lock"] = None
+        state["_domain_cache"] = None
+        state["_batch_eval_cache"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()

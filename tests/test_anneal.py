@@ -1,0 +1,106 @@
+"""Anneal + mix suggester tests (parity targets: hyperopt/tests/test_anneal.py,
+hyperopt/mix.py)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import anneal, mix, rand, tpe
+from hyperopt_tpu.zoo import ZOO
+
+
+def _best_loss(domain, algo, seed, max_evals):
+    t = Trials()
+    fmin(domain.objective, domain.space, algo=algo, max_evals=max_evals,
+         trials=t, rstate=np.random.default_rng(seed), show_progressbar=False)
+    return min(l for l in t.losses() if l is not None)
+
+
+def test_anneal_beats_random_on_quadratic():
+    domain = ZOO["quadratic1"]
+    seeds = range(4)
+    a = np.mean([_best_loss(domain, anneal.suggest, s, 60) for s in seeds])
+    r = np.mean([_best_loss(domain, rand.suggest, s, 60) for s in seeds])
+    assert a <= r * 1.05 + 1e-3, (a, r)
+
+
+def test_anneal_converges_tightly():
+    domain = ZOO["quadratic1"]
+    best = min(_best_loss(domain, anneal.suggest, s, 100) for s in range(3))
+    assert best < domain.loss_target
+
+
+def test_anneal_conditional_space():
+    space = hp.choice("c", [
+        {"kind": "a", "x": hp.uniform("xa", -5, 5)},
+        {"kind": "b", "y": hp.uniform("yb", 5, 10)},
+    ])
+
+    def obj(d):
+        return (d["x"] - 2.0) ** 2 if d["kind"] == "a" else d["y"]
+
+    t = Trials()
+    fmin(obj, space, algo=anneal.suggest, max_evals=60, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    best = t.best_trial
+    assert best["result"]["loss"] < 1.5
+    assert best["misc"]["vals"]["c"] == [0]
+
+
+def test_anneal_mixed_families_smoke():
+    domain = ZOO["many_dists"]
+    loss = _best_loss(domain, anneal.suggest, 0, 30)
+    assert np.isfinite(loss)
+
+
+def test_anneal_tunable_like_reference():
+    algo = anneal.AnnealSuggest(avg_best_idx=3.0, shrink_coef=0.2)
+    loss = _best_loss(ZOO["quadratic1"], algo, 0, 50)
+    assert loss < 1.0
+
+
+def test_anneal_respects_bounds():
+    t = Trials()
+    space = {"x": hp.uniform("x", -1, 1), "q": hp.quniform("q", 0, 10, 2)}
+    fmin(lambda d: d["x"] ** 2 + d["q"] * 0.01, space, algo=anneal.suggest,
+         max_evals=60, trials=t, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    xs = np.array([m["vals"]["x"][0] for m in t.miscs])
+    qs = np.array([m["vals"]["q"][0] for m in t.miscs])
+    assert xs.min() >= -1 and xs.max() <= 1
+    np.testing.assert_allclose(qs, np.round(qs / 2) * 2, atol=1e-5)
+
+
+def test_mix_dispatches_by_probability():
+    calls = {"a": 0, "b": 0}
+
+    def make(tag):
+        def algo(new_ids, domain, trials, seed):
+            calls[tag] += len(new_ids)
+            return rand.suggest(new_ids, domain, trials, seed)
+
+        return algo
+
+    t = Trials()
+    fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+         algo=functools.partial(mix.suggest,
+                                p_suggest=[(0.8, make("a")), (0.2, make("b"))]),
+         max_evals=100, trials=t, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    assert calls["a"] + calls["b"] == 100
+    assert calls["a"] > calls["b"]
+
+
+def test_mix_validates_probabilities():
+    with pytest.raises(ValueError):
+        mix.suggest([0], None, Trials(), 0, p_suggest=[(0.5, rand.suggest)])
+
+
+def test_mix_tpe_and_anneal_end_to_end():
+    algo = functools.partial(
+        mix.suggest, p_suggest=[(0.5, tpe.suggest), (0.5, anneal.suggest)]
+    )
+    loss = _best_loss(ZOO["branin"], algo, 0, 50)
+    assert np.isfinite(loss)

@@ -1,0 +1,76 @@
+"""On-device lax.scan fmin tests (no reference analog; SURVEY.md §7.1
+"one suggestion per call" row)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperopt_tpu import Trials, hp
+from hyperopt_tpu.device_fmin import fmin_device
+from hyperopt_tpu.zoo import ZOO
+
+
+def test_device_fmin_quadratic_converges():
+    best, loss = fmin_device(lambda d: (d["x"] - 1.0) ** 2,
+                             {"x": hp.uniform("x", -5, 5)},
+                             max_evals=150, seed=0)
+    assert loss < 0.05
+    assert abs(best["x"] - 1.0) < 0.5
+
+
+def test_device_fmin_branin():
+    dom = ZOO["branin"]
+    best, loss = fmin_device(dom.objective, dom.space, max_evals=300, seed=0,
+                             gamma=2.0, linear_forgetting=100)
+    assert loss < 0.9
+    assert set(best) == {"x", "y"}
+
+
+def test_device_fmin_beats_prior_sampling():
+    dom = ZOO["quadratic1"]
+    _, tpe_loss = fmin_device(dom.objective, dom.space, max_evals=120, seed=0)
+    # pure prior sampling = startup forever
+    _, rand_loss = fmin_device(dom.objective, dom.space, max_evals=120, seed=0,
+                               n_startup_jobs=10**9)
+    assert tpe_loss <= rand_loss * 1.1 + 1e-3
+
+
+def test_device_fmin_conditional_space():
+    space = hp.choice("c", [
+        {"k": 0, "x": hp.uniform("xa", -5, 5)},
+        {"k": 1, "x": hp.uniform("xb", 5, 15)},
+    ])
+    best, loss = fmin_device(lambda d: (d["x"] - 2.0) ** 2, space,
+                             max_evals=100, seed=0)
+    assert best["c"] == 0
+    assert "xa" in best and "xb" not in best
+    assert loss < 1.0
+
+
+def test_device_fmin_nan_objective_recorded_not_fatal():
+    def obj(d):
+        return jnp.where(d["x"] < 0, jnp.nan, d["x"])
+
+    best, loss = fmin_device(obj, {"x": hp.uniform("x", -5, 5)},
+                             max_evals=60, seed=0)
+    assert np.isfinite(loss)
+    assert best["x"] >= 0
+
+
+def test_device_fmin_return_trials():
+    dom = ZOO["quadratic1"]
+    trials = fmin_device(dom.objective, dom.space, max_evals=40, seed=0,
+                         return_trials=True)
+    assert isinstance(trials, Trials)
+    assert len(trials) == 40
+    assert trials.argmin  # reference-shaped docs work end-to-end
+    losses = [l for l in trials.losses() if l is not None]
+    assert min(losses) == trials.best_trial["result"]["loss"]
+
+
+def test_device_fmin_deterministic_per_seed():
+    dom = ZOO["quadratic1"]
+    a = fmin_device(dom.objective, dom.space, max_evals=50, seed=7)
+    b = fmin_device(dom.objective, dom.space, max_evals=50, seed=7)
+    assert a == b
