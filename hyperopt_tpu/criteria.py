@@ -1,0 +1,57 @@
+"""Analytic acquisition criteria.
+
+Parity target: ``hyperopt/criteria.py`` (sym: EI_empirical, EI_gaussian,
+logEI_gaussian, UCB) — demo-grade criteria not wired into TPE (the reference
+keeps them as standalone math; same here), expressed in jnp so they jit and
+vmap over candidate batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["EI_empirical", "EI_gaussian", "logEI_gaussian", "UCB"]
+
+
+def EI_empirical(samples, thresh):
+    """Expected improvement over ``thresh`` from empirical samples
+    (criteria.py sym: EI_empirical)."""
+    samples = jnp.asarray(samples)
+    improvement = jnp.maximum(samples - thresh, 0.0)
+    return jnp.mean(improvement)
+
+
+def EI_gaussian(mean, var, thresh):
+    """Expected improvement over ``thresh`` for N(mean, var)
+    (criteria.py sym: EI_gaussian)."""
+    sigma = jnp.sqrt(var)
+    score = (mean - thresh) / sigma
+    n_cdf = 0.5 * (1.0 + jax.lax.erf(score / jnp.sqrt(2.0)))
+    n_pdf = jnp.exp(-0.5 * score**2) / jnp.sqrt(2.0 * jnp.pi)
+    return sigma * (score * n_cdf + n_pdf)
+
+
+def logEI_gaussian(mean, var, thresh):
+    """log(EI_gaussian), stable far into the tails
+    (criteria.py sym: logEI_gaussian)."""
+    sigma = jnp.sqrt(var)
+    score = (mean - thresh) / sigma
+    # for very negative score use the asymptotic expansion of the tail:
+    # EI ~ sigma * pdf(score) / score^2  (Mills-ratio expansion)
+    n_cdf = 0.5 * (1.0 + jax.lax.erf(score / jnp.sqrt(2.0)))
+    n_pdf = jnp.exp(-0.5 * score**2) / jnp.sqrt(2.0 * jnp.pi)
+    naive = sigma * (score * n_cdf + n_pdf)
+    log_naive = jnp.log(jnp.maximum(naive, jnp.finfo(jnp.float32).tiny))
+    log_tail = (
+        jnp.log(sigma)
+        - 0.5 * score**2
+        - 0.5 * jnp.log(2.0 * jnp.pi)
+        - 2.0 * jnp.log(jnp.maximum(-score, 1.0))
+    )
+    return jnp.where(score < -10.0, log_tail, log_naive)
+
+
+def UCB(mean, var, zscore):
+    """Upper confidence bound (criteria.py sym: UCB)."""
+    return mean + jnp.sqrt(var) * zscore

@@ -1,0 +1,145 @@
+"""General utilities.
+
+Parity target: ``hyperopt/utils.py`` (sym: import_tokens, json_call,
+get_most_recent_inds, fast_isin, temp_dir, working_dir, get_closest_dir,
+coarse_utcnow).  ``use_obj_for_literal_in_memo`` has no analog — it patched
+``Ctrl`` objects into pyll interpreter memos, and there is no interpreter
+here (``Ctrl`` is passed to ``Domain.evaluate`` directly).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+
+import numpy as np
+
+from .base import coarse_utcnow  # noqa: F401  (re-export, reference parity)
+
+__all__ = [
+    "import_tokens",
+    "json_call",
+    "get_most_recent_inds",
+    "fast_isin",
+    "temp_dir",
+    "working_dir",
+    "path_split_all",
+    "get_closest_dir",
+    "coarse_utcnow",
+]
+
+
+def import_tokens(tokens):
+    """Import a dotted path given as a token list (utils.py sym: import_tokens)."""
+    module = __import__(tokens[0])
+    out = module
+    for t in tokens[1:]:
+        out = getattr(out, t)
+    return out
+
+
+def json_call(json_spec, args=(), kwargs=None):
+    """Call a function named by dotted string or ('name', args, kwargs) spec
+    (utils.py sym: json_call)."""
+    if kwargs is None:
+        kwargs = {}
+    if isinstance(json_spec, str):
+        return import_tokens(json_spec.split("."))(*args, **kwargs)
+    if isinstance(json_spec, (list, tuple)):
+        name = json_spec[0]
+        extra_args = json_spec[1] if len(json_spec) > 1 else []
+        extra_kwargs = json_spec[2] if len(json_spec) > 2 else {}
+        return import_tokens(name.split("."))(
+            *(list(args) + list(extra_args)), **{**kwargs, **extra_kwargs}
+        )
+    raise TypeError(f"cannot json_call {json_spec!r}")
+
+
+def get_most_recent_inds(obj):
+    """Indices of documents that are the latest version of their _id
+    (utils.py sym: get_most_recent_inds)."""
+    data = np.rec.fromarrays(
+        [[d["_id"] for d in obj], [d["version"] for d in obj]],
+        names=["_id", "version"],
+    )
+    s = np.argsort(data, order=["_id", "version"])
+    data = data[s]
+    recent = np.ones(len(data), dtype=bool)
+    if len(data) > 1:
+        recent[:-1] = data["_id"][1:] != data["_id"][:-1]
+    return s[recent]
+
+
+def fast_isin(X, Y):
+    """Boolean mask of which X appear in Y; both 1-D (utils.py sym: fast_isin)."""
+    X = np.asarray(X)
+    Y = np.asarray(Y)
+    if len(Y) == 0:
+        return np.zeros(len(X), bool)
+    T = Y.copy()
+    T.sort()
+    D = T.searchsorted(X)
+    T = np.append(T, np.array([0]))
+    W = T[D] == X
+    if W.dtype != bool:  # all-mismatch edge case
+        return np.zeros(len(X), bool)
+    return W
+
+
+@contextlib.contextmanager
+def temp_dir(dir, erase_after=False, with_sentinel=True):
+    """Create ``dir`` (and a sentinel marking it safe to delete); optionally
+    remove it afterwards (utils.py sym: temp_dir)."""
+    created_by_me = False
+    if not os.path.exists(dir):
+        os.makedirs(dir)
+        created_by_me = True
+        if with_sentinel:
+            open(os.path.join(dir, ".hyperopt_temp_sentinel"), "w").close()
+    try:
+        yield dir
+    finally:
+        if erase_after and created_by_me and os.path.exists(dir):
+            sentinel = os.path.join(dir, ".hyperopt_temp_sentinel")
+            if not with_sentinel or os.path.exists(sentinel):
+                shutil.rmtree(dir)
+
+
+@contextlib.contextmanager
+def working_dir(dir):
+    """chdir into ``dir`` for the block (utils.py sym: working_dir)."""
+    cwd = os.getcwd()
+    os.chdir(dir)
+    try:
+        yield dir
+    finally:
+        os.chdir(cwd)
+
+
+def path_split_all(path):
+    """All components of a path (utils.py sym: path_split_all)."""
+    parts = []
+    while True:
+        path, tail = os.path.split(path)
+        if tail:
+            parts.append(tail)
+        else:
+            if path:
+                parts.append(path)
+            break
+    parts.reverse()
+    return parts
+
+
+def get_closest_dir(workdir):
+    """Deepest existing ancestor of ``workdir`` plus the first missing
+    component (utils.py sym: get_closest_dir)."""
+    closest_dir = ""
+    for wdi in path_split_all(workdir):
+        if os.path.isdir(os.path.join(closest_dir, wdi)):
+            closest_dir = os.path.join(closest_dir, wdi)
+        else:
+            break
+    assert closest_dir != workdir
+    return closest_dir, wdi
