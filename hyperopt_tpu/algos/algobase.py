@@ -18,7 +18,6 @@ from __future__ import annotations
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from . import rand
 
@@ -69,10 +68,7 @@ class SuggestAlgo:
             "active": history["active"],
         }
         propose = self._get_jit(domain, cfg)
-        base_key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-            jnp.asarray([int(i) & 0x7FFFFFFF for i in new_ids], jnp.uint32)
-        )
+        keys = rand.fold_ids(rand.seed_to_key(seed), new_ids)
         batch = propose(hist_arrays, keys)
         host = {k: np.asarray(v) for k, v in batch.items()}
         flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]

@@ -13,7 +13,29 @@ import numpy as np
 
 import jax
 
-__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs"]
+__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs", "seed_to_key", "fold_ids"]
+
+
+def seed_to_key(seed):
+    """Full-width threefry key from an integer seed.
+
+    The low 32 bits seed the key and any higher bits fold in separately, so
+    seeds differing only above bit 31 (common with rstate-derived 64-bit
+    seeds) produce distinct streams instead of silently colliding.
+    """
+    seed = int(seed)
+    key = jax.random.PRNGKey(seed & 0xFFFFFFFF)
+    hi = (seed >> 32) & 0xFFFFFFFF
+    if hi:
+        key = jax.random.fold_in(key, hi)
+    return key
+
+
+def fold_ids(key, new_ids):
+    """One derived key per new id (full 32-bit id range)."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jax.numpy.asarray([int(i) & 0xFFFFFFFF for i in new_ids], jax.numpy.uint32)
+    )
 
 
 def flat_to_new_trial_docs(domain, trials, new_ids, flats):
@@ -52,20 +74,18 @@ def _flat_to_host(flat):
 
 def suggest(new_ids, domain, trials, seed):
     """Draw one prior sample per new id (hyperopt/rand.py sym: suggest)."""
-    key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
+    key = seed_to_key(seed)
     flats = []
     for new_id in new_ids:
-        k = jax.random.fold_in(key, int(new_id) & 0x7FFFFFFF)
+        k = jax.random.fold_in(key, int(new_id) & 0xFFFFFFFF)
         flats.append(_flat_to_host(domain.cs.sample_flat_jit(k)))
     return flat_to_new_trial_docs(domain, trials, new_ids, flats)
 
 
 def suggest_batch(new_ids, domain, trials, seed):
     """Vectorized variant: one vmapped device program for all ids."""
-    key = jax.random.PRNGKey(int(seed) & 0x7FFFFFFF)
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        jax.numpy.asarray([int(i) & 0x7FFFFFFF for i in new_ids])
-    )
+    key = seed_to_key(seed)
+    keys = fold_ids(key, new_ids)
     batch = jax.jit(jax.vmap(domain.cs.sample_flat))(keys)
     host = {k: np.asarray(v) for k, v in batch.items()}
     flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]

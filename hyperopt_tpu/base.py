@@ -285,7 +285,8 @@ class Trials:
         self._exp_key = exp_key
         self.attachments = {}
         self._history = None  # PaddedHistory, built lazily once labels known
-        self._history_synced = 0  # number of docs folded into history
+        self._history_synced = 0  # scan position over _dynamic_trials
+        self._history_pending = []  # seen-but-unsettled docs, revisited
         if refresh:
             self.refresh()
 
@@ -327,6 +328,7 @@ class Trials:
         self.attachments = {}
         self._history = None
         self._history_synced = 0
+        self._history_pending = []
         self.refresh()
 
     # -- id/doc generation -------------------------------------------------
@@ -485,24 +487,42 @@ class Trials:
 
     def padded_history(self, labels):
         """Incrementally fold DONE trials into the dense padded history and
-        return its device view.  O(new trials) per call."""
+        return its device view.  O(new + in-flight trials) per call.
+
+        With an asynchronous backend completions arrive out of order, so a
+        single watermark would let one slow in-flight trial hide every later
+        DONE trial from the posterior (head-of-line blocking).  Instead:
+        settled docs fold as soon as they are seen; unsettled ones go into a
+        pending set revisited on every call.  Fold order is completion order,
+        which is what the linear-forgetting weights should see anyway.
+        """
         if self._history is None or self._history.labels != tuple(labels):
             self._history = PaddedHistory(labels)
             self._history_synced = 0
+            self._history_pending = []
         docs = self._dynamic_trials
-        while self._history_synced < len(docs):
-            doc = docs[self._history_synced]
-            if doc["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
-                # in-flight (async backend): stop at the first unsettled doc
-                # so it is revisited once it completes — advancing past it
-                # would drop the trial from the posterior forever
-                break
-            self._history_synced += 1
+
+        def fold(doc):
             if doc["state"] != JOB_STATE_DONE:
-                continue
+                return  # ERROR/CANCEL: settled but contributes nothing
             result = doc["result"]
             loss = result.get("loss") if result.get("status") == STATUS_OK else None
             self._history.append(spec_from_misc(doc["misc"]), loss)
+
+        still_pending = []
+        for doc in self._history_pending:
+            if doc["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+                still_pending.append(doc)
+            else:
+                fold(doc)
+        self._history_pending = still_pending
+        while self._history_synced < len(docs):
+            doc = docs[self._history_synced]
+            self._history_synced += 1
+            if doc["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+                self._history_pending.append(doc)
+            else:
+                fold(doc)
         return self._history.device_view()
 
     def fmin(
@@ -553,6 +573,7 @@ class Trials:
         state = self.__dict__.copy()
         state["_history"] = None
         state["_history_synced"] = 0
+        state["_history_pending"] = []
         attachments = dict(state.get("attachments", {}))
         dom = attachments.get("FMinIter_Domain")
         if dom is not None and not isinstance(dom, (bytes, bytearray)):

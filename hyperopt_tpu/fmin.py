@@ -13,6 +13,7 @@ objectives, ``device_fmin.fmin_device`` runs the entire loop on-device under
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import pickle
@@ -294,10 +295,19 @@ class FMinIter:
     def _save_trials(self):
         """Checkpoint trials atomically: write a temp file, then rename, so a
         crash mid-dump never truncates an existing checkpoint (round-1 bug:
-        a failed dump left a 0-byte file and EOFError on resume)."""
+        a failed dump left a 0-byte file and EOFError on resume).
+
+        An asynchronous backend's workers mutate trial docs concurrently;
+        pickling a doc whose dict changes mid-dump raises RuntimeError or
+        tears the checkpoint, so serialize under the backend's lock when it
+        has one.
+        """
+        lock = getattr(self.trials, "_lock", None)
+        with lock if lock is not None else contextlib.nullcontext():
+            payload = pickle.dumps(self.trials, protocol=self.pickle_protocol)
         tmp = self.trials_save_file + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+            f.write(payload)
         os.replace(tmp, self.trials_save_file)
 
     def __iter__(self):

@@ -64,6 +64,7 @@ class ExecutorTrials(Trials):
         self._pool = None
         self._domain_cache = None
         self._batch_eval_cache = None
+        self._dispatched = set()  # tids already submitted to the pool
         super().__init__(exp_key=exp_key, refresh=refresh)
 
     # -- pool / domain plumbing -------------------------------------------
@@ -164,32 +165,53 @@ class ExecutorTrials(Trials):
 
     # -- Trials overrides --------------------------------------------------
 
+    def _dispatch(self, docs):
+        """Submit NEW, not-yet-dispatched docs to the pool exactly once.
+
+        Docs inserted before the domain attachment exists are left
+        undispatched; ``refresh()`` picks them up later (the Mongo-worker
+        poll-again analog) — so each doc is submitted once, not O(all-NEW)
+        per insert/refresh.
+        """
+        if not docs or self._get_domain() is None:
+            return
+        with self._lock:
+            todo = [
+                d
+                for d in docs
+                if d["state"] == JOB_STATE_NEW and d["tid"] not in self._dispatched
+            ]
+            self._dispatched.update(d["tid"] for d in todo)
+        if not todo:
+            return
+        pool = self._get_pool()
+        if self.traceable and len(todo) > 1:
+            pool.submit(self._run_batch, todo)
+        else:
+            for trial in todo:
+                pool.submit(self._run_one, trial)
+
     def insert_trial_docs(self, docs):
         with self._lock:
             tids = super().insert_trial_docs(docs)
-            new = [d for d in self._dynamic_trials if d["state"] == JOB_STATE_NEW]
-        pool = self._get_pool()
-        if self.traceable and len(new) > 1:
-            pool.submit(self._run_batch, new)
-        else:
-            for trial in new:
-                pool.submit(self._run_one, trial)
+            inserted = self._dynamic_trials[-len(docs):] if len(docs) else []
+        self._dispatch(inserted)
         return tids
 
     def refresh(self):
         with self._lock:
             super().refresh()
-            pending = [d for d in self._dynamic_trials if d["state"] == JOB_STATE_NEW]
-        # redispatch anything still NEW (e.g. inserted before the domain
-        # attachment existed — the Mongo-worker poll-again analog).  The
-        # atomic claim makes redundant submissions harmless.
-        if pending and self._get_domain() is not None:
-            pool = self._get_pool()
-            if self.traceable and len(pending) > 1:
-                pool.submit(self._run_batch, pending)
-            else:
-                for trial in pending:
-                    pool.submit(self._run_one, trial)
+            pending = [
+                d
+                for d in self._dynamic_trials
+                if d["state"] == JOB_STATE_NEW and d["tid"] not in self._dispatched
+            ]
+        self._dispatch(pending)
+
+    def delete_all(self):
+        with self._lock:
+            self._dispatched = set()
+            super().delete_all()
 
     def count_by_state_unsynced(self, arg):
         with self._lock:
@@ -207,8 +229,12 @@ class ExecutorTrials(Trials):
         state["_lock"] = None
         state["_domain_cache"] = None
         state["_batch_eval_cache"] = None
+        # a resumed process has no workers yet: NEW docs must redispatch there
+        state["_dispatched"] = set()
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.RLock()
+        # checkpoints written by older versions predate this attribute
+        self.__dict__.setdefault("_dispatched", set())

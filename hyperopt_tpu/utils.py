@@ -59,32 +59,18 @@ def json_call(json_spec, args=(), kwargs=None):
 def get_most_recent_inds(obj):
     """Indices of documents that are the latest version of their _id
     (utils.py sym: get_most_recent_inds)."""
-    data = np.rec.fromarrays(
-        [[d["_id"] for d in obj], [d["version"] for d in obj]],
-        names=["_id", "version"],
-    )
-    s = np.argsort(data, order=["_id", "version"])
-    data = data[s]
-    recent = np.ones(len(data), dtype=bool)
-    if len(data) > 1:
-        recent[:-1] = data["_id"][1:] != data["_id"][:-1]
+    ids = np.asarray([d["_id"] for d in obj])
+    versions = np.asarray([d["version"] for d in obj])
+    s = np.lexsort((versions, ids))  # by _id, then version
+    recent = np.ones(len(s), dtype=bool)
+    if len(s) > 1:
+        recent[:-1] = ids[s][1:] != ids[s][:-1]
     return s[recent]
 
 
 def fast_isin(X, Y):
     """Boolean mask of which X appear in Y; both 1-D (utils.py sym: fast_isin)."""
-    X = np.asarray(X)
-    Y = np.asarray(Y)
-    if len(Y) == 0:
-        return np.zeros(len(X), bool)
-    T = Y.copy()
-    T.sort()
-    D = T.searchsorted(X)
-    T = np.append(T, np.array([0]))
-    W = T[D] == X
-    if W.dtype != bool:  # all-mismatch edge case
-        return np.zeros(len(X), bool)
-    return W
+    return np.isin(np.asarray(X), np.asarray(Y))
 
 
 @contextlib.contextmanager

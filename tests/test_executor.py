@@ -110,32 +110,54 @@ def test_traceable_batch_eval():
         assert d["result"]["loss"] == pytest.approx(expect, rel=1e-4)
 
 
-def test_padded_history_revisits_in_flight_trials():
-    # a RUNNING doc must block (not be skipped by) incremental history sync
+def _doc(i, state, loss=None):
+    return {
+        "tid": i, "spec": None,
+        "result": {"status": STATUS_OK, "loss": float(i if loss is None else loss)}
+        if state == JOB_STATE_DONE else {"status": "new"},
+        "misc": {"tid": i, "cmd": None, "idxs": {"x": [i]}, "vals": {"x": [float(i)]}},
+        "state": state, "exp_key": None, "owner": None, "version": 0,
+        "book_time": None, "refresh_time": None,
+    }
+
+
+def test_padded_history_folds_out_of_order_completions():
+    # a RUNNING doc must NOT hide later DONE docs from the posterior
+    # (head-of-line blocking), and must still fold once it completes
     from hyperopt_tpu import Trials
     from hyperopt_tpu.base import JOB_STATE_RUNNING
 
     t = Trials()
-    docs = []
-    for i, state in enumerate([JOB_STATE_DONE, JOB_STATE_RUNNING, JOB_STATE_DONE]):
-        docs.append({
-            "tid": i, "spec": None,
-            "result": {"status": STATUS_OK, "loss": float(i)}
-            if state == JOB_STATE_DONE else {"status": "new"},
-            "misc": {"tid": i, "cmd": None, "idxs": {"x": [i]}, "vals": {"x": [float(i)]}},
-            "state": state, "exp_key": None, "owner": None, "version": 0,
-            "book_time": None, "refresh_time": None,
-        })
-    t.insert_trial_docs(docs)
+    t.insert_trial_docs(
+        [_doc(0, JOB_STATE_DONE), _doc(1, JOB_STATE_RUNNING), _doc(2, JOB_STATE_DONE)]
+    )
     t.refresh()
     h = t.padded_history(("x",))
-    assert h["n"] == 1  # stops at the RUNNING doc
-    # trial 1 completes -> next call folds it AND trial 2
+    assert h["n"] == 2  # DONE trials behind the in-flight one are visible
+    assert sorted(h["vals"]["x"][:2].tolist()) == [0.0, 2.0]
+    # the slow trial completes -> next call folds it too
     t._dynamic_trials[1]["result"] = {"status": STATUS_OK, "loss": 1.0}
     t._dynamic_trials[1]["state"] = JOB_STATE_DONE
     h = t.padded_history(("x",))
     assert h["n"] == 3
     assert h["has_loss"][:3].all()
+    assert sorted(h["vals"]["x"][:3].tolist()) == [0.0, 1.0, 2.0]
+
+
+def test_padded_history_many_stuck_trials_dont_starve_posterior():
+    # posterior must see every DONE trial even with several stuck RUNNING docs
+    from hyperopt_tpu import Trials
+    from hyperopt_tpu.base import JOB_STATE_RUNNING
+
+    t = Trials()
+    states = [JOB_STATE_RUNNING if i % 3 == 0 else JOB_STATE_DONE for i in range(30)]
+    t.insert_trial_docs([_doc(i, s) for i, s in enumerate(states)])
+    t.refresh()
+    h = t.padded_history(("x",))
+    assert h["n"] == sum(1 for s in states if s == JOB_STATE_DONE)
+    # repeated calls are idempotent while nothing settles
+    h2 = t.padded_history(("x",))
+    assert h2["n"] == h["n"]
 
 
 def test_insert_before_domain_attachment_not_lost():
@@ -179,3 +201,20 @@ def test_executor_trials_pickle_roundtrip():
     t2 = pickle.loads(pickle.dumps(t))
     assert len(t2) == 4
     assert t2.losses() == t.losses()
+
+
+def test_dispatch_submits_each_trial_once():
+    # insert/refresh used to resubmit every still-NEW doc (O(n^2) submissions
+    # over a run); now each doc reaches the pool exactly once
+    calls = []
+
+    class Counting(ExecutorTrials):
+        def _run_one(self, trial):
+            calls.append(trial["tid"])
+            super()._run_one(trial)
+
+    t = Counting(n_workers=4)
+    fmin(lambda d: d["x"] ** 2, SPACE, algo=rand.suggest, max_evals=16, trials=t,
+         max_queue_len=4, rstate=np.random.default_rng(0), show_progressbar=False)
+    t.shutdown()
+    assert sorted(calls) == sorted(t.tids)

@@ -83,3 +83,23 @@ def test_rand_fmin_on_conditional_space():
     fmin(obj, space, algo=rand.suggest, max_evals=60, trials=t,
          rstate=np.random.default_rng(0), show_progressbar=False)
     assert t.best_trial["result"]["loss"] < 5
+
+
+def test_seed_high_bits_produce_distinct_streams():
+    # rstate-derived seeds can exceed 32 bits; truncating them (an earlier
+    # bug masked with 0x7FFFFFFF) must not collapse distinct seeds
+    import jax
+
+    from hyperopt_tpu.algos.rand import seed_to_key
+
+    lo, hi = 123, 123 + 2**33
+    k_lo = np.asarray(jax.random.key_data(seed_to_key(lo)))
+    k_hi = np.asarray(jax.random.key_data(seed_to_key(hi)))
+    assert not np.array_equal(k_lo, k_hi)
+
+    space = {"u": hp.uniform("u", 0, 1)}
+    a = _collect(space, n=8, seed=lo)[0]
+    b = _collect(space, n=8, seed=hi)[0]
+    va = [d["misc"]["vals"]["u"][0] for d in a]
+    vb = [d["misc"]["vals"]["u"][0] for d in b]
+    assert va != vb
