@@ -42,17 +42,28 @@ class SuggestAlgo:
 
     # -- shared runtime ----------------------------------------------------
 
+    #: module-wide: (algo class, space signature, cfg) -> jitted suggest
+    _jit_cache = {}
+
     def _get_jit(self, domain, cfg):
-        cache_attr = f"_algo_cache_{type(self).__name__}"
-        cache = getattr(domain, cache_attr, None)
-        if cache is None:
-            cache = {}
-            setattr(domain, cache_attr, cache)
-        key = tuple(sorted(cfg.items()))
-        fn = cache.get(key)
+        """Cached ``run(history, seed_words[2], ids[B]) -> packed [B, L]``
+        with key derivation traced in (one dispatch per suggest call).
+        Keyed by space signature so fresh Domains reuse compiled kernels."""
+        key = (type(self).__name__, domain.cs.signature(), tuple(sorted(cfg.items())))
+        fn = SuggestAlgo._jit_cache.get(key)
         if fn is None:
-            fn = jax.jit(jax.vmap(self.build(domain.cs, cfg), in_axes=(None, 0)))
-            cache[key] = fn
+            cs = domain.cs
+            propose = self.build(cs, cfg)
+
+            def run(history, seed_words, ids):
+                k = jax.random.fold_in(
+                    jax.random.PRNGKey(seed_words[0]), seed_words[1]
+                )
+                keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+                out = jax.vmap(propose, in_axes=(None, 0))(history, keys)
+                return rand.pack_labels(cs, out)
+
+            fn = SuggestAlgo._jit_cache[key] = jax.jit(run)
         return fn
 
     def __call__(self, new_ids, domain, trials, seed, **overrides):
@@ -67,9 +78,12 @@ class SuggestAlgo:
             "vals": history["vals"],
             "active": history["active"],
         }
-        propose = self._get_jit(domain, cfg)
-        keys = rand.fold_ids(rand.seed_to_key(seed), new_ids)
-        batch = propose(hist_arrays, keys)
-        host = {k: np.asarray(v) for k, v in batch.items()}
-        flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
+        run = self._get_jit(domain, cfg)
+        seed = int(seed)
+        seed_words = np.asarray(
+            [seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32
+        )
+        ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
+        mat = run(hist_arrays, seed_words, ids)
+        flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
         return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

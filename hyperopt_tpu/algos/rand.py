@@ -25,10 +25,9 @@ def seed_to_key(seed):
     """
     seed = int(seed)
     key = jax.random.PRNGKey(seed & 0xFFFFFFFF)
-    hi = (seed >> 32) & 0xFFFFFFFF
-    if hi:
-        key = jax.random.fold_in(key, hi)
-    return key
+    # unconditional fold keeps this bit-identical to the in-trace derivation
+    # used by the suggesters' fused kernels (tpe._get_suggest_jit)
+    return jax.random.fold_in(key, (seed >> 32) & 0xFFFFFFFF)
 
 
 def fold_ids(key, new_ids):
@@ -68,25 +67,74 @@ def flat_to_new_trial_docs(domain, trials, new_ids, flats):
     return rval
 
 
-def _flat_to_host(flat):
-    return {k: np.asarray(v).item() for k, v in flat.items()}
+def pack_labels(cs, out):
+    """Stack a ``{label: value[B]}`` kernel output into one ``[B, L]`` f32
+    matrix (labels in ``cs.labels`` order).
+
+    A tunneled accelerator pays one host↔device round trip *per fetched
+    buffer*; packing makes every suggest readback exactly one transfer.
+    Integer families survive the f32 trip exactly (|value| < 2^24).
+    """
+    import jax.numpy as jnp
+
+    return jnp.stack(
+        [jnp.asarray(out[l], jnp.float32) for l in cs.labels], axis=-1
+    )
+
+
+def unpack_flats(cs, mat, n):
+    """Invert :func:`pack_labels` on host: ``[n, L]`` matrix → flat dicts."""
+    mat = np.asarray(mat)
+    return [
+        {
+            l: (int(round(float(mat[i, j]))) if cs.params[l].is_int
+                else float(mat[i, j]))
+            for j, l in enumerate(cs.labels)
+        }
+        for i in range(n)
+    ]
+
+
+_sample_jit_cache = {}  # space signature -> jitted batched prior sampler
+
+
+def _get_sample_jit(domain):
+    """Cached ``run(seed_words[2], ids[B]) -> packed [B, L]`` with the
+    PRNG-key derivation traced in — one device dispatch and one readback
+    per suggest call regardless of batch size (host-side PRNGKey/fold_in
+    calls each cost a round trip on a tunneled accelerator).  Keyed by
+    space signature so fresh Domains reuse the compiled kernel."""
+    cs = domain.cs
+    key = cs.signature()
+    fn = _sample_jit_cache.get(key)
+    if fn is None:
+        sample_flat = cs.sample_flat
+
+        def run(seed_words, ids):
+            k = jax.random.fold_in(
+                jax.random.PRNGKey(seed_words[0]), seed_words[1]
+            )
+            keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+            return pack_labels(cs, jax.vmap(sample_flat)(keys))
+
+        fn = _sample_jit_cache[key] = jax.jit(run)
+    return fn
 
 
 def suggest(new_ids, domain, trials, seed):
-    """Draw one prior sample per new id (hyperopt/rand.py sym: suggest)."""
-    key = seed_to_key(seed)
-    flats = []
-    for new_id in new_ids:
-        k = jax.random.fold_in(key, int(new_id) & 0xFFFFFFFF)
-        flats.append(_flat_to_host(domain.cs.sample_flat_jit(k)))
+    """Draw one prior sample per new id (hyperopt/rand.py sym: suggest).
+
+    All ids are drawn by one vmapped device program (per-id ``fold_in``
+    keys, so the draws are identical whatever the batching)."""
+    seed = int(seed)
+    seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
+    ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
+    mat = _get_sample_jit(domain)(seed_words, ids)
+    flats = unpack_flats(domain.cs, mat, len(new_ids))
     return flat_to_new_trial_docs(domain, trials, new_ids, flats)
 
 
 def suggest_batch(new_ids, domain, trials, seed):
-    """Vectorized variant: one vmapped device program for all ids."""
-    key = seed_to_key(seed)
-    keys = fold_ids(key, new_ids)
-    batch = jax.jit(jax.vmap(domain.cs.sample_flat))(keys)
-    host = {k: np.asarray(v) for k, v in batch.items()}
-    flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
-    return flat_to_new_trial_docs(domain, trials, new_ids, flats)
+    """Alias of ``suggest`` (hyperopt/rand.py sym: suggest_batch) — the
+    serial path is already one batched device program."""
+    return suggest(new_ids, domain, trials, seed)

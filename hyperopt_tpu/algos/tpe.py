@@ -471,17 +471,81 @@ def build_propose(cs, cfg):
     return propose
 
 
+_propose_jit_cache = {}  # (space signature, cfg) -> jitted vmapped propose
+_suggest_jit_cache = {}  # (space signature, cfg) -> fused tell+ask program
+
+
 def _get_propose_jit(domain, cfg_key, cfg):
-    """Per-domain cache of the jitted (and vmapped-over-keys) proposal fn."""
-    cache = getattr(domain, "_tpe_propose_cache", None)
-    if cache is None:
-        cache = domain._tpe_propose_cache = {}
-    fn = cache.get(cfg_key)
+    """Module-level cache of the jitted (and vmapped-over-keys) proposal fn,
+    keyed by space signature so fresh Domains reuse compiled kernels."""
+    key = (domain.cs.signature(), cfg_key)
+    fn = _propose_jit_cache.get(key)
     if fn is None:
         propose = build_propose(domain.cs, cfg)
-        fn = jax.jit(jax.vmap(propose, in_axes=(None, 0)))
-        cache[cfg_key] = fn
+        fn = _propose_jit_cache[key] = jax.jit(jax.vmap(propose, in_axes=(None, 0)))
     return fn
+
+
+def _apply_rows(labels, history, rows):
+    """Fold packed trial rows (see ``PaddedHistory._pack_row``) into the
+    history arrays in-trace.  Padding rows carry an out-of-bounds index and
+    are dropped by ``mode='drop'``; the row count is a small static bucket,
+    so the loop unrolls."""
+    L = len(labels)
+    hist = history
+    for r in range(rows.shape[0]):
+        row = rows[r]
+        i = row[2 * L + 2].astype(jnp.int32)
+        hist = {
+            "vals": {
+                l: hist["vals"][l].at[i].set(row[j], mode="drop")
+                for j, l in enumerate(labels)
+            },
+            "active": {
+                l: hist["active"][l].at[i].set(row[L + j] > 0.5, mode="drop")
+                for j, l in enumerate(labels)
+            },
+            "losses": hist["losses"].at[i].set(row[2 * L], mode="drop"),
+            "has_loss": hist["has_loss"].at[i].set(row[2 * L + 1] > 0.5, mode="drop"),
+        }
+    return hist
+
+
+def _get_suggest_jit(domain, cfg_key, cfg):
+    """The fused tell+ask program:
+    ``run(history, rows, seed_words[2], ids[B]) -> (history', packed[B, L])``.
+
+    One device program per ask→tell iteration: it folds the just-completed
+    trials (``rows``) into the device-resident history, then proposes for
+    every queued id.  Key derivation is traced in too — host-side
+    ``PRNGKey``/``fold_in`` calls are each their own device dispatch, and on
+    a tunneled accelerator every extra program costs tens of ms of
+    completion latency (the round-2 interactive-loop bottleneck).
+    """
+    cs = domain.cs
+    key = (cs.signature(), cfg_key)
+    fn = _suggest_jit_cache.get(key)
+    if fn is None:
+        propose = build_propose(cs, cfg)
+
+        def run(history, rows, seed_words, ids):
+            hist = _apply_rows(cs.labels, history, rows)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed_words[0]), seed_words[1]
+            )
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+            out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
+            return hist, rand.pack_labels(cs, out)
+
+        fn = _suggest_jit_cache[key] = jax.jit(run)
+    return fn
+
+
+def _seed_words(seed):
+    """(low 32 bits, high 32 bits) of an integer seed, for in-trace key
+    derivation matching ``rand.seed_to_key``'s full-width semantics."""
+    seed = int(seed)
+    return np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
 
 
 # ---------------------------------------------------------------------------
@@ -518,17 +582,14 @@ def suggest(
         "LF": int(linear_forgetting),
     }
     cfg_key = tuple(sorted(cfg.items()))
-    history = trials.padded_history(domain.cs.labels)
-    hist_arrays = {
-        "losses": history["losses"],
-        "has_loss": history["has_loss"],
-        "vals": history["vals"],
-        "active": history["active"],
-    }
+    ph = trials.history_object(domain.cs.labels)
+    dev, rows = ph.device_state()
 
-    propose = _get_propose_jit(domain, cfg_key, cfg)
-    keys = rand.fold_ids(rand.seed_to_key(seed), new_ids)
-    batch = propose(hist_arrays, keys)
-    host = {k: np.asarray(v) for k, v in batch.items()}
-    flats = [{k: host[k][i].item() for k in host} for i in range(len(new_ids))]
+    # ONE device program (fold completed trials + propose whole queue) and
+    # one single-buffer readback; the updated history stays device-resident
+    run = _get_suggest_jit(domain, cfg_key, cfg)
+    ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
+    new_dev, mat = run(dev, rows, _seed_words(seed), ids)
+    ph.commit_device(new_dev)
+    flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
     return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

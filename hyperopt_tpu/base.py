@@ -78,7 +78,10 @@ STATUS_OK = "ok"
 STATUS_FAIL = "fail"
 STATUS_STRINGS = (STATUS_NEW, STATUS_RUNNING, STATUS_SUSPENDED, STATUS_OK, STATUS_FAIL)
 
-_MIN_CAP = 64  # smallest padded-history capacity bucket
+# Smallest padded-history capacity bucket.  128 keeps a standard ~100-eval
+# run inside ONE bucket — a growth recompile of the TPE kernel costs seconds
+# on a remote-compiled TPU, far more than the few KB of extra padding.
+_MIN_CAP = 128
 
 
 def coarse_utcnow():
@@ -187,14 +190,53 @@ def _bucket_cap(n: int) -> int:
     return cap
 
 
+_updater_cache = {}  # (labels, cap) -> jitted single-row history update
+
+
+def _get_history_updater(labels, cap):
+    """One jitted program that folds a packed trial row into every device
+    array of the history — ONE dispatch per completed trial instead of
+    2·L+2 separate ``.at[]`` updates (which each cost a host↔device round
+    trip over a tunneled accelerator)."""
+    key = (labels, cap)
+    fn = _updater_cache.get(key)
+    if fn is None:
+        L = len(labels)
+
+        def update(dev, row):
+            # row layout: [vals(L), active(L), loss, has_loss, index]
+            i = row[2 * L + 2].astype(jnp.int32)
+            return {
+                "vals": {
+                    l: dev["vals"][l].at[i].set(row[j])
+                    for j, l in enumerate(labels)
+                },
+                "active": {
+                    l: dev["active"][l].at[i].set(row[L + j] > 0.5)
+                    for j, l in enumerate(labels)
+                },
+                "losses": dev["losses"].at[i].set(row[2 * L]),
+                "has_loss": dev["has_loss"].at[i].set(row[2 * L + 1] > 0.5),
+            }
+
+        fn = _updater_cache[key] = jax.jit(update)
+    return fn
+
+
 class PaddedHistory:
     """Dense, padded structure-of-arrays view of trial history.
 
-    This is what the jitted suggesters consume: for each label an
+    This is what the jitted suggesters consume: for each label a
     ``(vals[cap], active[cap])`` pair plus ``losses[cap]`` and the live count
     ``n``.  Padding slots have ``active=False`` and ``loss=+inf``; capacities
     are power-of-two buckets so kernel shapes are stable.  The dense analog of
     the reference's sparse per-label ``(idxs, vals)`` (SURVEY.md §7.1).
+
+    The numpy arrays are the source of truth (cheap host appends, pickling);
+    ``device_view`` maintains a **device-resident mirror** so the per-suggest
+    cost is one incremental update dispatch, not a re-upload of every array
+    (the round-2 host-loop bottleneck: ~2·L+2 transfers per proposal over
+    the TPU tunnel).
     """
 
     def __init__(self, labels):
@@ -205,6 +247,8 @@ class PaddedHistory:
         self._active = {l: np.zeros(self.cap, bool) for l in self.labels}
         self._losses = np.full(self.cap, np.inf, np.float32)
         self._has_loss = np.zeros(self.cap, bool)
+        self._dev = None  # device mirror of the arrays above
+        self._dev_synced = 0  # rows folded into the mirror
 
     def _grow(self, need):
         new_cap = _bucket_cap(need)
@@ -217,6 +261,7 @@ class PaddedHistory:
         self._losses = np.concatenate([self._losses, np.full(pad, np.inf, np.float32)])
         self._has_loss = np.concatenate([self._has_loss, np.zeros(pad, bool)])
         self.cap = new_cap
+        self._dev = None  # shapes changed: full re-upload at next view
 
     def append(self, flat_vals: dict, loss):
         """Record one finished trial (flat {label: value}; absent = inactive)."""
@@ -231,16 +276,75 @@ class PaddedHistory:
             self._has_loss[i] = True
         self.n += 1
 
-    def device_view(self):
-        """Arrays for the jitted kernels (converted lazily by jnp.asarray)."""
-        return {
-            "vals": {l: self._vals[l] for l in self.labels},
-            "active": {l: self._active[l] for l in self.labels},
-            "losses": self._losses,
-            "has_loss": self._has_loss,
-            "n": self.n,
-            "cap": self.cap,
+    def _pack_row(self, i):
+        L = len(self.labels)
+        row = np.empty(2 * L + 3, np.float32)
+        for j, l in enumerate(self.labels):
+            row[j] = self._vals[l][i]
+            row[L + j] = 1.0 if self._active[l][i] else 0.0
+        row[2 * L] = self._losses[i]
+        row[2 * L + 1] = 1.0 if self._has_loss[i] else 0.0
+        row[2 * L + 2] = float(i)  # cap ≤ 2^24: exact in f32
+        return row
+
+    _ROW_BUCKETS = (1, 2, 4, 8, 16)
+
+    def _full_upload(self):
+        self._dev = {
+            "vals": {l: jnp.asarray(self._vals[l]) for l in self.labels},
+            "active": {l: jnp.asarray(self._active[l]) for l in self.labels},
+            "losses": jnp.asarray(self._losses),
+            "has_loss": jnp.asarray(self._has_loss),
         }
+        self._dev_synced = self.n
+
+    def device_state(self):
+        """``(dev, rows)`` for FUSED update+propose kernels.
+
+        ``dev`` is the device mirror as of the last commit; ``rows`` is a
+        ``[K, 2L+3]`` float32 matrix of trials not yet folded into it,
+        K padded to a small bucket so kernels retrace O(1) times.  Padding
+        rows carry ``index = cap`` so ``.at[i].set(..., mode='drop')``
+        ignores them in-trace.  The caller applies ``rows`` inside its own
+        program (saving one device program per ask→tell iteration — on a
+        tunneled TPU each program costs tens of ms of completion latency)
+        and hands the updated mirror back via :meth:`commit_device`.
+        """
+        delta = self.n - self._dev_synced
+        if self._dev is None or delta > self._ROW_BUCKETS[-1]:
+            self._full_upload()
+            delta = 0
+        K = next(b for b in self._ROW_BUCKETS if b >= max(delta, 1))
+        L = len(self.labels)
+        rows = np.zeros((K, 2 * L + 3), np.float32)
+        rows[:, 2 * L + 2] = float(self.cap)  # default: dropped no-op
+        for j, i in enumerate(range(self._dev_synced, self.n)):
+            rows[j] = self._pack_row(i)
+        self._pending_commit_n = self.n
+        return self._dev, rows
+
+    def commit_device(self, dev):
+        """Adopt a kernel-updated mirror (see :meth:`device_state`)."""
+        self._dev = dev
+        self._dev_synced = self._pending_commit_n
+
+    def device_view(self):
+        """Device-resident arrays for the jitted kernels, synced incrementally
+        (one fused update dispatch per new row; full upload only on capacity
+        growth or first use)."""
+        if self._dev is None:
+            self._full_upload()
+        elif self._dev_synced < self.n:
+            delta = self.n - self._dev_synced
+            if delta > 16:
+                # many rows landed at once (batch eval): re-upload wholesale
+                self._dev = None
+                return self.device_view()
+            update = _get_history_updater(self.labels, self.cap)
+            for i in range(self._dev_synced, self.n):
+                self._dev = update(self._dev, self._pack_row(i))
+            self._dev_synced = self.n
+        return {**self._dev, "n": self.n, "cap": self.cap}
 
 
 class Ctrl:
@@ -486,8 +590,12 @@ class Trials:
     # -- padded SoA history (TPU-native addition) --------------------------
 
     def padded_history(self, labels):
+        """Device view of the folded history (see :meth:`history_object`)."""
+        return self.history_object(labels).device_view()
+
+    def history_object(self, labels):
         """Incrementally fold DONE trials into the dense padded history and
-        return its device view.  O(new + in-flight trials) per call.
+        return the :class:`PaddedHistory`.  O(new + in-flight trials) per call.
 
         With an asynchronous backend completions arrive out of order, so a
         single watermark would let one slow in-flight trial hide every later
@@ -523,7 +631,7 @@ class Trials:
                 self._history_pending.append(doc)
             else:
                 fold(doc)
-        return self._history.device_view()
+        return self._history
 
     def fmin(
         self,

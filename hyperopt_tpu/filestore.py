@@ -1,0 +1,348 @@
+"""Durable cross-process trial store + driver-side Trials backend.
+
+Parity target: ``hyperopt/mongoexp.py`` (sym: MongoJobs ≈L150-500 — atomic
+``reserve`` via find_one_and_update, ``new_trial_ids`` via counter doc;
+MongoTrials ≈L500-800 — asynchronous=True, exp_key scoping, attachments).
+The reference gets durability and single-claim semantics from MongoDB; here
+both come from the filesystem, which every TPU pod slice already shares via
+NFS/GCS-fuse mounts:
+
+* **Durability** — every trial document is its own pickle file; a crashed
+  driver or worker loses nothing that was written.
+* **Atomic claim** — claiming NEW→RUNNING is ``os.rename(new/<tid>.pkl,
+  running/<tid>.pkl)``: POSIX rename is atomic, exactly one claimant wins
+  (the ``find_one_and_update`` analog).  No daemon required.
+* **Heartbeats & reclaim** — workers rewrite their RUNNING doc's
+  ``refresh_time`` periodically (MongoWorker's heartbeat thread); anyone may
+  move a RUNNING doc whose heartbeat is older than ``reserve_timeout`` back
+  to NEW (stale-claim recovery, which upstream leaves as a manual query).
+* **Counter** — trial ids come from a byte-length-encoded counter file under
+  an ``fcntl`` lock (the atomic counter-doc increment).
+
+Layout of a store directory::
+
+    store/
+      counter           monotonically increasing tid allocator (fcntl-locked)
+      attachments/      named blobs: FMinIter_Domain is the cloudpickled Domain
+      new/<tid>.pkl     queued trial documents
+      running/<tid>.pkl claimed documents (owner, book_time, refresh_time set)
+      done/<tid>.pkl    finished documents (result filled in)
+      error/<tid>.pkl   crashed documents (misc['error'] set)
+
+Workers are real processes: ``python -m hyperopt_tpu.worker --store DIR``
+(console script ``hyperopt-tpu-worker``), the ``hyperopt-mongo-worker``
+analog — see ``worker.py``.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import pickle
+import time
+
+from .base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Trials,
+    coarse_utcnow,
+)
+
+__all__ = ["FileStore", "FileTrials", "ReserveTimeout"]
+
+logger = logging.getLogger(__name__)
+
+_STATE_DIRS = {
+    JOB_STATE_NEW: "new",
+    JOB_STATE_RUNNING: "running",
+    JOB_STATE_DONE: "done",
+    JOB_STATE_ERROR: "error",
+}
+
+
+class ReserveTimeout(Exception):
+    """No job could be reserved within the allotted time
+    (hyperopt/mongoexp.py sym: ReserveTimeout)."""
+
+
+def _atomic_write(path, payload: bytes):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+class FileStore:
+    """Low-level durable job store (hyperopt/mongoexp.py sym: MongoJobs)."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        for d in ("attachments", *_STATE_DIRS.values()):
+            os.makedirs(os.path.join(self.root, d), exist_ok=True)
+        counter = os.path.join(self.root, "counter")
+        if not os.path.exists(counter):
+            _atomic_write(counter, b"0")
+
+    # -- tid allocation (counter-doc analog) ------------------------------
+
+    def new_trial_ids(self, n):
+        path = os.path.join(self.root, "counter")
+        with open(path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                start = int(f.read().strip() or "0")
+                f.seek(0)
+                f.truncate()
+                f.write(str(start + n))
+                f.flush()
+                os.fsync(f.fileno())
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+        return list(range(start, start + n))
+
+    # -- attachments ------------------------------------------------------
+
+    def set_attachment(self, name, blob: bytes):
+        _atomic_write(os.path.join(self.root, "attachments", name), blob)
+
+    def get_attachment(self, name):
+        path = os.path.join(self.root, "attachments", name)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def attachment_names(self):
+        return sorted(os.listdir(os.path.join(self.root, "attachments")))
+
+    # -- doc IO -----------------------------------------------------------
+
+    def _path(self, state, tid):
+        return os.path.join(self.root, _STATE_DIRS[state], f"{tid}.pkl")
+
+    def write_doc(self, doc):
+        """Write (or overwrite) a doc in the directory matching its state."""
+        _atomic_write(self._path(doc["state"], doc["tid"]), pickle.dumps(doc))
+
+    def _read(self, path):
+        try:
+            with open(path, "rb") as f:
+                return pickle.loads(f.read())
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            return None  # raced with a rename / partial write: skip this scan
+
+    def load_all(self):
+        """Every doc in the store, state taken from its directory (a doc
+        mid-rename can appear in neither — the next scan sees it)."""
+        docs = []
+        for state, d in _STATE_DIRS.items():
+            dirpath = os.path.join(self.root, d)
+            for fname in os.listdir(dirpath):
+                if not fname.endswith(".pkl"):
+                    continue
+                doc = self._read(os.path.join(dirpath, fname))
+                if doc is not None:
+                    doc["state"] = state
+                    docs.append(doc)
+        docs.sort(key=lambda d: d["tid"])
+        return docs
+
+    def count(self, states):
+        if isinstance(states, int):
+            states = [states]
+        total = 0
+        for s in states:
+            d = os.path.join(self.root, _STATE_DIRS[s])
+            total += sum(1 for f in os.listdir(d) if f.endswith(".pkl"))
+        return total
+
+    # -- claim / finish (the Mongo find_one_and_update analog) ------------
+
+    def reserve(self, owner):
+        """Atomically claim one NEW job: rename into running/ (exactly one
+        claimant can win the rename), then stamp owner/book_time.  Returns
+        the claimed doc or None."""
+        new_dir = os.path.join(self.root, "new")
+        for fname in sorted(os.listdir(new_dir)):
+            if not fname.endswith(".pkl"):
+                continue
+            tid = fname[:-4]
+            src = os.path.join(new_dir, fname)
+            dst = os.path.join(self.root, "running", fname)
+            try:
+                os.rename(src, dst)
+            except FileNotFoundError:
+                continue  # another claimant won this one
+            doc = self._read(dst)
+            if doc is None:
+                continue
+            now = coarse_utcnow()
+            doc["state"] = JOB_STATE_RUNNING
+            doc["owner"] = owner
+            doc["book_time"] = now
+            doc["refresh_time"] = now
+            _atomic_write(dst, pickle.dumps(doc))
+            return doc
+        return None
+
+    def heartbeat(self, doc):
+        """Bump refresh_time on a RUNNING doc (MongoWorker heartbeat)."""
+        doc["refresh_time"] = coarse_utcnow()
+        path = self._path(JOB_STATE_RUNNING, doc["tid"])
+        if os.path.exists(path):
+            _atomic_write(path, pickle.dumps(doc))
+
+    def finish(self, doc, result=None, error=None):
+        """RUNNING → DONE/ERROR: write the terminal doc, then remove the
+        running file (write-then-remove so a crash between the two leaves a
+        duplicate, never a loss; load_all keeps the terminal state last)."""
+        tid = doc["tid"]
+        doc["refresh_time"] = coarse_utcnow()
+        if error is not None:
+            doc["state"] = JOB_STATE_ERROR
+            doc["misc"]["error"] = (str(type(error)), str(error))
+        else:
+            doc["state"] = JOB_STATE_DONE
+            doc["result"] = result
+        self.write_doc(doc)
+        try:
+            os.remove(self._path(JOB_STATE_RUNNING, tid))
+        except FileNotFoundError:
+            pass
+
+    def reclaim_stale(self, reserve_timeout):
+        """Move RUNNING docs whose heartbeat is older than reserve_timeout
+        seconds back to NEW (worker died mid-trial).  Returns count."""
+        n = 0
+        run_dir = os.path.join(self.root, "running")
+        for fname in os.listdir(run_dir):
+            if not fname.endswith(".pkl"):
+                continue
+            path = os.path.join(run_dir, fname)
+            doc = self._read(path)
+            if doc is None or doc.get("refresh_time") is None:
+                continue
+            age = (coarse_utcnow() - doc["refresh_time"]).total_seconds()
+            if age < reserve_timeout:
+                continue
+            doc["state"] = JOB_STATE_NEW
+            doc["owner"] = None
+            dst = self._path(JOB_STATE_NEW, doc["tid"])
+            _atomic_write(dst, pickle.dumps(doc))
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+            logger.warning("reclaimed stale trial %s (heartbeat %.0fs old)",
+                           doc["tid"], age)
+            n += 1
+        return n
+
+
+class FileTrials(Trials):
+    """Driver-side Trials over a FileStore (mongoexp.py sym: MongoTrials).
+
+    ``asynchronous=True``: the driver inserts NEW docs and polls; separate
+    worker *processes* (``hyperopt-tpu-worker``) evaluate them.  Docs are
+    updated in place on refresh so the incremental padded-history fold (and
+    its out-of-order pending set) keeps working across process boundaries.
+    """
+
+    asynchronous = True
+    poll_interval_secs = 0.1
+
+    def __init__(self, root, exp_key=None, refresh=True):
+        self.store = FileStore(root)
+        self._docs_by_tid = {}
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    @property
+    def attachments(self):
+        return _StoreAttachments(self.store)
+
+    @attachments.setter
+    def attachments(self, value):
+        for k, v in dict(value).items():
+            self.store.set_attachment(k, _to_bytes(v))
+
+    def refresh(self):
+        for doc in self.store.load_all():
+            mine = self._docs_by_tid.get(doc["tid"])
+            if mine is None:
+                self._docs_by_tid[doc["tid"]] = doc
+                self._dynamic_trials.append(doc)
+            elif doc["state"] != mine["state"] or doc["state"] == JOB_STATE_RUNNING:
+                mine.update(doc)  # in place: history folding tracks identity
+        super().refresh()
+
+    def insert_trial_doc(self, doc):
+        doc = dict(doc)
+        self.store.write_doc(doc)
+        if doc["tid"] not in self._docs_by_tid:
+            self._docs_by_tid[doc["tid"]] = doc
+            self._dynamic_trials.append(doc)
+        return doc["tid"]
+
+    def insert_trial_docs(self, docs):
+        return [self.insert_trial_doc(d) for d in docs]
+
+    def new_trial_ids(self, n):
+        return self.store.new_trial_ids(n)
+
+    def count_by_state_unsynced(self, arg):
+        return self.store.count(arg)
+
+    def delete_all(self):
+        import shutil
+
+        shutil.rmtree(self.store.root)
+        self.store = FileStore(self.store.root)
+        self._docs_by_tid = {}
+        self._dynamic_trials = []
+        self._ids = set()
+        self._history = None
+        self._history_synced = 0
+        self._history_pending = []
+        self.refresh()
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state.pop("attachments", None)  # lives in the store, not the pickle
+        return state
+
+
+def _to_bytes(v):
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    import cloudpickle
+
+    return cloudpickle.dumps(v)
+
+
+class _StoreAttachments:
+    """Dict-like view over the store's attachment blobs (GridFS analog)."""
+
+    def __init__(self, store):
+        self._store = store
+
+    def __contains__(self, k):
+        return self._store.get_attachment(k) is not None
+
+    def __getitem__(self, k):
+        blob = self._store.get_attachment(k)
+        if blob is None:
+            raise KeyError(k)
+        return blob
+
+    def get(self, k, default=None):
+        blob = self._store.get_attachment(k)
+        return default if blob is None else blob
+
+    def __setitem__(self, k, v):
+        self._store.set_attachment(k, _to_bytes(v))
+
+    def keys(self):
+        return self._store.attachment_names()

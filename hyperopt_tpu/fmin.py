@@ -121,7 +121,12 @@ class FMinIter:
         self.trials = trials
         self.asynchronous = trials.asynchronous if asynchronous is None else asynchronous
         self.rstate = rstate
-        self.max_queue_len = max_queue_len
+        # an async backend knows how many trials it can usefully run at once
+        # (the SparkTrials-parallelism pattern); proposals for the whole queue
+        # are one vmapped device dispatch, so a deeper queue is ~free
+        self.max_queue_len = max(
+            max_queue_len, getattr(trials, "default_max_queue_len", 1)
+        )
         # precedence: explicit argument > backend attribute > 1.0s default.
         # An async Trials backend may dictate its own polling cadence (the
         # SparkTrials pattern); in-process pools poll much faster than a DB.

@@ -57,6 +57,12 @@ class ExecutorTrials(Trials):
     asynchronous = True
     poll_interval_secs = 0.05  # in-process pool: poll fast (FMinIter reads this)
 
+    @property
+    def default_max_queue_len(self):
+        """FMinIter queues at least this many outstanding suggestions so the
+        pool stays saturated (the SparkTrials-parallelism analog)."""
+        return self.n_workers
+
     def __init__(self, n_workers=4, traceable=False, exp_key=None, refresh=True):
         self.n_workers = int(n_workers)
         self.traceable = bool(traceable)

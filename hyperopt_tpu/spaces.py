@@ -340,6 +340,20 @@ class CompiledSpace:
         self.labels: tuple[str, ...] = tuple(self.params.keys())
         self._sample_flat_jit = None  # compiled lazily; dropped on pickle
 
+    def signature(self):
+        """Canonical hashable key of the param table.  Two CompiledSpace
+        instances over the same user space share it, so suggesters key their
+        module-level jit caches on this — repeated ``fmin`` calls (each of
+        which builds a fresh Domain) reuse compiled kernels instead of
+        retracing."""
+        sig = getattr(self, "_signature", None)
+        if sig is None:
+            sig = self._signature = tuple(
+                (i.label, i.dist.family, i.dist.params, i.cast, i.conditions)
+                for i in self.params.values()
+            )
+        return sig
+
     # pickle support: jitted handles are process-local, rebuild lazily.  This
     # is what makes Domain (and thus fmin's trials_save_file checkpoint, which
     # stores the live Domain in trials.attachments) picklable.

@@ -1,0 +1,150 @@
+"""Standalone evaluation worker over a FileStore.
+
+Parity target: ``hyperopt/mongoexp.py`` (sym: MongoWorker.run_one ≈L800-1000,
+main_worker / main_worker_helper — the ``hyperopt-mongo-worker`` CLI).  A
+worker process loops: reclaim stale claims → atomically reserve one NEW job →
+unpickle the Domain from the store's ``FMinIter_Domain`` attachment →
+evaluate with a heartbeat thread bumping ``refresh_time`` → write DONE/ERROR.
+Exits after ``--max-consecutive-failures`` consecutive errors or
+``--reserve-timeout`` seconds without work, exactly like the reference CLI.
+
+Run as ``hyperopt-tpu-worker --store DIR`` (console script) or
+``python -m hyperopt_tpu.worker --store DIR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+
+from .base import Ctrl, spec_from_misc
+from .filestore import FileStore, FileTrials, ReserveTimeout
+
+__all__ = ["FileWorker", "main"]
+
+logger = logging.getLogger(__name__)
+
+
+class FileWorker:
+    """One worker loop bound to a store (mongoexp.py sym: MongoWorker)."""
+
+    def __init__(self, store_root, poll_interval=0.25, heartbeat_interval=2.0,
+                 stale_after=30.0, workdir=None):
+        self.store = FileStore(store_root)
+        self.store_root = store_root
+        self.poll_interval = float(poll_interval)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.stale_after = float(stale_after)
+        self.workdir = workdir
+        self.owner = f"{socket.gethostname()}:{os.getpid()}"
+        self._domain = None
+
+    def _get_domain(self):
+        if self._domain is None:
+            blob = self.store.get_attachment("FMinIter_Domain")
+            if blob is None:
+                return None
+            import cloudpickle
+
+            self._domain = cloudpickle.loads(blob)
+        return self._domain
+
+    def run_one(self, reserve_timeout=None):
+        """Reserve and evaluate one job (mongoexp.py sym: MongoWorker.run_one).
+        Raises ReserveTimeout if nothing could be claimed in time."""
+        deadline = None if reserve_timeout is None else time.time() + reserve_timeout
+        while True:
+            self.store.reclaim_stale(self.stale_after)
+            doc = self.store.reserve(self.owner)
+            if doc is not None:
+                break
+            if deadline is not None and time.time() >= deadline:
+                raise ReserveTimeout(f"no job within {reserve_timeout}s")
+            time.sleep(self.poll_interval)
+
+        domain = self._get_domain()
+        if domain is None:
+            # job exists but the driver hasn't attached the domain yet: put
+            # the claim back and wait
+            doc["state"] = 0
+            doc["owner"] = None
+            self.store.write_doc(doc)
+            try:
+                os.remove(self.store._path(1, doc["tid"]))
+            except FileNotFoundError:
+                pass
+            time.sleep(self.poll_interval)
+            return False
+
+        stop = threading.Event()
+
+        def beat():
+            while not stop.wait(self.heartbeat_interval):
+                self.store.heartbeat(doc)
+
+        hb = threading.Thread(target=beat, daemon=True)
+        hb.start()
+        try:
+            spec = spec_from_misc(doc["misc"])
+            trials = FileTrials(self.store_root, refresh=False)
+            result = domain.evaluate(spec, Ctrl(trials, current_trial=doc))
+        except Exception as e:
+            logger.error("job %s failed: %s", doc["tid"], e)
+            self.store.finish(doc, error=e)
+            return False
+        finally:
+            stop.set()
+            hb.join(timeout=5)
+        self.store.finish(doc, result=result)
+        return True
+
+
+def main(argv=None):
+    """CLI entry point (mongoexp.py sym: main_worker)."""
+    p = argparse.ArgumentParser(prog="hyperopt-tpu-worker")
+    p.add_argument("--store", required=True, help="FileStore directory")
+    p.add_argument("--poll-interval", type=float, default=0.25)
+    p.add_argument("--heartbeat-interval", type=float, default=2.0)
+    p.add_argument("--stale-after", type=float, default=30.0,
+                   help="reclaim RUNNING jobs with heartbeats older than this")
+    p.add_argument("--max-consecutive-failures", type=int, default=4)
+    p.add_argument("--reserve-timeout", type=float, default=120.0,
+                   help="exit after this long without claiming a job")
+    p.add_argument("--max-jobs", type=int, default=sys.maxsize)
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    worker = FileWorker(
+        args.store,
+        poll_interval=args.poll_interval,
+        heartbeat_interval=args.heartbeat_interval,
+        stale_after=args.stale_after,
+        workdir=args.workdir,
+    )
+    consecutive_failures = 0
+    done = 0
+    while done < args.max_jobs:
+        try:
+            ok = worker.run_one(reserve_timeout=args.reserve_timeout)
+        except ReserveTimeout:
+            logger.info("reserve timeout; exiting")
+            return 0
+        if ok:
+            consecutive_failures = 0
+            done += 1
+        else:
+            consecutive_failures += 1
+            if consecutive_failures >= args.max_consecutive_failures:
+                logger.error("too many consecutive failures; exiting")
+                return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,24 @@ def _distractor():
     )
 
 
+def _gauss_wave():
+    """Sinusoid under a Gaussian envelope
+    (hyperopt/tests/test_domains.py sym: gauss_wave) — a smooth global basin
+    with high-frequency ripple; TPE must not get stuck on a local ripple."""
+
+    def obj(d):
+        x = d["x"]
+        return -math.exp(-((x / 8.0) ** 2)) * math.cos(x)
+
+    return DomainZoo(
+        name="gauss_wave",
+        space={"x": hp.uniform("x", -20, 20)},
+        objective=obj,
+        loss_target=-0.8,
+        optimum=-1.0,
+    )
+
+
 def _gauss_wave2():
     def obj(d):
         x = d["x"]
@@ -224,6 +242,67 @@ def _many_dists():
     return DomainZoo(name="many_dists", space=space, objective=obj, loss_target=2.5)
 
 
+def _hartmann6_host(x):
+    """Host-numpy Hartmann6 for non-traceable (interactive-loop) domains —
+    keeps per-eval cost off the accelerator dispatch path."""
+    import numpy as np
+
+    alpha = np.array([1.0, 1.2, 3.0, 3.2])
+    A = np.array(
+        [
+            [10, 3, 17, 3.5, 1.7, 8],
+            [0.05, 10, 17, 0.1, 8, 14],
+            [3, 3.5, 1.7, 10, 17, 8],
+            [17, 8, 0.05, 10, 0.1, 14],
+        ]
+    )
+    P = 1e-4 * np.array(
+        [
+            [1312, 1696, 5569, 124, 8283, 5886],
+            [2329, 4135, 8307, 3736, 1004, 9991],
+            [2348, 1451, 3522, 2883, 3047, 6650],
+            [4047, 8828, 8732, 5743, 1091, 381],
+        ]
+    )
+    inner = np.sum(A * (np.asarray(x) - P) ** 2, axis=1)
+    return float(-np.sum(alpha * np.exp(-inner)))
+
+
+def _hr_conditional():
+    """BASELINE config #3: mixed conditional space — ``hp.choice`` dispatches
+    between Hartmann6 (6 uniform dims) and a 20-D Rosenbrock whose scale is
+    an ``hp.loguniform``; TPE must learn both the branch preference and the
+    per-branch posteriors (all via activation masks, SURVEY.md §7.4)."""
+    import numpy as np
+
+    space = hp.choice(
+        "family",
+        [
+            {
+                "kind": "hartmann",
+                "xs": [hp.uniform(f"h{i}", 0, 1) for i in range(6)],
+            },
+            {
+                "kind": "rosen",
+                "xs": [hp.uniform(f"r{i}", -2, 2) for i in range(20)],
+                "scale": hp.loguniform("r_scale", -3, 1),
+            },
+        ],
+    )
+
+    def obj(d):
+        if d["kind"] == "hartmann":
+            return _hartmann6_host(d["xs"])
+        xs = np.asarray(d["xs"]) * d["scale"]
+        return float(
+            np.sum(100.0 * (xs[1:] - xs[:-1] ** 2) ** 2 + (1.0 - xs[:-1]) ** 2)
+        )
+
+    # hartmann branch reaches < -1 quickly; rosen floor is ~0 → a competent
+    # optimizer should commit to the hartmann branch within ~100 evals
+    return DomainZoo(name="hr_conditional", space=space, objective=obj, loss_target=-1.0)
+
+
 ZOO = {
     d.name: d
     for d in (
@@ -232,10 +311,12 @@ ZOO = {
         _q1_choice(),
         _n_arms(),
         _distractor(),
+        _gauss_wave(),
         _gauss_wave2(),
         _branin_domain(),
         _hartmann6_domain(),
         _rosenbrock4(),
         _many_dists(),
+        _hr_conditional(),
     )
 }

@@ -163,7 +163,7 @@ def test_spec_from_misc_skips_inactive():
 
 def test_padded_history_growth_and_masks():
     t = Trials()
-    n = 70  # crosses the 64-slot capacity bucket
+    n = 140  # crosses the 128-slot capacity bucket
     docs = []
     for i in range(n):
         vals = {"x": float(i)} if i % 2 == 0 else {}
@@ -173,7 +173,7 @@ def test_padded_history_growth_and_masks():
     t.refresh()
     h = t.padded_history(("x",))
     assert h["n"] == n
-    assert h["cap"] == 128
+    assert h["cap"] == 256
     assert h["active"]["x"].sum() == (n + 1) // 2
     assert h["has_loss"].sum() == n
     # incremental: appending more only folds the new ones
