@@ -90,9 +90,26 @@ def featurize_trials(trials):
     return feats
 
 
+def _quantize(x, step):
+    return float(np.round(x / step) * step)
+
+
+def _pow2_bucket(x, lo, hi):
+    """Round to the nearest power of two within [lo, hi]."""
+    x = float(np.clip(x, lo, hi))
+    return int(2 ** int(round(math.log2(x))))
+
+
 def predict_tpe_params(space_feats, trial_feats):
     """Map features → TPE tuning (the lightgbm-ensemble analog; see module
     docstring for why this is analytic).  Returns kwargs for ``tpe.suggest``.
+
+    Every output is quantized to a coarse bucket: the fused suggest kernel
+    (tpe._get_suggest_jit) is cached per (space, cfg), so a continuously
+    varying cfg would force a full retrace+compile on every call and grow
+    the jit cache without bound.  Buckets keep the number of distinct
+    compiled kernels per run small (~a dozen) while preserving the
+    adaptive behavior at the granularity that matters.
     """
     d = space_feats["n_params"]
     n = trial_feats["n_trials"]
@@ -105,26 +122,27 @@ def predict_tpe_params(space_feats, trial_feats):
         1.0 - trial_feats["loss_spread"]
     )
     gamma *= 1.0 - 0.4 * trial_feats["recent_improvement"]
-    gamma = float(np.clip(gamma, 0.1, 0.5))
+    gamma = _quantize(np.clip(gamma, 0.1, 0.5), 0.05)
 
     # candidate count: scale with dimensionality and history size — cheap on
     # an accelerator (vmapped axis), so err high; the reference caps at ~24
-    # only because numpy pays per candidate.
-    n_ei = int(np.clip(24 * math.sqrt(max(d, 1)) * (1 + n / 200.0), 24, 512))
+    # only because numpy pays per candidate.  Power-of-two bucket.
+    n_ei = _pow2_bucket(24 * math.sqrt(max(d, 1)) * (1 + n / 200.0), 32, 512)
 
     # linear forgetting: keep the window proportional to history once the
-    # run is long, never below the reference default.
-    lf = int(np.clip(n // 2, 25, 200))
+    # run is long, never below the reference default.  25-wide buckets.
+    lf = int(np.clip(_quantize(n // 2, 25), 25, 200))
 
     # startup: more dimensions need more seeding, conditional spaces more
-    # still (each branch needs observations).
+    # still (each branch needs observations).  (Not part of the kernel cfg —
+    # only compared against len(trials) — but bucket anyway for stability.)
     n_startup = int(
-        np.clip(10 + 2 * d * (1 + space_feats["frac_conditional"]), 15, 60)
+        np.clip(_quantize(10 + 2 * d * (1 + space_feats["frac_conditional"]), 5), 15, 60)
     )
 
     # prior weight: down-weight the prior a little on log-scaled spaces where
     # the uniform-in-log prior is broad relative to useful regions.
-    prior_weight = float(np.clip(1.0 - 0.3 * space_feats["frac_log"], 0.6, 1.0))
+    prior_weight = float(np.clip(_quantize(1.0 - 0.3 * space_feats["frac_log"], 0.1), 0.6, 1.0))
 
     return {
         "gamma": gamma,

@@ -18,7 +18,9 @@ def suggest(new_ids, domain, trials, seed, p_suggest):
     ps = np.asarray([p for p, _ in p_suggest], dtype=float)
     if not np.isclose(ps.sum(), 1.0, atol=1e-6):
         raise ValueError(f"p_suggest probabilities sum to {ps.sum()}, expected 1")
-    rng = np.random.default_rng(int(seed) & 0x7FFFFFFF)
+    # full-width seed: masking to 31 bits would collapse seeds differing only
+    # in high words to identical mix streams (cf. rand.seed_to_key)
+    rng = np.random.default_rng(int(seed))
     docs = []
     for new_id in new_ids:
         idx = int(rng.choice(len(ps), p=ps))

@@ -641,7 +641,7 @@ class Trials:
         max_evals=None,
         timeout=None,
         loss_threshold=None,
-        max_queue_len=1,
+        max_queue_len=None,
         rstate=None,
         verbose=False,
         pass_expr_memo_ctrl=None,

@@ -43,6 +43,7 @@ import pickle
 import time
 
 from .base import (
+    JOB_STATE_CANCEL,
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
@@ -60,6 +61,7 @@ _STATE_DIRS = {
     JOB_STATE_RUNNING: "running",
     JOB_STATE_DONE: "done",
     JOB_STATE_ERROR: "error",
+    JOB_STATE_CANCEL: "cancel",
 }
 
 
@@ -213,11 +215,15 @@ class FileStore:
         except FileNotFoundError:
             pass
 
-    def reclaim_stale(self, reserve_timeout):
+    def reclaim_stale(self, reserve_timeout, to_cancel=False):
         """Move RUNNING docs whose heartbeat is older than reserve_timeout
-        seconds back to NEW (worker died mid-trial).  Returns count."""
+        seconds back to NEW (worker died mid-trial) — or, with
+        ``to_cancel=True``, to CANCEL instead of retrying (the SparkTrials
+        timeout→JOB_STATE_CANCEL policy for jobs that must not be re-run).
+        Returns count."""
         n = 0
         run_dir = os.path.join(self.root, "running")
+        target = JOB_STATE_CANCEL if to_cancel else JOB_STATE_NEW
         for fname in os.listdir(run_dir):
             if not fname.endswith(".pkl"):
                 continue
@@ -228,18 +234,40 @@ class FileStore:
             age = (coarse_utcnow() - doc["refresh_time"]).total_seconds()
             if age < reserve_timeout:
                 continue
-            doc["state"] = JOB_STATE_NEW
+            doc["state"] = target
             doc["owner"] = None
-            dst = self._path(JOB_STATE_NEW, doc["tid"])
+            dst = self._path(target, doc["tid"])
             _atomic_write(dst, pickle.dumps(doc))
             try:
                 os.remove(path)
             except FileNotFoundError:
                 pass
-            logger.warning("reclaimed stale trial %s (heartbeat %.0fs old)",
-                           doc["tid"], age)
+            logger.warning("reclaimed stale trial %s (heartbeat %.0fs old) -> %s",
+                           doc["tid"], age, _STATE_DIRS[target])
             n += 1
         return n
+
+    def cancel(self, tid):
+        """Move one NEW or RUNNING doc to CANCEL (SparkTrials job-group
+        cancellation analog).  A worker holding the claim will fail its
+        heartbeat/finish harmlessly — the running file is gone.  Returns True
+        if a doc was cancelled."""
+        for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+            src = self._path(state, tid)
+            doc = self._read(src)
+            if doc is None:
+                continue
+            doc["state"] = JOB_STATE_CANCEL
+            doc.setdefault("result", {})
+            doc["result"]["status"] = "fail"
+            doc["refresh_time"] = coarse_utcnow()
+            _atomic_write(self._path(JOB_STATE_CANCEL, tid), pickle.dumps(doc))
+            try:
+                os.remove(src)
+            except FileNotFoundError:
+                pass
+            return True
+        return False
 
 
 class FileTrials(Trials):
@@ -294,6 +322,16 @@ class FileTrials(Trials):
 
     def count_by_state_unsynced(self, arg):
         return self.store.count(arg)
+
+    def cancel_unfinished(self):
+        """NEW/RUNNING → CANCEL in the store (FMinIter calls this when its
+        timeout expires so a dead/hung worker can't wedge the driver)."""
+        for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+            d = os.path.join(self.store.root, _STATE_DIRS[state])
+            for fname in os.listdir(d):
+                if fname.endswith(".pkl"):
+                    self.store.cancel(int(fname[:-4]))
+        self.refresh()
 
     def delete_all(self):
         import shutil

@@ -106,7 +106,7 @@ class FMinIter:
         trials,
         rstate,
         asynchronous=None,
-        max_queue_len=1,
+        max_queue_len=None,
         poll_interval_secs=None,
         max_evals=float("inf"),
         timeout=None,
@@ -121,12 +121,14 @@ class FMinIter:
         self.trials = trials
         self.asynchronous = trials.asynchronous if asynchronous is None else asynchronous
         self.rstate = rstate
-        # an async backend knows how many trials it can usefully run at once
-        # (the SparkTrials-parallelism pattern); proposals for the whole queue
-        # are one vmapped device dispatch, so a deeper queue is ~free
-        self.max_queue_len = max(
-            max_queue_len, getattr(trials, "default_max_queue_len", 1)
-        )
+        # precedence: explicit argument > backend attribute > 1 — mirroring
+        # poll_interval_secs below.  An async backend knows how many trials it
+        # can usefully run at once (the SparkTrials-parallelism pattern), but
+        # an explicit request (e.g. queue depth 1 for fresh-posterior
+        # reference semantics) must never be silently widened.
+        if max_queue_len is None:
+            max_queue_len = getattr(trials, "default_max_queue_len", 1)
+        self.max_queue_len = max_queue_len
         # precedence: explicit argument > backend attribute > 1.0s default.
         # An async Trials backend may dictate its own polling cadence (the
         # SparkTrials pattern); in-process pools poll much faster than a DB.
@@ -183,20 +185,36 @@ class FMinIter:
 
     def block_until_done(self):
         """Poll an asynchronous backend until no NEW/RUNNING trials remain
-        (hyperopt/fmin.py sym: FMinIter.block_until_done)."""
+        (hyperopt/fmin.py sym: FMinIter.block_until_done).
+
+        When the fmin-level ``timeout`` has expired, in-flight trials are
+        cancelled (backends that support it set JOB_STATE_CANCEL) instead of
+        waited on — a hung objective must never wedge the driver
+        (hyperopt/spark.py: job-group cancellation on timeout)."""
         already_printed = False
         if self.asynchronous:
             unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
 
+            def timed_out():
+                return (
+                    self.timeout is not None
+                    and time.time() - self.start_time >= self.timeout
+                )
+
             def get_queue_len():
                 return self.trials.count_by_state_unsynced(unfinished_states)
 
+            cancel = getattr(self.trials, "cancel_unfinished", None)
+            if timed_out() and cancel is not None:
+                cancel()
             qlen = get_queue_len()
             while qlen > 0:
                 if not already_printed and self.verbose:
                     logger.info("Waiting for %d jobs to finish ...", qlen)
                     already_printed = True
                 time.sleep(self.poll_interval_secs)
+                if timed_out() and cancel is not None:
+                    cancel()
                 qlen = get_queue_len()
             self.trials.refresh()
         else:
@@ -346,7 +364,7 @@ def fmin(
     verbose=False,
     return_argmin=True,
     points_to_evaluate=None,
-    max_queue_len=1,
+    max_queue_len=None,
     show_progressbar=True,
     early_stop_fn=None,
     trials_save_file="",

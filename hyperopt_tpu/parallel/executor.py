@@ -35,10 +35,12 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..base import (
+    JOB_STATE_CANCEL,
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
+    STATUS_FAIL,
     STATUS_OK,
     Ctrl,
     Trials,
@@ -63,9 +65,17 @@ class ExecutorTrials(Trials):
         pool stays saturated (the SparkTrials-parallelism analog)."""
         return self.n_workers
 
-    def __init__(self, n_workers=4, traceable=False, exp_key=None, refresh=True):
+    def __init__(self, n_workers=4, traceable=False, timeout=None,
+                 exp_key=None, refresh=True):
         self.n_workers = int(n_workers)
         self.traceable = bool(traceable)
+        # per-trial wall-clock budget (the SparkTrials(timeout=) analog):
+        # a RUNNING trial older than this is moved to JOB_STATE_CANCEL by the
+        # driver's poll loop; the orphaned worker thread's eventual result is
+        # discarded.  Python threads can't be killed — cancellation is a
+        # state-level guarantee (fmin never waits on it), not a CPU reclaim,
+        # matching Spark's job-group cancel semantics at the trial-doc level.
+        self.timeout = timeout
         self._lock = threading.RLock()
         self._pool = None
         self._domain_cache = None
@@ -110,6 +120,8 @@ class ExecutorTrials(Trials):
 
     def _finish(self, trial, result=None, error=None):
         with self._lock:
+            if trial["state"] == JOB_STATE_CANCEL:
+                return  # timed out meanwhile: the late result is discarded
             # write result BEFORE state: the driver thread reads docs without
             # this lock, and must never observe DONE with a stale result
             if error is not None:
@@ -119,6 +131,40 @@ class ExecutorTrials(Trials):
                 trial["result"] = result
                 trial["state"] = JOB_STATE_DONE
             trial["refresh_time"] = coarse_utcnow()
+
+    def _cancel_timed_out(self):
+        """RUNNING → CANCEL for trials over the per-trial budget (SparkTrials
+        timeout policy: hyperopt/spark.py sym: _FMinState timeout handling).
+        Runs under the driver's poll cadence."""
+        if self.timeout is None:
+            return
+        with self._lock:
+            now = coarse_utcnow()
+            for t in self._dynamic_trials:
+                if t["state"] != JOB_STATE_RUNNING or t.get("book_time") is None:
+                    continue
+                if (now - t["book_time"]).total_seconds() >= self.timeout:
+                    t["state"] = JOB_STATE_CANCEL
+                    t["result"] = {"status": STATUS_FAIL}
+                    t["misc"]["error"] = (
+                        "Cancelled",
+                        f"trial exceeded per-trial timeout {self.timeout}s",
+                    )
+                    t["refresh_time"] = now
+                    logger.warning("trial %s cancelled after %ss timeout",
+                                   t["tid"], self.timeout)
+
+    def cancel_unfinished(self):
+        """Move every NEW/RUNNING trial to CANCEL — called by FMinIter when
+        the fmin-level timeout expires so the driver never blocks on a hung
+        in-flight objective (hyperopt/spark.py: job-group cancellation)."""
+        with self._lock:
+            for t in self._dynamic_trials:
+                if t["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+                    t["state"] = JOB_STATE_CANCEL
+                    t["result"] = {"status": STATUS_FAIL}
+                    t["misc"]["error"] = ("Cancelled", "fmin timeout")
+                    t["refresh_time"] = coarse_utcnow()
 
     def _run_one(self, trial):
         """Evaluate one claimed trial (MongoWorker.run_one analog)."""
@@ -205,6 +251,7 @@ class ExecutorTrials(Trials):
         return tids
 
     def refresh(self):
+        self._cancel_timed_out()
         with self._lock:
             super().refresh()
             pending = [
@@ -220,12 +267,17 @@ class ExecutorTrials(Trials):
             super().delete_all()
 
     def count_by_state_unsynced(self, arg):
+        self._cancel_timed_out()
         with self._lock:
             return super().count_by_state_unsynced(arg)
 
-    def shutdown(self):
+    def shutdown(self, wait=True):
         if self._pool is not None:
-            self._pool.shutdown(wait=True)
+            # cancel_futures: queued-but-unstarted work is dropped; running
+            # threads (possibly hung user objectives) are not joined when
+            # wait=False — their results land in already-terminal docs and
+            # are discarded by _finish
+            self._pool.shutdown(wait=wait, cancel_futures=not wait)
             self._pool = None
 
     # pickle: drop pool/lock/caches along with base-class exclusions

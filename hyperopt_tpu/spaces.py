@@ -35,6 +35,7 @@ Distribution semantics match the reference's stochastic nodes
 from __future__ import annotations
 
 import dataclasses
+import math
 import zlib
 from typing import Any, Callable
 
@@ -369,7 +370,10 @@ class CompiledSpace:
             raise InvalidAnnotatedParameter(f"label must be a string: {label!r}")
         if label in self.params:
             raise DuplicateLabel(label)
-        self.params[label] = ParamInfo(label, dist, cast, conditions)
+        info = ParamInfo(label, dist, cast, conditions)
+        if info.is_int:
+            _check_f32_exact_int(info)
+        self.params[label] = info
 
     def _collect(self, node: Expr, conditions: tuple):
         if isinstance(node, Param):
@@ -466,6 +470,31 @@ class CompiledSpace:
         """One structured sample on host (pyll/stochastic.py sym: sample)."""
         flat = {k: np.asarray(v) for k, v in self.sample_flat_jit(key).items()}
         return self.assemble(flat)
+
+
+_F32_EXACT = 2 ** 24  # largest window of exactly representable f32 integers
+
+
+def _check_f32_exact_int(info: ParamInfo):
+    """Integer-family values ride a packed float32 readback
+    (``rand.pack_labels``: one [B, L] buffer = one host↔device transfer per
+    suggest); integers with |value| >= 2**24 would silently round.  Reject
+    such spaces at compile time rather than corrupt values at runtime.
+    Unbounded int-cast families (qnormal/qlognormal) can't be checked
+    statically and keep the documented f32 caveat."""
+    fam, p = info.dist.family, info.dist.params
+    if fam in ("randint", "uniformint", "quniform"):
+        bound = max(abs(float(p[0])), abs(float(p[1])))
+    elif fam == "qloguniform":
+        bound = math.exp(float(p[1]))
+    else:
+        return
+    if bound >= _F32_EXACT:
+        raise InvalidAnnotatedParameter(
+            f"{info.label!r}: integer range |{bound:.3g}| >= 2**24 cannot survive "
+            f"the float32 proposal readback exactly; shift/scale the space "
+            f"(e.g. sample an offset) to keep integer magnitudes below 2**24"
+        )
 
 
 def compile_space(space: Any) -> CompiledSpace:

@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 
-from .base import Ctrl, spec_from_misc
+from .base import Ctrl, JOB_STATE_NEW, JOB_STATE_RUNNING, spec_from_misc
 from .filestore import FileStore, FileTrials, ReserveTimeout
 
 __all__ = ["FileWorker", "main"]
@@ -71,11 +71,11 @@ class FileWorker:
         if domain is None:
             # job exists but the driver hasn't attached the domain yet: put
             # the claim back and wait
-            doc["state"] = 0
+            doc["state"] = JOB_STATE_NEW
             doc["owner"] = None
             self.store.write_doc(doc)
             try:
-                os.remove(self.store._path(1, doc["tid"]))
+                os.remove(self.store._path(JOB_STATE_RUNNING, doc["tid"]))
             except FileNotFoundError:
                 pass
             time.sleep(self.poll_interval)
@@ -89,17 +89,26 @@ class FileWorker:
 
         hb = threading.Thread(target=beat, daemon=True)
         hb.start()
+        error = None
+        result = None
         try:
             spec = spec_from_misc(doc["misc"])
             trials = FileTrials(self.store_root, refresh=False)
             result = domain.evaluate(spec, Ctrl(trials, current_trial=doc))
         except Exception as e:
-            logger.error("job %s failed: %s", doc["tid"], e)
-            self.store.finish(doc, error=e)
-            return False
+            error = e
         finally:
+            # the heartbeat must be fully stopped BEFORE finish() removes
+            # running/<tid>.pkl — a concurrent beat could pass its existence
+            # check and resurrect the file, which reclaim_stale would later
+            # move back to NEW and re-evaluate a finished (or deterministic-
+            # failure) trial
             stop.set()
             hb.join(timeout=5)
+        if error is not None:
+            logger.error("job %s failed: %s", doc["tid"], error)
+            self.store.finish(doc, error=error)
+            return False
         self.store.finish(doc, result=result)
         return True
 

@@ -8,7 +8,14 @@ import time
 import numpy as np
 import pytest
 
-from hyperopt_tpu import JOB_STATE_DONE, JOB_STATE_ERROR, STATUS_OK, fmin, hp
+from hyperopt_tpu import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    STATUS_OK,
+    fmin,
+    hp,
+)
 from hyperopt_tpu.algos import rand, tpe
 from hyperopt_tpu.parallel import ExecutorTrials
 
@@ -201,6 +208,54 @@ def test_executor_trials_pickle_roundtrip():
     t2 = pickle.loads(pickle.dumps(t))
     assert len(t2) == 4
     assert t2.losses() == t.losses()
+
+
+def test_per_trial_timeout_sets_cancel_state():
+    # SURVEY §2.1 spark row: timeout → JOB_STATE_CANCEL.  A sleeping
+    # objective must end CANCEL within the per-trial budget; fast trials
+    # complete normally.
+    t = ExecutorTrials(n_workers=4, timeout=0.5)
+
+    def sometimes_hangs(d):
+        if d["x"] < 0:
+            time.sleep(8)
+        return d["x"] ** 2
+
+    t0 = time.perf_counter()
+    fmin(sometimes_hangs, SPACE, algo=rand.suggest, max_evals=8, trials=t,
+         max_queue_len=8, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    dt = time.perf_counter() - t0
+    t.shutdown(wait=False)
+    states = [d["state"] for d in t._dynamic_trials]
+    assert JOB_STATE_CANCEL in states
+    assert JOB_STATE_DONE in states
+    assert dt < 10, f"driver blocked on hung trial for {dt:.1f}s"
+    cancelled = [d for d in t._dynamic_trials if d["state"] == JOB_STATE_CANCEL]
+    assert all(d["result"]["status"] == "fail" for d in cancelled)
+    # losses() treats cancelled trials as loss-less, argmin still works
+    assert min(l for l in t.losses() if l is not None) >= 0.0
+
+
+def test_fmin_timeout_does_not_block_on_hung_trial():
+    # fmin(timeout=...) used to stop *asking* but wait forever on in-flight
+    # trials; now block_until_done cancels them once the deadline passes
+    t = ExecutorTrials(n_workers=2)
+
+    def hang(d):
+        time.sleep(8)
+        return d["x"]
+
+    t0 = time.perf_counter()
+    fmin(hang, SPACE, algo=rand.suggest, max_evals=4, trials=t, timeout=1,
+         max_queue_len=2, rstate=np.random.default_rng(0),
+         show_progressbar=False, return_argmin=False)
+    dt = time.perf_counter() - t0
+    t.shutdown(wait=False)
+    assert dt < 15, f"fmin blocked {dt:.1f}s past its 1s timeout"
+    assert all(
+        d["state"] in (JOB_STATE_CANCEL,) for d in t._dynamic_trials
+    ), [d["state"] for d in t._dynamic_trials]
 
 
 def test_dispatch_submits_each_trial_once():

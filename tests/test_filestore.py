@@ -209,6 +209,39 @@ def test_filetrials_is_durable_across_handles(tmp_path):
     assert h["n"] == 4
 
 
+def test_store_cancel_and_reclaim_to_cancel(tmp_path):
+    from hyperopt_tpu import JOB_STATE_CANCEL
+
+    store = FileStore(tmp_path / "s")
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(lambda d: d["x"] ** 2, SPACE)
+    _insert_new(t, domain, 3)
+    # cancel a NEW doc directly
+    tids = sorted(d["tid"] for d in t.store.load_all())
+    assert t.store.cancel(tids[0])
+    # claim one, age its heartbeat, reclaim straight to CANCEL
+    doc = t.store.reserve("test-owner")
+    assert doc is not None
+    doc["refresh_time"] = coarse_utcnow() - datetime.timedelta(seconds=60)
+    t.store.heartbeat(doc)  # writes the stale refresh_time back
+    doc["refresh_time"] = coarse_utcnow() - datetime.timedelta(seconds=60)
+    import pickle as _p
+
+    from hyperopt_tpu.filestore import _atomic_write
+
+    _atomic_write(t.store._path(JOB_STATE_RUNNING, doc["tid"]), _p.dumps(doc))
+    assert t.store.reclaim_stale(30, to_cancel=True) == 1
+    t.refresh()
+    states = {d["tid"]: d["state"] for d in t.store.load_all()}
+    assert list(states.values()).count(JOB_STATE_CANCEL) == 2
+    # cancelled docs surface as loss-less fails, not crashes
+    assert t.count_by_state_unsynced(JOB_STATE_CANCEL) == 2
+    # cancel_unfinished sweeps the remaining NEW doc
+    t.cancel_unfinished()
+    assert t.count_by_state_unsynced(JOB_STATE_CANCEL) == 3
+    assert t.count_by_state_unsynced([JOB_STATE_NEW, JOB_STATE_RUNNING]) == 0
+
+
 def test_filetrials_pickle_roundtrip(tmp_path):
     t = FileTrials(tmp_path / "s")
     domain = Domain(lambda d: d["x"] ** 2, SPACE)
