@@ -3,7 +3,7 @@
 Run by the driver on real TPU hardware with the ambient env.  Prints exactly
 ONE JSON line on stdout:
 
-    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+    {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, "backend": "..."}
 
 ``vs_baseline`` is the speedup of the jitted candidate-proposal path over a
 faithful NumPy reimplementation of the reference hot loop
@@ -13,12 +13,25 @@ observation history.  BASELINE.md's north-star target is >=1000x.
 
 Supplementary measurements (Branin fmin wall-clock, per-config details) go
 to stderr as human-readable JSON.
+
+Robustness contract (round-3 postmortem): the ambient TPU backend (a
+tunneled PJRT plugin) can be broken or HUNG on any given day, and a hang
+inside backend init is uncatchable in-process.  Therefore the parent
+process NEVER initializes a jax backend.  It (1) measures the NumPy
+baseline in-process, (2) probes the ambient backend in a timeout-guarded
+subprocess, (3) runs every jax stage in a subprocess that streams one JSON
+line per completed stage (so a late hang preserves earlier results),
+(4) falls back to a forced-CPU subprocess for stages the ambient attempt
+did not produce, and (5) ALWAYS prints the final metric line, tagged with
+the backend that produced it ("tpu", "cpu-fallback", or "none").
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
+import subprocess
 import sys
 import time
 
@@ -115,7 +128,11 @@ def np_tpe_propose(rng, obs_below, obs_above, low, high, n_cand,
 # ---------------------------------------------------------------------------
 
 
-def bench_numpy(n_obs=60, n_cand=24, repeats=20, seed=0):
+def bench_numpy(n_obs=60, n_cand=24, repeats=20, blocks=5, seed=0):
+    """Best-of-``blocks`` timing: the numpy path is short enough that OS
+    scheduling noise dominates a single block (observed 2x swings between
+    runs); the fastest block is the honest baseline — overstating the
+    baseline can only shrink the reported speedup."""
     rng = np.random.default_rng(seed)
     losses = rng.normal(size=n_obs)
     vals = rng.uniform(-5, 5, size=n_obs)
@@ -125,10 +142,13 @@ def bench_numpy(n_obs=60, n_cand=24, repeats=20, seed=0):
     obs_above = vals[order[n_below:]]
     # warmup
     np_tpe_propose(rng, obs_below, obs_above, -5.0, 5.0, n_cand)
-    t0 = time.perf_counter()
-    for _ in range(repeats):
-        np_tpe_propose(rng, obs_below, obs_above, -5.0, 5.0, n_cand)
-    dt = (time.perf_counter() - t0) / repeats
+    best = float("inf")
+    for _ in range(blocks):
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            np_tpe_propose(rng, obs_below, obs_above, -5.0, 5.0, n_cand)
+        best = min(best, (time.perf_counter() - t0) / repeats)
+    dt = best
     return {"proposals_per_sec": 1.0 / dt, "candidates_per_sec": n_cand / dt,
             "n_obs": n_obs, "n_cand": n_cand, "sec_per_proposal": dt}
 
@@ -331,6 +351,125 @@ def bench_parallel_trials(n_trials=10000, repeats=5, seed=0):
             "sec_per_batch": dt, "best_loss_last": best}
 
 
+def bench_parallel_trials_tpe(n_trials=10240, generations=3, hist_cap=1024,
+                              n_cand=64, seed=0):
+    """BASELINE config #5, TPE-DRIVEN (round-3 verdict: the 10k-parallel
+    path must run TPE, not prior sampling).  Generation loop: one jitted
+    program proposes ``n_trials`` candidates from the TPE posterior (vmapped
+    over trial keys), evaluates the traceable Branin objective for all of
+    them, and folds a bounded reservoir (best half + random half, capacity
+    ``hist_cap``) back as the next generation's observation set — the
+    device-scale analog of linear forgetting, keeping the Parzen component
+    count fixed while the trial count scales."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.spaces import compile_space
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    cs = compile_space(dom.space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": n_cand, "gamma": 0.25,
+           "LF": hist_cap}
+    propose = tpe.build_propose(cs, cfg)
+    labels = cs.labels
+
+    def one_generation(hist, gi):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), gi)
+        keys = jax.vmap(lambda j: jax.random.fold_in(key, j))(
+            jnp.arange(n_trials, dtype=jnp.uint32)
+        )
+        flats = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
+        flats = {l: v.astype(jnp.float32) for l, v in flats.items()}
+        losses = jax.vmap(
+            lambda f: dom.objective(cs.assemble(f, traced=True))
+        )(flats)
+        # bounded reservoir for the next posterior: the best hist_cap/2 new
+        # trials plus hist_cap/2 sampled uniformly (the above-model needs
+        # typical points, not only winners)
+        k_res = jax.random.fold_in(key, 0xFFFF)
+        n_best = hist_cap // 2
+        _, best_idx = jax.lax.top_k(-losses, n_best)
+        rand_idx = jax.random.randint(k_res, (hist_cap - n_best,), 0, n_trials)
+        idx = jnp.concatenate([best_idx, rand_idx])
+        new_hist = {
+            "losses": losses[idx],
+            "has_loss": jnp.ones(hist_cap, bool),
+            "vals": {l: flats[l][idx] for l in labels},
+            "active": {l: jnp.ones(hist_cap, bool) for l in labels},
+        }
+        return new_hist, jnp.min(losses)
+
+    gen = jax.jit(one_generation)
+    empty = {
+        "losses": jnp.full(hist_cap, jnp.inf, jnp.float32),
+        "has_loss": jnp.zeros(hist_cap, bool),
+        "vals": {l: jnp.zeros(hist_cap, jnp.float32) for l in labels},
+        "active": {l: jnp.zeros(hist_cap, bool) for l in labels},
+    }
+    hist, best = gen(empty, np.uint32(0))  # compile
+    jax.block_until_ready(best)
+    t0 = time.perf_counter()
+    hist = empty
+    bests = []
+    for gi in range(generations):
+        hist, best = gen(hist, np.uint32(gi))
+        bests.append(best)
+    bests = [float(b) for b in jax.block_until_ready(bests)]
+    dt = time.perf_counter() - t0
+    total = n_trials * generations
+    return {"trials_per_sec": total / dt, "n_trials": total,
+            "generations": generations, "hist_cap": hist_cap,
+            "n_cand_per_trial": n_cand, "sec_total": dt,
+            "best_loss_per_gen": bests,
+            "note": "TPE posterior drives every generation"}
+
+
+def bench_ml_cv(max_evals=64, batch=4096, seed=0):
+    """BASELINE config #4 analog: real-ML objective (4-fold CV logistic
+    regression, pure jnp — zoo.ml_logreg_cv).  Two measurements: (a) batched
+    trial evaluation via ``Domain.make_batch_eval`` — thousands of CV model
+    fits in one device program; (b) HPO quality: the fully on-device fmin
+    tuning lr/l2/momentum."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.base import Domain
+    from hyperopt_tpu.device_fmin import fmin_device
+    from hyperopt_tpu.spaces import compile_space
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["ml_logreg_cv"]
+    cs = compile_space(dom.space)
+
+    # (a) batched evaluation: `batch` full CV fits per dispatch
+    batch_eval = Domain(dom.objective, dom.space).make_batch_eval()
+    keys = jax.vmap(lambda j: jax.random.fold_in(jax.random.PRNGKey(seed), j))(
+        jnp.arange(batch, dtype=jnp.uint32)
+    )
+    flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    losses = batch_eval(flats)
+    jax.block_until_ready(losses)  # compile
+    t0 = time.perf_counter()
+    losses = batch_eval(flats)
+    # diverged fits (lr at the top of the log range) return NaN — real trial
+    # batches contain failures; nanmin is the honest best
+    best_prior = float(jnp.nanmin(jax.block_until_ready(losses)))
+    dt = time.perf_counter() - t0
+
+    # (b) on-device HPO over the CV objective
+    t1 = time.perf_counter()
+    _, best_loss = fmin_device(dom.objective, dom.space, max_evals=max_evals,
+                               seed=seed, n_EI_candidates=64)
+    hpo_dt = time.perf_counter() - t1
+    return {"cv_fits_per_sec": batch / dt, "batch": batch,
+            "sec_per_batch": dt, "best_prior_loss": best_prior,
+            "fmin_device_best_loss": float(best_loss),
+            "fmin_device_evals": max_evals,
+            "fmin_device_sec": hpo_dt, "loss_target": dom.loss_target}
+
+
 _SHARDED_SNIPPET = r"""
 import json, sys, time
 import numpy as np
@@ -387,58 +526,173 @@ def bench_sharded_scaling():
     import subprocess
     import sys as _sys
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    proc = subprocess.run(
-        [_sys.executable, "-c", _SHARDED_SNIPPET],
-        env=env, capture_output=True, text=True, timeout=600,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    if proc.returncode != 0:
-        return {"error": proc.stderr[-500:]}
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    env = _forced_cpu_env(os.environ, n_devices=8)
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", _SHARDED_SNIPPET],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr[-500:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # timeout/empty stdout must not kill the metric line
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
-def main():
+# ---------------------------------------------------------------------------
+# hang-proof orchestration (see module docstring)
+# ---------------------------------------------------------------------------
+
+# every jax-touching stage, in the order the child runs them.  Each entry:
+# (stage name, thunk).  Thunks are resolved inside the child process only.
+_JAX_STAGES = (
+    ("jax_same_grid", lambda: bench_jax(n_cand=24)),
+    ("jax_scaled", lambda: bench_jax(n_cand=8192)),
+    ("jax_batched", lambda: bench_jax(n_cand=8192, batch=64, repeats=20)),
+    ("branin_device_1000", bench_branin_device),
+    ("branin_fmin_tpe", bench_branin_fmin),
+    ("hr_conditional_tpe", bench_hr_conditional),
+    ("parallel_trials_10k", bench_parallel_trials),
+    ("parallel_trials_10k_tpe", bench_parallel_trials_tpe),
+    ("ml_cv", bench_ml_cv),
+)
+
+_PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp; d = jax.devices(); "
+    "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
+def _forced_cpu_env(env, n_devices=None):
+    from hyperopt_tpu._env import forced_cpu_env
+
+    return forced_cpu_env(env, n_devices)
+
+
+def _probe_backend(timeout=120):
+    """Return the ambient jax platform name, or None if init fails/hangs."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("PROBE_OK"):
+            return line.split()[1]
+    return None
+
+
+def _jax_stage_child(only=None):
+    """Child mode: run jax stages (all, or just ``only``), one flushed JSON
+    line per stage."""
+    import jax
+
     # persistent XLA compilation cache: a fresh bench process pays compile
     # time only the first time a given kernel shape is ever seen on this
     # machine (jit caches are per-process; the disk cache is not)
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    platform = jax.devices()[0].platform
+
+    stages = [(n, t) for n, t in _JAX_STAGES if only is None or n in only]
+    for name, thunk in stages:
+        try:
+            result = thunk()
+            result.setdefault("backend", platform)
+            rec = {"stage": name, "ok": True, "result": result}
+        except Exception as e:  # a stage failure must not kill later stages
+            rec = {"stage": name, "ok": False,
+                   "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps(rec, default=float), flush=True)
+
+
+def _run_stage_child(env, timeout, only=None):
+    """Run the stage child; return {stage: record} for whatever completed.
+
+    A hang is handled by the timeout: the child is killed and the stages it
+    already flushed are recovered from the partial stdout.
+    """
+    cmd = [sys.executable, os.path.abspath(__file__), "--jax-stages"]
+    if only:
+        cmd += list(only)
     try:
-        import jax
+        proc = subprocess.run(
+            cmd,
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        out, err = proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        print(f"bench: stage child timed out after {timeout}s", file=sys.stderr)
+    stages = {}
+    for line in (out or "").splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "stage" in rec:
+            stages[rec["stage"]] = rec
+    if not stages and err:
+        print(f"bench: stage child stderr tail:\n{err[-2000:]}", file=sys.stderr)
+    return stages
 
-        jax.config.update("jax_compilation_cache_dir",
-                          "/root/repo/.jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:
-        pass
 
+def main():
     detail = {}
     detail["numpy_cpu"] = bench_numpy()
-    detail["jax_same_grid"] = bench_jax(n_cand=24)
-    detail["jax_scaled"] = bench_jax(n_cand=8192)
-    detail["jax_batched"] = bench_jax(n_cand=8192, batch=64, repeats=20)
-    detail["branin_device_1000"] = bench_branin_device()
-    detail["branin_fmin_tpe"] = bench_branin_fmin()
-    detail["hr_conditional_tpe"] = bench_hr_conditional()
-    detail["parallel_trials_10k"] = bench_parallel_trials()
+
+    platform = _probe_backend()
+    stages = {}
+    if platform is not None:
+        stages = _run_stage_child(dict(os.environ), timeout=1500)
+    missing = [n for n, _ in _JAX_STAGES
+               if not stages.get(n, {}).get("ok")]
+    if missing:
+        print(f"bench: retrying stages on forced CPU: {missing}",
+              file=sys.stderr)
+        cpu_stages = _run_stage_child(_forced_cpu_env(os.environ),
+                                      timeout=1200, only=missing)
+        for n in missing:
+            rec = cpu_stages.get(n)
+            if rec and rec.get("ok"):
+                rec["result"]["backend"] = "cpu-fallback"
+                stages[n] = rec
+
+    for name, _ in _JAX_STAGES:
+        rec = stages.get(name)
+        detail[name] = (rec["result"] if rec and rec.get("ok")
+                        else {"error": (rec or {}).get("error", "not run")})
     detail["sharded_scaling_cpu_mesh"] = bench_sharded_scaling()
     print(json.dumps(detail, indent=2, default=float), file=sys.stderr)
 
-    speedup = (
-        detail["jax_batched"]["candidates_per_sec"]
-        / detail["numpy_cpu"]["candidates_per_sec"]
-    )
+    headline = stages.get("jax_batched")
+    if headline and headline.get("ok"):
+        cps = headline["result"]["candidates_per_sec"]
+        backend = headline["result"].get("backend", "unknown")
+        speedup = cps / detail["numpy_cpu"]["candidates_per_sec"]
+    else:
+        # total jax failure: still emit the line so the round records data
+        cps = detail["numpy_cpu"]["candidates_per_sec"]
+        backend = "none"
+        speedup = 1.0
     print(json.dumps({
         "metric": "tpe_candidate_proposal_throughput",
-        "value": round(detail["jax_batched"]["candidates_per_sec"], 1),
+        "value": round(cps, 1),
         "unit": "candidates/sec",
         "vs_baseline": round(speedup, 2),
+        "backend": backend,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if "--jax-stages" in sys.argv:
+        names = sys.argv[sys.argv.index("--jax-stages") + 1:]
+        _jax_stage_child(only=set(names) or None)
+    else:
+        main()

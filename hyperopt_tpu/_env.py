@@ -1,0 +1,40 @@
+"""Shared subprocess-environment recipe for forced virtual-CPU JAX.
+
+Used by every harness path that must NOT touch the ambient accelerator
+(bench fallback, multi-chip dryrun, sharded-scaling child, worker tests):
+the ambient env may carry a site accelerator plugin (keyed off
+``PALLAS_AXON_POOL_IPS``) whose broken tunnel hangs backend init
+uncatchably, so these paths run in clean subprocesses on virtual CPU
+devices.  One definition — the recipe drifted when it was hand-copied
+per call site.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["forced_cpu_env"]
+
+
+def forced_cpu_env(environ, n_devices=None):
+    """Copy ``environ`` with JAX pinned to CPU (and, optionally, an
+    ``n_devices``-wide virtual device pool via XLA_FLAGS).
+
+    An existing ``--xla_force_host_platform_device_count`` flag is REPLACED,
+    not kept: a child process may need a different pool width than the parent
+    that spawned it (e.g. the 8-device dryrun launching 4-device
+    multi-controller children)."""
+    env = dict(environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the site accelerator plugin (keyed off this var) would otherwise
+    # re-register the single real chip instead of virtual CPUs
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if n_devices:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
+        )
+    return env

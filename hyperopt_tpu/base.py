@@ -360,8 +360,15 @@ class Ctrl:
         return self.trials.attachments
 
     def checkpoint(self, result=None):
-        if self.current_trial is not None and result is not None:
+        """Record a partial result for the in-flight trial and persist it
+        through the backend, so a crashed worker's progress survives
+        (hyperopt/base.py sym: Ctrl.checkpoint; the reference's MongoCtrl
+        writes partials to mongod — SURVEY.md §5 checkpoint row)."""
+        if self.current_trial is None:
+            return
+        if result is not None:
             self.current_trial["result"] = result
+        self.trials.checkpoint_trial(self.current_trial)
 
     def inject_results(self, specs, results, miscs, new_tids=None):
         if new_tids is None:
@@ -436,6 +443,12 @@ class Trials:
         self.refresh()
 
     # -- id/doc generation -------------------------------------------------
+
+    def checkpoint_trial(self, doc):
+        """Persist a mid-trial partial result (Ctrl.checkpoint backend hook).
+        In-memory trials share doc objects with the evaluator, so the
+        mutation is already visible; durable backends override this to write
+        the doc through (FileTrials → store, ExecutorTrials → lock+stamp)."""
 
     def new_trial_ids(self, n):
         aa = len(self._ids)

@@ -136,21 +136,38 @@ class FileStore:
         except (FileNotFoundError, EOFError, pickle.UnpicklingError):
             return None  # raced with a rename / partial write: skip this scan
 
+    # residual cross-process races (e.g. a heartbeat re-creating running/
+    # in the instant a cancel renames it away) can leave one tid in two
+    # directories; readers resolve by precedence so a trial is never
+    # double-counted.  DONE over CANCEL: if the work finished anyway,
+    # keeping the result is strictly better than discarding it.
+    _STATE_PRECEDENCE = {
+        JOB_STATE_DONE: 4,
+        JOB_STATE_ERROR: 3,
+        JOB_STATE_CANCEL: 2,
+        JOB_STATE_RUNNING: 1,
+        JOB_STATE_NEW: 0,
+    }
+
     def load_all(self):
         """Every doc in the store, state taken from its directory (a doc
-        mid-rename can appear in neither — the next scan sees it)."""
-        docs = []
+        mid-rename can appear in neither — the next scan sees it).  A tid
+        present in several directories yields ONE doc, by state precedence."""
+        by_tid = {}
         for state, d in _STATE_DIRS.items():
             dirpath = os.path.join(self.root, d)
             for fname in os.listdir(dirpath):
                 if not fname.endswith(".pkl"):
                     continue
                 doc = self._read(os.path.join(dirpath, fname))
-                if doc is not None:
-                    doc["state"] = state
-                    docs.append(doc)
-        docs.sort(key=lambda d: d["tid"])
-        return docs
+                if doc is None:
+                    continue
+                doc["state"] = state
+                prev = by_tid.get(doc["tid"])
+                if (prev is None or self._STATE_PRECEDENCE[state]
+                        > self._STATE_PRECEDENCE[prev["state"]]):
+                    by_tid[doc["tid"]] = doc
+        return sorted(by_tid.values(), key=lambda d: d["tid"])
 
     def count(self, states):
         if isinstance(states, int):
@@ -191,17 +208,36 @@ class FileStore:
         return None
 
     def heartbeat(self, doc):
-        """Bump refresh_time on a RUNNING doc (MongoWorker heartbeat)."""
+        """Bump refresh_time on a RUNNING doc (MongoWorker heartbeat).
+        A cancelled/finished trial is not resurrected: the write is skipped
+        once the running file is gone (and the residual TOCTOU window is
+        absorbed by ``load_all``'s state precedence)."""
         doc["refresh_time"] = coarse_utcnow()
-        path = self._path(JOB_STATE_RUNNING, doc["tid"])
+        tid = doc["tid"]
+        for terminal in (JOB_STATE_CANCEL, JOB_STATE_DONE, JOB_STATE_ERROR):
+            if os.path.exists(self._path(terminal, tid)):
+                return  # trial already settled: do not resurrect running/
+        path = self._path(JOB_STATE_RUNNING, tid)
         if os.path.exists(path):
             _atomic_write(path, pickle.dumps(doc))
 
     def finish(self, doc, result=None, error=None):
-        """RUNNING → DONE/ERROR: write the terminal doc, then remove the
-        running file (write-then-remove so a crash between the two leaves a
-        duplicate, never a loss; load_all keeps the terminal state last)."""
+        """RUNNING → DONE/ERROR.  Ownership of the transition is the running
+        file itself: renaming it to a private name is the atomic claim.  If
+        the rename fails, a concurrent ``cancel``/``reclaim_stale`` took the
+        trial first — the result is dropped (returns False) rather than
+        written alongside the other party's doc (which would double-count the
+        tid in ``load_all``)."""
         tid = doc["tid"]
+        run_path = self._path(JOB_STATE_RUNNING, tid)
+        claim = f"{run_path}.finish.{os.getpid()}"
+        try:
+            os.rename(run_path, claim)
+        except FileNotFoundError:
+            logger.warning(
+                "trial %s was cancelled/reclaimed before finish; dropping %s",
+                tid, "error" if error is not None else "result")
+            return False
         doc["refresh_time"] = coarse_utcnow()
         if error is not None:
             doc["state"] = JOB_STATE_ERROR
@@ -210,10 +246,8 @@ class FileStore:
             doc["state"] = JOB_STATE_DONE
             doc["result"] = result
         self.write_doc(doc)
-        try:
-            os.remove(self._path(JOB_STATE_RUNNING, tid))
-        except FileNotFoundError:
-            pass
+        os.remove(claim)
+        return True
 
     def reclaim_stale(self, reserve_timeout, to_cancel=False):
         """Move RUNNING docs whose heartbeat is older than reserve_timeout
@@ -234,14 +268,18 @@ class FileStore:
             age = (coarse_utcnow() - doc["refresh_time"]).total_seconds()
             if age < reserve_timeout:
                 continue
+            # claim the transition by renaming the running file away first;
+            # losing the rename means the worker finished (or another
+            # reclaimer won) in the meantime — skip, don't duplicate
+            claim = f"{path}.reclaim.{os.getpid()}"
+            try:
+                os.rename(path, claim)
+            except FileNotFoundError:
+                continue
             doc["state"] = target
             doc["owner"] = None
-            dst = self._path(target, doc["tid"])
-            _atomic_write(dst, pickle.dumps(doc))
-            try:
-                os.remove(path)
-            except FileNotFoundError:
-                pass
+            _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
+            os.remove(claim)
             logger.warning("reclaimed stale trial %s (heartbeat %.0fs old) -> %s",
                            doc["tid"], age, _STATE_DIRS[target])
             n += 1
@@ -249,23 +287,28 @@ class FileStore:
 
     def cancel(self, tid):
         """Move one NEW or RUNNING doc to CANCEL (SparkTrials job-group
-        cancellation analog).  A worker holding the claim will fail its
-        heartbeat/finish harmlessly — the running file is gone.  Returns True
-        if a doc was cancelled."""
+        cancellation analog).  The source file is renamed away FIRST (the
+        atomic claim — same idiom as ``reserve``/``finish``), so a worker
+        that finishes concurrently loses the rename race and drops its
+        result instead of writing a duplicate doc.  Returns True if a doc
+        was cancelled."""
         for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
             src = self._path(state, tid)
-            doc = self._read(src)
+            claim = f"{src}.cancel.{os.getpid()}"
+            try:
+                os.rename(src, claim)
+            except FileNotFoundError:
+                continue
+            doc = self._read(claim)
             if doc is None:
+                os.remove(claim)
                 continue
             doc["state"] = JOB_STATE_CANCEL
             doc.setdefault("result", {})
             doc["result"]["status"] = "fail"
             doc["refresh_time"] = coarse_utcnow()
             _atomic_write(self._path(JOB_STATE_CANCEL, tid), pickle.dumps(doc))
-            try:
-                os.remove(src)
-            except FileNotFoundError:
-                pass
+            os.remove(claim)
             return True
         return False
 
@@ -322,6 +365,14 @@ class FileTrials(Trials):
 
     def count_by_state_unsynced(self, arg):
         return self.store.count(arg)
+
+    def checkpoint_trial(self, doc):
+        """Ctrl.checkpoint hook: write the RUNNING doc (with its partial
+        result) through to the store, so a worker crash after a checkpoint
+        loses only the work since that checkpoint (MongoCtrl.checkpoint
+        analog).  Reuses the heartbeat write path: atomic, skipped if the
+        trial was cancelled/finished meanwhile."""
+        self.store.heartbeat(doc)
 
     def cancel_unfinished(self):
         """NEW/RUNNING → CANCEL in the store (FMinIter calls this when its

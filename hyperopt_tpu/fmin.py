@@ -41,6 +41,7 @@ from .spaces import space_eval  # re-export (hyperopt/fmin.py sym: space_eval)
 __all__ = [
     "fmin",
     "FMinIter",
+    "PhaseTimings",
     "space_eval",
     "fmin_pass_expr_memo_ctrl",
     "generate_trials_to_calculate",
@@ -48,6 +49,25 @@ __all__ = [
 ]
 
 logger = logging.getLogger(__name__)
+
+
+class PhaseTimings(dict):
+    """Per-phase wall-clock accounting for the ask→tell loop (SURVEY.md §5
+    tracing row).  Maps phase name → ``{"sec": total, "count": calls}``;
+    lives on the trials object (``trials.phase_timings``) so it survives
+    pickling/resume and is inspectable after ``fmin`` returns."""
+
+    def add(self, phase, dt):
+        e = self.setdefault(phase, {"sec": 0.0, "count": 0})
+        e["sec"] += dt
+        e["count"] += 1
+
+    def summary(self):
+        total = sum(e["sec"] for e in self.values()) or 1.0
+        return {
+            k: {**e, "frac": e["sec"] / total}
+            for k, e in sorted(self.items(), key=lambda kv: -kv[1]["sec"])
+        }
 
 
 def fmin_pass_expr_memo_ctrl(f):
@@ -145,6 +165,11 @@ class FMinIter:
         self.show_progressbar = show_progressbar
         self.early_stop_args = []
         self.is_cancelled = False
+        # per-phase timing counters, shared with (and surfaced on) the trials
+        # object; accumulates across fmin calls that reuse one Trials
+        if not hasattr(trials, "phase_timings"):
+            trials.phase_timings = PhaseTimings()
+        self.phase_timings = trials.phase_timings
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
@@ -220,7 +245,38 @@ class FMinIter:
         else:
             self.serial_evaluate()
 
+    def _timed(self, phase):
+        """Context manager accumulating wall time into ``phase_timings``."""
+        timings = self.phase_timings
+
+        @contextlib.contextmanager
+        def ctx():
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                timings.add(phase, time.perf_counter() - t0)
+
+        return ctx()
+
+    @staticmethod
+    def _profiler_ctx():
+        """Optional ``jax.profiler`` trace over the whole loop: set
+        ``HYPEROPT_TPU_PROFILE=<dir>`` to capture a TensorBoard-viewable
+        device+host trace of every suggest kernel and readback."""
+        pdir = os.environ.get("HYPEROPT_TPU_PROFILE", "")
+        if not pdir:
+            return contextlib.nullcontext()
+        import jax
+
+        logger.info("profiling to %s (jax.profiler.trace)", pdir)
+        return jax.profiler.trace(pdir)
+
     def run(self, N, block_until_done=True):
+        with self._profiler_ctx():
+            self._run(N, block_until_done)
+
+    def _run(self, N, block_until_done=True):
         trials = self.trials
         algo = self.algo
         n_queued = 0
@@ -251,14 +307,15 @@ class FMinIter:
                     n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     self.trials.refresh()
-                    new_trials = algo(
-                        new_ids,
-                        self.domain,
-                        trials,
-                        self.rstate.integers(2**31 - 1)
-                        if hasattr(self.rstate, "integers")
-                        else self.rstate.randint(2**31 - 1),
-                    )
+                    with self._timed("suggest"):
+                        new_trials = algo(
+                            new_ids,
+                            self.domain,
+                            trials,
+                            self.rstate.integers(2**31 - 1)
+                            if hasattr(self.rstate, "integers")
+                            else self.rstate.randint(2**31 - 1),
+                        )
                     assert len(new_ids) >= len(new_trials)
                     if len(new_trials):
                         self.trials.insert_trial_docs(new_trials)
@@ -271,13 +328,17 @@ class FMinIter:
 
                 if self.asynchronous:
                     # wait for workers to fill in the trials
-                    time.sleep(self.poll_interval_secs)
+                    with self._timed("poll"):
+                        time.sleep(self.poll_interval_secs)
                 else:
-                    self.serial_evaluate()
+                    with self._timed("evaluate"):
+                        self.serial_evaluate()
 
-                self.trials.refresh()
+                with self._timed("refresh"):
+                    self.trials.refresh()
                 if self.trials_save_file != "":
-                    self._save_trials()
+                    with self._timed("save"):
+                        self._save_trials()
 
                 if self.early_stop_fn is not None:
                     stop, kwargs = self.early_stop_fn(

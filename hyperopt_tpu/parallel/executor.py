@@ -132,6 +132,13 @@ class ExecutorTrials(Trials):
                 trial["state"] = JOB_STATE_DONE
             trial["refresh_time"] = coarse_utcnow()
 
+    def checkpoint_trial(self, doc):
+        """Ctrl.checkpoint hook: stamp the partial result under the lock so
+        the driver thread never reads a half-written doc (docs are shared
+        in-process; the stamp is the persistence)."""
+        with self._lock:
+            doc["refresh_time"] = coarse_utcnow()
+
     def _cancel_timed_out(self):
         """RUNNING → CANCEL for trials over the per-trial budget (SparkTrials
         timeout policy: hyperopt/spark.py sym: _FMinState timeout handling).
@@ -145,7 +152,9 @@ class ExecutorTrials(Trials):
                     continue
                 if (now - t["book_time"]).total_seconds() >= self.timeout:
                     t["state"] = JOB_STATE_CANCEL
-                    t["result"] = {"status": STATUS_FAIL}
+                    # merge, don't overwrite: a Ctrl.checkpoint partial
+                    # result must survive cancellation
+                    t["result"] = {**(t.get("result") or {}), "status": STATUS_FAIL}
                     t["misc"]["error"] = (
                         "Cancelled",
                         f"trial exceeded per-trial timeout {self.timeout}s",
@@ -162,7 +171,7 @@ class ExecutorTrials(Trials):
             for t in self._dynamic_trials:
                 if t["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING):
                     t["state"] = JOB_STATE_CANCEL
-                    t["result"] = {"status": STATUS_FAIL}
+                    t["result"] = {**(t.get("result") or {}), "status": STATUS_FAIL}
                     t["misc"]["error"] = ("Cancelled", "fmin timeout")
                     t["refresh_time"] = coarse_utcnow()
 

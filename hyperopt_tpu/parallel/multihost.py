@@ -1,0 +1,143 @@
+"""Multi-host / multi-process distribution over ICI + DCN.
+
+Parity target: the reference scales across machines with MongoDB polling —
+``hyperopt/mongoexp.py`` (sym: MongoJobs.reserve, MongoWorker) has N worker
+hosts racing to claim trial docs from one mongod (SURVEY.md §2.2 "collective
+communication backend" row and §5 "distributed comm" row).  The TPU-native
+equivalent is a **multi-controller JAX job**: every host runs the same
+program, ``jax.distributed.initialize`` forms one global runtime, and the
+proposal/evaluation arrays are sharded over a global ``Mesh`` whose
+collectives ride ICI within a slice and DCN across slices.  Trial-history
+state is replicated (it is tiny); the trial-batch and candidate axes shard.
+
+This module is the thin wiring layer: idempotent ``initialize`` with
+environment fallbacks, a global mesh helper, and deterministic global key
+batches every process can construct without communication.  The sharded
+kernels themselves (``sharding.suggest_batch_sharded``,
+``sharding.propose_sharded_candidates``) are process-count-agnostic — under
+a multi-process runtime the same jitted programs place their shards on other
+hosts' devices and XLA inserts the cross-host collectives.
+
+Tested (tests/test_multihost.py) the way the reference tests mongo
+distribution — real local processes, no fakes: two jax processes form one
+8-device CPU mesh and must produce bitwise-identical proposals to a
+single-process run.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "global_mesh",
+    "global_key_batch",
+    "replicate_global",
+    "process_index",
+    "process_count",
+]
+
+_initialized = False
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               local_device_ids=None, **kwargs):
+    """Join (or form) the multi-process JAX runtime.  Idempotent.
+
+    Arguments fall back to the standard environment variables
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``),
+    and on Cloud TPU pods everything may be omitted — ``jax.distributed``
+    autodetects from the TPU metadata server.  Call before any other jax use
+    (backend topology is fixed at first device access).
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(num_processes) if num_processes is not None else None
+    if process_id is None:
+        process_id = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(process_id) if process_id is not None else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+        **kwargs,
+    )
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized
+
+
+def process_index():
+    return jax.process_index()
+
+
+def process_count():
+    return jax.process_count()
+
+
+def global_mesh(n_cand_shards=1):
+    """A ``(trials, cand)`` mesh over ALL global devices (every process's
+    chips).  Must be constructed identically on every process — jax.devices()
+    returns the same global order everywhere."""
+    from . import sharding
+
+    return sharding.make_mesh(len(jax.devices()), n_cand_shards=n_cand_shards)
+
+
+def replicate_global(tree, mesh):
+    """Replicate a host-value pytree onto every device of a (possibly
+    multi-process) global mesh.  The value must be identical on every
+    process — true by construction for trial history, which every
+    controller folds deterministically.  ``jax.make_array_from_callback``
+    assembles the global array from each process's addressable shards, the
+    multi-controller-safe equivalent of ``sharding.replicate_history``'s
+    single-process ``device_put``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, rep, lambda idx: x[idx])
+
+    return jax.tree.map(put, tree)
+
+
+def global_key_batch(seed, batch, mesh, axis=None):
+    """A globally-sharded ``[batch, 2]`` array of per-trial PRNG keys (raw
+    uint32 words, the format the proposal kernels vmap over).
+
+    Every process computes only its addressable shards, via
+    ``jax.make_array_from_callback`` — no cross-host traffic.  Key
+    derivation is ``fold_in(PRNGKey(seed), index)``, deterministic in the
+    global index, so the assembled global array is identical to what a
+    single process would build (the multihost test asserts this).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import sharding as _sh
+
+    if axis is None:
+        axis = (_sh.TRIALS_AXIS, _sh.CAND_AXIS)
+    base = jax.random.PRNGKey(seed)
+    host_keys = np.asarray(
+        jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(batch, dtype=jnp.uint32))
+    )  # [batch, 2], batch-dim sharded, key words replicated
+    spec = NamedSharding(mesh, P(axis))
+    return jax.make_array_from_callback(
+        host_keys.shape, spec, lambda index: host_keys[index])

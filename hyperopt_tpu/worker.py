@@ -104,7 +104,14 @@ class FileWorker:
             # move back to NEW and re-evaluate a finished (or deterministic-
             # failure) trial
             stop.set()
-            hb.join(timeout=5)
+            hb.join(timeout=30)
+        if hb.is_alive():
+            # a heartbeat write is stalled (e.g. hung NFS): finishing now
+            # would re-open the resurrect race the join exists to close.
+            # Leave the claim; reclaim_stale re-queues it once stale.
+            logger.error("job %s: heartbeat thread stuck; leaving claim for "
+                         "stale reclaim", doc["tid"])
+            return False
         if error is not None:
             logger.error("job %s failed: %s", doc["tid"], error)
             self.store.finish(doc, error=error)

@@ -1,0 +1,104 @@
+"""Child process for tests/test_multihost.py: one controller of a 2-process
+JAX runtime over virtual CPU devices.
+
+Usage: python _multihost_child.py <coordinator_port> <process_id>
+
+The parent launches two of these; each joins the distributed runtime, forms
+the 8-device global mesh (4 local + 4 remote), runs the mesh-sharded batched
+TPE proposal, gathers the result, and compares it against the plain
+single-device computation of the SAME history and keys.  Prints
+``MULTIHOST_OK`` on success.
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    port, pid = sys.argv[1], int(sys.argv[2])
+
+    from hyperopt_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.parallel import sharding
+    from hyperopt_tpu.spaces import compile_space
+
+    space = {
+        "lr": hp.loguniform("lr", -6, 0),
+        "width": hp.quniform("width", 16, 256, 16),
+        "act": hp.choice("act", ["relu", "gelu", "tanh"]),
+    }
+    cs = compile_space(space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25, "LF": 25}
+
+    # identical history on both controllers (deterministic construction)
+    rng = np.random.default_rng(7)
+    cap, n_obs = 64, 40
+    has = np.zeros(cap, bool)
+    has[:n_obs] = True
+    history = {
+        "losses": np.where(has, rng.normal(size=cap), np.inf).astype(np.float32),
+        "has_loss": has,
+        "vals": {
+            "lr": np.where(has, np.exp(rng.uniform(-6, 0, cap)), 0).astype(np.float32),
+            "width": np.where(has, rng.integers(1, 16, cap) * 16.0, 0).astype(np.float32),
+            "act": np.where(has, rng.integers(0, 3, cap), 0).astype(np.float32),
+        },
+        "active": {l: has.copy() for l in cs.labels},
+    }
+
+    batch = 16
+    mesh = multihost.global_mesh()
+    assert int(np.prod(list(mesh.shape.values()))) == 8
+    keys = multihost.global_key_batch(0, batch, mesh)
+    hist_dev = multihost.replicate_global(history, mesh)
+
+    fn = sharding.suggest_batch_sharded(cs, cfg, mesh)
+    out = fn(hist_dev, keys)
+    gathered = {
+        l: np.asarray(multihost_utils.process_allgather(out[l], tiled=True))
+        for l in cs.labels
+    }
+
+    # single-device reference on this controller: same math, local arrays
+    host_keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i)
+    )(jnp.arange(batch, dtype=jnp.uint32))
+    plain_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0)))
+    plain = plain_fn(
+        jax.tree.map(jnp.asarray, history), host_keys
+    )
+    for label in cs.labels:
+        np.testing.assert_allclose(
+            gathered[label], np.asarray(plain[label]), rtol=1e-6, atol=1e-6,
+            err_msg=f"multi-process != single-process for {label}",
+        )
+
+    # and the candidate-axis collective path executes across processes
+    mesh2 = multihost.global_mesh(n_cand_shards=2)
+    cand_fn = sharding.propose_sharded_candidates(cs, cfg, mesh2)
+    hist2 = multihost.replicate_global(history, mesh2)
+    out2 = cand_fn(hist2, jax.random.PRNGKey(3))
+    for label in cs.labels:
+        v = np.asarray(multihost_utils.process_allgather(out2[label], tiled=True))
+        assert np.all(np.isfinite(v)), f"non-finite proposal for {label}"
+
+    print(f"MULTIHOST_OK process={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

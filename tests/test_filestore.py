@@ -242,6 +242,96 @@ def test_store_cancel_and_reclaim_to_cancel(tmp_path):
     assert t.count_by_state_unsynced([JOB_STATE_NEW, JOB_STATE_RUNNING]) == 0
 
 
+def test_finish_after_cancel_drops_result_no_duplicate(tmp_path):
+    # the cancel-vs-finish race: the driver cancels a RUNNING trial while the
+    # worker is still evaluating.  finish() must lose the rename-claim and
+    # drop its result — the tid must appear exactly once, as CANCEL.
+    from hyperopt_tpu import JOB_STATE_CANCEL
+
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(lambda d: d["x"] ** 2, SPACE)
+    _insert_new(t, domain, 1)
+    doc = t.store.reserve("worker")
+    assert t.store.cancel(doc["tid"])  # driver-side timeout fires
+    assert t.store.finish(doc, result={"loss": 1.0, "status": "ok"}) is False
+    docs = t.store.load_all()
+    assert len(docs) == 1 and docs[0]["state"] == JOB_STATE_CANCEL
+    # and the reverse interleaving: finish wins, cancel finds nothing
+    _insert_new(t, domain, 1)
+    doc2 = t.store.reserve("worker")
+    assert t.store.finish(doc2, result={"loss": 2.0, "status": "ok"}) is True
+    assert not t.store.cancel(doc2["tid"])
+    states = [d["state"] for d in t.store.load_all() if d["tid"] == doc2["tid"]]
+    assert states == [JOB_STATE_DONE]
+
+
+def test_load_all_dedupes_by_state_precedence(tmp_path):
+    # a residual race can leave one tid in two directories; readers must see
+    # exactly one doc, preferring the more-terminal state
+    from hyperopt_tpu import JOB_STATE_CANCEL
+
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(lambda d: d["x"] ** 2, SPACE)
+    _insert_new(t, domain, 1)
+    doc = t.store.reserve("worker")
+    # forge the duplicate: same tid in both running/ and cancel/
+    dup = dict(doc, state=JOB_STATE_CANCEL)
+    from hyperopt_tpu.filestore import _atomic_write
+
+    _atomic_write(t.store._path(JOB_STATE_CANCEL, doc["tid"]), pickle.dumps(dup))
+    docs = t.store.load_all()
+    assert len(docs) == 1 and docs[0]["state"] == JOB_STATE_CANCEL
+    # DONE shadows CANCEL (finished work keeps its result)
+    done = dict(doc, state=JOB_STATE_DONE, result={"loss": 0.5, "status": "ok"})
+    _atomic_write(t.store._path(JOB_STATE_DONE, doc["tid"]), pickle.dumps(done))
+    docs = t.store.load_all()
+    assert len(docs) == 1 and docs[0]["state"] == JOB_STATE_DONE
+    t.refresh()
+    assert len(t) == 1
+
+
+def test_ctrl_checkpoint_survives_worker_crash(tmp_path):
+    # MongoCtrl.checkpoint doctrine: a worker checkpoints a partial result,
+    # then dies -9; the partial must survive in the store — reclaimed doc
+    # (CANCEL here, so the trial is not silently re-run) still carries it
+    from hyperopt_tpu import JOB_STATE_CANCEL, fmin_pass_expr_memo_ctrl
+
+    store = tmp_path / "s"
+    t = FileTrials(store)
+
+    @fmin_pass_expr_memo_ctrl
+    def obj(expr, memo, ctrl):
+        ctrl.checkpoint({"status": "ok", "partial_steps": 7})
+        time.sleep(60)  # killed long before this returns
+        return {"status": "ok", "loss": 0.0}
+
+    domain = Domain(obj, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 1)
+    victim = _spawn_worker(store, "--stale-after", "1")
+    try:
+        deadline = time.time() + 30
+        seen = False
+        while time.time() < deadline and not seen:
+            docs = t.store.load_all()
+            seen = any(
+                d["state"] == JOB_STATE_RUNNING
+                and d.get("result", {}).get("partial_steps") == 7
+                for d in docs
+            )
+            time.sleep(0.1)
+        assert seen, "checkpointed partial result never reached the store"
+    finally:
+        victim.kill()
+        victim.wait(timeout=10)
+    time.sleep(1.5)  # age the last heartbeat past stale-after
+    assert t.store.reclaim_stale(1.0, to_cancel=True) == 1
+    docs = t.store.load_all()
+    assert len(docs) == 1
+    assert docs[0]["state"] == JOB_STATE_CANCEL
+    assert docs[0]["result"]["partial_steps"] == 7  # survived the crash
+
+
 def test_filetrials_pickle_roundtrip(tmp_path):
     t = FileTrials(tmp_path / "s")
     domain = Domain(lambda d: d["x"] ** 2, SPACE)

@@ -225,3 +225,36 @@ def test_trials_fmin_method():
                   rstate=np.random.default_rng(0), show_progressbar=False)
     assert len(t) == 8
     assert "x" in best
+
+
+def test_phase_timings_recorded():
+    # SURVEY.md §5 tracing row: per-phase wall-clock counters on the trials
+    from hyperopt_tpu.algos import tpe as _tpe
+
+    t = Trials()
+    fmin(lambda d: (d["x"] - 1.0) ** 2, {"x": hp.uniform("x", -5, 5)},
+         algo=_tpe.suggest, max_evals=25, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    pt = t.phase_timings
+    assert pt["suggest"]["count"] >= 25 // 1 - 21  # at least the TPE calls
+    assert pt["evaluate"]["count"] > 0
+    assert pt["refresh"]["count"] > 0
+    assert all(e["sec"] >= 0 for e in pt.values())
+    fracs = sum(e["frac"] for e in pt.summary().values())
+    assert fracs == pytest.approx(1.0)
+    # survives the pickle round-trip (resume keeps accumulating)
+    import pickle as _p
+
+    t2 = _p.loads(_p.dumps(t))
+    assert t2.phase_timings["suggest"]["count"] == pt["suggest"]["count"]
+
+
+def test_jax_profiler_trace_hook(tmp_path, monkeypatch):
+    # HYPEROPT_TPU_PROFILE=<dir> wraps the loop in jax.profiler.trace
+    monkeypatch.setenv("HYPEROPT_TPU_PROFILE", str(tmp_path / "prof"))
+    t = Trials()
+    fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -5, 5)},
+         algo=rand.suggest, max_evals=5, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    traces = list((tmp_path / "prof").rglob("*"))
+    assert traces, "no profiler artifacts written"

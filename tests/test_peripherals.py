@@ -1,0 +1,241 @@
+"""Peripheral-module tests.
+
+Parity targets (SURVEY.md §4 table): ``hyperopt/tests/test_plotting.py``
+(Agg-backend smoke), ``test_criteria.py`` (closed-form checks),
+``test_progress.py``, ``test_utils.py``, plus worker-CLI argument handling
+and the graphviz DOT renderer.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")  # headless backend, the reference test doctrine
+
+
+@pytest.fixture(scope="module")
+def run_trials():
+    t = Trials()
+    fmin(
+        lambda d: (d["x"] - 1.0) ** 2 + 0.1 * d["n"],
+        {"x": hp.uniform("x", -5, 5), "n": hp.randint("n", 3)},
+        algo=rand.suggest, max_evals=15, trials=t,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    return t
+
+
+# ---------------------------------------------------------------------------
+# plotting (Agg smoke — reference: tests/test_plotting.py)
+# ---------------------------------------------------------------------------
+
+
+def test_main_plot_history(run_trials):
+    from hyperopt_tpu.plotting import main_plot_history
+
+    fig = main_plot_history(run_trials, do_show=False)
+    assert fig.axes and fig.axes[0].get_ylabel() == "loss"
+    matplotlib.pyplot.close(fig)
+
+
+def test_main_plot_histogram(run_trials):
+    from hyperopt_tpu.plotting import main_plot_histogram
+
+    fig = main_plot_histogram(run_trials, do_show=False)
+    assert fig.axes
+    matplotlib.pyplot.close(fig)
+
+
+def test_main_plot_vars(run_trials):
+    from hyperopt_tpu.plotting import main_plot_vars
+
+    fig = main_plot_vars(run_trials, do_show=False)
+    # one subplot per hyperparameter (x and n) at minimum
+    assert len([a for a in fig.axes if a.get_title() in ("x", "n")]) == 2
+    matplotlib.pyplot.close(fig)
+
+
+def test_plots_tolerate_empty_trials():
+    from hyperopt_tpu.plotting import (
+        main_plot_histogram, main_plot_history, main_plot_vars)
+
+    t = Trials()
+    for fn in (main_plot_history, main_plot_histogram, main_plot_vars):
+        fig = fn(t, do_show=False)
+        matplotlib.pyplot.close(fig)
+
+
+# ---------------------------------------------------------------------------
+# criteria vs closed form (reference: tests/test_criteria.py)
+# ---------------------------------------------------------------------------
+
+
+def test_ei_empirical_matches_definition():
+    from hyperopt_tpu.criteria import EI_empirical
+
+    rng = np.random.default_rng(0)
+    s = rng.normal(size=4096)
+    got = float(EI_empirical(s, 0.5))
+    want = np.mean(np.maximum(s - 0.5, 0.0))
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_ei_gaussian_matches_monte_carlo():
+    from hyperopt_tpu.criteria import EI_gaussian
+
+    rng = np.random.default_rng(1)
+    mean, var, thresh = 0.3, 1.7, 1.0
+    s = rng.normal(mean, math.sqrt(var), size=2_000_000)
+    mc = np.mean(np.maximum(s - thresh, 0.0))
+    assert float(EI_gaussian(mean, var, thresh)) == pytest.approx(mc, rel=5e-3)
+
+
+def test_log_ei_gaussian_consistent_and_tail_stable():
+    from hyperopt_tpu.criteria import EI_gaussian, logEI_gaussian
+
+    # moderate regime: logEI == log(EI)
+    v = float(logEI_gaussian(0.0, 1.0, 1.0))
+    assert v == pytest.approx(math.log(float(EI_gaussian(0.0, 1.0, 1.0))), rel=1e-5)
+    # deep tail: naive EI underflows to 0, logEI must stay finite and ordered
+    far = float(logEI_gaussian(0.0, 1.0, 15.0))
+    farther = float(logEI_gaussian(0.0, 1.0, 20.0))
+    assert np.isfinite(far) and np.isfinite(farther) and farther < far
+
+
+def test_ucb():
+    from hyperopt_tpu.criteria import UCB
+
+    assert float(UCB(1.0, 4.0, 2.0)) == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# progress (reference: tests/test_progress.py)
+# ---------------------------------------------------------------------------
+
+
+def test_progress_callback_selection():
+    from hyperopt_tpu.progress import (
+        get_progress_callback, no_progress_callback, tqdm_progress_callback)
+
+    assert get_progress_callback(True) is tqdm_progress_callback
+    assert get_progress_callback(False) is no_progress_callback
+    custom = no_progress_callback
+    assert get_progress_callback(custom) is custom
+
+
+def test_progress_contexts_update_and_postfix():
+    from hyperopt_tpu.progress import no_progress_callback, tqdm_progress_callback
+
+    with no_progress_callback(initial=0, total=10) as ctx:
+        ctx.update(3)
+        ctx.postfix = "best: 1.0"
+    with tqdm_progress_callback(initial=0, total=10) as ctx:
+        ctx.update(3)
+        ctx.postfix = "best: 1.0"
+        assert "best" in str(ctx.postfix)
+
+
+# ---------------------------------------------------------------------------
+# utils (reference: tests/test_utils.py)
+# ---------------------------------------------------------------------------
+
+
+def test_import_tokens_and_json_call():
+    from hyperopt_tpu.utils import import_tokens, json_call
+
+    assert import_tokens(["math", "sqrt"]) is math.sqrt
+    assert json_call("math.sqrt", (9.0,)) == 3.0
+    assert json_call(("math.pow", [2.0, 3.0])) == 8.0
+
+
+def test_get_most_recent_inds():
+    from hyperopt_tpu.utils import get_most_recent_inds
+
+    docs = [
+        {"_id": 0, "version": 0},
+        {"_id": 0, "version": 1},
+        {"_id": 1, "version": 0},
+        {"_id": 2, "version": 0},
+        {"_id": 2, "version": 2},
+    ]
+    inds = sorted(get_most_recent_inds(docs))
+    assert inds == [1, 2, 4]
+
+
+def test_fast_isin():
+    from hyperopt_tpu.utils import fast_isin
+
+    got = fast_isin([1, 2, 3, 4], [2, 4])
+    assert got.tolist() == [False, True, False, True]
+
+
+def test_temp_dir_and_working_dir(tmp_path):
+    from hyperopt_tpu.utils import temp_dir, working_dir
+
+    target = tmp_path / "scratch" / "deep"
+    with temp_dir(str(target), erase_after=True):
+        assert target.is_dir()
+        with working_dir(str(target)):
+            assert os.getcwd() == str(target)
+    assert not target.exists()
+
+
+def test_get_closest_dir(tmp_path):
+    from hyperopt_tpu.utils import get_closest_dir
+
+    closest, missing = get_closest_dir(str(tmp_path / "a" / "b"))
+    assert closest == str(tmp_path)
+    assert missing == "a"
+
+
+# ---------------------------------------------------------------------------
+# worker CLI arg handling (reference: mongoexp main_worker CLI tests)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_cli_requires_store(capsys):
+    from hyperopt_tpu.worker import main
+
+    with pytest.raises(SystemExit) as e:
+        main([])
+    assert e.value.code == 2
+    assert "--store" in capsys.readouterr().err
+
+
+def test_worker_cli_reserve_timeout_exits_zero(tmp_path):
+    from hyperopt_tpu.worker import main
+
+    rc = main(["--store", str(tmp_path / "s"), "--reserve-timeout", "0.2",
+               "--poll-interval", "0.05"])
+    assert rc == 0  # empty store: clean reserve-timeout exit
+
+
+def test_worker_cli_rejects_unknown_flag(tmp_path):
+    from hyperopt_tpu import worker
+
+    with pytest.raises(SystemExit):
+        worker.main(["--store", str(tmp_path), "--no-such-flag"])
+
+
+# ---------------------------------------------------------------------------
+# graphviz DOT renderer (reference: hyperopt/graphviz.py)
+# ---------------------------------------------------------------------------
+
+
+def test_dot_hyperparameters_renders_all_nodes():
+    from hyperopt_tpu.graphviz_mod import dot_hyperparameters
+
+    space = {
+        "lr": hp.loguniform("lr", -6, 0),
+        "arch": hp.choice("arch", [{"w": hp.uniform("w", 0, 1)}, "none"]),
+    }
+    dot = dot_hyperparameters(space)
+    assert dot.startswith("digraph {") and dot.endswith("}")
+    for frag in ("lr", "choice arch", "loguniform", "uniform"):
+        assert frag in dot, f"{frag!r} missing from DOT output"
