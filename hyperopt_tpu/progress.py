@@ -37,6 +37,8 @@ def tqdm_progress_callback(initial=0, total=None):
             yield ctx
         return
 
+    from .std_out_err_redirect_tqdm import std_out_err_redirect_tqdm
+
     class _Tqdm:
         def __init__(self, bar):
             self.bar = bar
@@ -54,8 +56,12 @@ def tqdm_progress_callback(initial=0, total=None):
                 self.bar.update(n)
 
     total_ = None if total in (None, float("inf")) else int(total)
-    with tqdm(initial=initial, total=total_, dynamic_ncols=True) as bar:
-        yield _Tqdm(bar)
+    # objective prints are routed through tqdm.write so they don't shred
+    # the bar (reference: std_out_err_redirect_tqdm.py used the same way)
+    with std_out_err_redirect_tqdm() as orig_stdout:
+        with tqdm(initial=initial, total=total_, dynamic_ncols=True,
+                  file=orig_stdout) as bar:
+            yield _Tqdm(bar)
 
 
 def get_progress_callback(show_progressbar):

@@ -323,15 +323,19 @@ def _ml_logreg_cv():
 
     @functools.lru_cache(maxsize=1)
     def _data():
-        # LAZY: jax array ops initialize the backend; running them at module
-        # import would make `import hyperopt_tpu.zoo` hang uncatchably when
-        # the ambient TPU tunnel is broken (the round-3 bench failure mode)
-        key = jax.random.PRNGKey(42)
-        kw, kx, kn = jax.random.split(key, 3)
-        w_true = jax.random.normal(kw, (dim,))
-        X = jax.random.normal(kx, (n, dim))
-        margin = X @ w_true / jnp.sqrt(dim)
-        y = (margin + 0.6 * jax.random.normal(kn, (n,)) > 0).astype(jnp.float32)
+        # LAZY (jax backend init at module import would hang when the
+        # ambient TPU tunnel is broken — the round-3 bench failure mode) and
+        # PURE NUMPY: jax ops here would run under whatever trace first
+        # calls the objective, caching tracers that escape their scope
+        # (UnexpectedTracerError on the second jit)
+        import numpy as np
+
+        rng = np.random.default_rng(42)
+        w_true = rng.standard_normal(dim).astype(np.float32)
+        X = rng.standard_normal((n, dim)).astype(np.float32)
+        margin = X @ w_true / np.sqrt(dim)
+        noise = 0.6 * rng.standard_normal(n)
+        y = (margin + noise > 0).astype(np.float32)
         return X.reshape(folds, n // folds, dim), y.reshape(folds, n // folds)
 
     def _nll(w, b, Xs, ys):

@@ -1,0 +1,62 @@
+"""Pallas EI-kernel tests (CPU lane: exercises the jnp twin + the fallback
+dispatch logic; the TPU lowering itself was validated on hardware — see
+hyperopt_tpu/pallas_ei.py MEASURED VERDICT)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import pallas_ei
+from hyperopt_tpu.algos import tpe
+
+
+def _models(m=65, seed=0):
+    rng = np.random.default_rng(seed)
+    def one(z):
+        w = np.abs(rng.random(m)).astype(np.float32)
+        w[z] = 0.0  # a dead (masked) component
+        w /= w.sum()
+        return (jnp.asarray(w),
+                jnp.asarray(rng.uniform(-5, 5, m).astype(np.float32)),
+                jnp.asarray(rng.uniform(0.1, 2.0, m).astype(np.float32)))
+    return one(3), one(7)
+
+
+def test_ei_diff_matches_tpe_lpdf_pair():
+    # the kernel's math contract: ei_diff == gmm1_lpdf_b - gmm1_lpdf_a for
+    # the untruncated case (truncation terms are scalar shifts the caller
+    # applies; they cancel out of the difference only when p_accepts match,
+    # so compare against the untruncated lpdfs directly)
+    (wb, mb, sb), (wa, ma, sa) = _models()
+    x = jnp.asarray(np.random.default_rng(1).uniform(-5, 5, 2048).astype(np.float32))
+    got = pallas_ei.ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
+    inf = float("inf")
+    want = (tpe.gmm1_lpdf(x, wb, mb, sb, -inf, inf, None)
+            - tpe.gmm1_lpdf(x, wa, ma, sa, -inf, inf, None))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ei_diff_dispatch_and_fallback():
+    (wb, mb, sb), (wa, ma, sa) = _models()
+    x = jnp.asarray(np.random.default_rng(2).uniform(-5, 5, 8192).astype(np.float32))
+    out = pallas_ei.ei_diff(x, wb, mb, sb, wa, ma, sa)  # CPU: jnp twin
+    ref = pallas_ei.ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # non-tiling candidate count always takes the fallback, on any backend
+    x_odd = x[:100]
+    out2 = pallas_ei.ei_diff(x_odd, wb, mb, sb, wa, ma, sa)
+    np.testing.assert_allclose(
+        np.asarray(out2),
+        np.asarray(pallas_ei.ei_diff_reference(x_odd, wb, mb, sb, wa, ma, sa)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_ei_diff_dead_components_do_not_poison():
+    (wb, mb, sb), (wa, ma, sa) = _models()
+    x = jnp.asarray(np.linspace(-5, 5, 1024).astype(np.float32))
+    out = np.asarray(pallas_ei.ei_diff(x, wb, mb, sb, wa, ma, sa))
+    assert np.isfinite(out).all()

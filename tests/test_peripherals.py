@@ -239,3 +239,39 @@ def test_dot_hyperparameters_renders_all_nodes():
     assert dot.startswith("digraph {") and dot.endswith("}")
     for frag in ("lr", "choice arch", "loguniform", "uniform"):
         assert frag in dot, f"{frag!r} missing from DOT output"
+
+
+def test_stdout_redirect_through_tqdm(capsys):
+    # reference std_out_err_redirect_tqdm.py: prints inside the bar context
+    # go through tqdm.write without crashing or being swallowed
+    import sys
+
+    from hyperopt_tpu.std_out_err_redirect_tqdm import (
+        DummyTqdmFile, std_out_err_redirect_tqdm)
+
+    with std_out_err_redirect_tqdm() as orig_stdout:
+        assert isinstance(sys.stdout, DummyTqdmFile)
+        print("line1")
+        print("line2")
+        sys.stdout.flush()
+    assert sys.stdout is orig_stdout  # restored on exit
+    out = capsys.readouterr()
+    combined = out.out + out.err
+    # consecutive prints must stay on separate lines (tqdm.write supplies
+    # the newline the redirect swallows from print's bare-"\n" write)
+    assert "line1\n" in combined and "line2\n" in combined
+    assert "line1line2" not in combined
+
+
+def test_progressbar_survives_printing_objective():
+    from hyperopt_tpu.algos import rand as _rand
+
+    t = Trials()
+    def noisy(d):
+        print("objective says hi")
+        return d["x"] ** 2
+
+    fmin(noisy, {"x": hp.uniform("x", -5, 5)}, algo=_rand.suggest,
+         max_evals=5, trials=t, rstate=np.random.default_rng(0),
+         show_progressbar=True)
+    assert len(t) == 5
