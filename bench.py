@@ -480,11 +480,24 @@ def bench_ml_cv(max_evals=64, batch=4096, seed=0):
     _, best_loss = fmin_device(dom.objective, dom.space, max_evals=max_evals,
                                seed=seed, n_EI_candidates=64)
     hpo_dt = time.perf_counter() - t1
+
+    # (c) model-FAMILY selection (the sklearn SVM-vs-RF shape): conditional
+    # space over two model families, per-family hyperparameters, whole HPO
+    # on-device via the union-merge traced assembly
+    sel = ZOO["ml_model_select_cv"]
+    t2 = time.perf_counter()
+    sel_best, sel_loss = fmin_device(sel.objective, sel.space,
+                                     max_evals=max_evals, seed=seed,
+                                     n_EI_candidates=64)
+    sel_dt = time.perf_counter() - t2
     return {"cv_fits_per_sec": batch / dt, "batch": batch,
             "sec_per_batch": dt, "best_prior_loss": best_prior,
             "fmin_device_best_loss": float(best_loss),
             "fmin_device_evals": max_evals,
-            "fmin_device_sec": hpo_dt, "loss_target": dom.loss_target}
+            "fmin_device_sec": hpo_dt, "loss_target": dom.loss_target,
+            "model_select_best_loss": float(sel_loss),
+            "model_select_family": int(sel_best.get("model", -1)),
+            "model_select_sec": sel_dt}
 
 
 _SHARDED_SNIPPET = r"""

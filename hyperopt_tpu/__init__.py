@@ -14,7 +14,7 @@ JOB_STATE_*), so ``from hyperopt_tpu import fmin, hp, tpe, Trials`` — the
 canonical reference idiom — works unchanged.
 """
 
-from . import early_stop, hp, spaces
+from . import early_stop, hp, pyll, spaces
 from .algos import rand
 from .base import (
     JOB_STATE_CANCEL,
@@ -71,6 +71,7 @@ __version__ = "0.2.0"
 __all__ = [
     "hp",
     "spaces",
+    "pyll",
     "early_stop",
     "fmin",
     "FMinIter",

@@ -140,6 +140,11 @@ def ei_diff(x, wb, mb, sb, wa, ma, sa):
     Uses the pallas kernel when the candidate count tiles the TPU grid
     (multiple of 1024) on a TPU backend; jnp twin otherwise.
     """
+    if wb.shape[0] != wa.shape[0]:
+        # the kernel bakes ONE component count into both fori_loops (TPE's
+        # below/above models share the padded cap, so this never triggers
+        # from tpe.py) — mismatched mixtures must take the shape-generic path
+        return ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
     n = x.shape[0]
     if n % _BLOCK == 0 and pallas_available():
         x2d = x.reshape(n // _LANES, _LANES)

@@ -1,0 +1,33 @@
+"""Compatibility facade for the reference's ``hyperopt.pyll`` surface.
+
+Parity target: ``hyperopt/pyll`` (sym: stochastic.sample, as_apply).  The
+reference's pyll is an interpreted expression-graph DSL; this framework
+replaced it with a compiled space IR (``hyperopt_tpu.spaces`` — the jaxpr
+plays the role of the pyll graph, SURVEY.md §7.1).  What survives here is
+the *user-facing* subset that reference tutorials and docs actually use:
+
+* ``pyll.stochastic.sample(space)`` — preview one structured draw from a
+  search space (the canonical space-debugging idiom).
+* ``as_apply`` — alias of ``spaces.as_expr`` (builds the static IR).
+
+The interpreter internals (``scope``, ``rec_eval``, ``Apply`` graph
+surgery) intentionally have no analog: spaces compile to jitted samplers,
+and custom distributions extend ``spaces.Dist`` instead of registering
+scope symbols.  Importing them raises immediately with that guidance.
+"""
+
+from ..spaces import as_expr as as_apply  # noqa: F401
+from . import stochastic  # noqa: F401
+
+__all__ = ["stochastic", "as_apply"]
+
+
+def __getattr__(name):
+    if name in ("scope", "rec_eval", "Apply", "Literal"):
+        raise AttributeError(
+            f"hyperopt_tpu.pyll.{name} does not exist: the pyll interpreter "
+            "was replaced by the compiled space IR (hyperopt_tpu.spaces). "
+            "Build spaces with hp.*, sample with pyll.stochastic.sample, "
+            "and extend distributions via hyperopt_tpu.spaces.Dist."
+        )
+    raise AttributeError(name)

@@ -1,0 +1,37 @@
+"""``hyperopt.pyll.stochastic`` compatibility: ``sample(space, rng=None)``.
+
+Parity target: ``hyperopt/pyll/stochastic.py`` (sym: sample ≈L200) — the
+reference signature takes a numpy ``RandomState``; here any of numpy
+``Generator``/``RandomState``, an int seed, a jax PRNG key, or nothing
+(fresh entropy) is accepted and mapped onto the compiled sampler's
+``jax.random`` key.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .. import spaces
+
+__all__ = ["sample"]
+
+
+def _as_key(rng):
+    if rng is None:
+        return jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**32))
+    if isinstance(rng, jax.Array):
+        return rng
+    if isinstance(rng, (int, np.integer)):
+        return jax.random.PRNGKey(int(rng) & 0xFFFFFFFF)
+    if isinstance(rng, np.random.Generator):
+        return jax.random.PRNGKey(int(rng.integers(2**32, dtype=np.uint64)))
+    if isinstance(rng, np.random.RandomState):
+        return jax.random.PRNGKey(int(rng.randint(0, 2**31 - 1)))
+    raise TypeError(f"cannot derive a PRNG key from rng={rng!r}")
+
+
+def sample(space, rng=None):
+    """One structured draw from ``space`` (pyll/stochastic.py sym: sample)."""
+    return spaces.sample(space, _as_key(rng))

@@ -431,11 +431,84 @@ class CompiledSpace:
         """Rebuild the user-facing structure from flat per-label values.
 
         Host mode picks choice branches with concrete ints (the analog of
-        rec_eval's lazy ``switch``); traced mode uses ``lax.switch`` so
-        jit/vmap'd objective evaluation works — requires homogeneous branch
-        pytrees, which is checked at call time by JAX itself.
+        rec_eval's lazy ``switch``); traced mode evaluates every branch
+        (XLA cannot data-dependent-skip) and SELECTS per leaf, union-merging
+        dict branches with different keys: a key absent from the selected
+        branch reads as a zero of the right dtype.  This makes the common
+        "different hyperparameters per architecture" ``hp.choice`` pattern
+        work under jit/vmap (``make_batch_eval``, ``fmin_device``) — the
+        objective sees the union structure and gates on the selector value.
+        Branch lists of DIFFERENT lengths cannot be merged (shapes must be
+        static) and raise; equal non-numeric leaves (e.g. a shared
+        ``"kind"`` string) pass through; unequal non-numeric leaves are
+        OMITTED from the merged dict (a traced index cannot select a
+        string, and the objective could not compute with one anyway) — a
+        choice whose entire value would be omitted raises with guidance.
         """
         table = _OP_TABLE_JNP if traced else _OP_TABLE_NP
+        _MISSING = object()
+
+        def union_select(idx, per_branch):
+            """Select among per-branch values (``_MISSING`` where a branch
+            lacks the slot) by traced index ``idx``."""
+            present = [v for v in per_branch if v is not _MISSING]
+            if all(isinstance(v, dict) for v in present):
+                keys = sorted(set().union(*(v.keys() for v in present)))
+                out = {}
+                for k in keys:
+                    sub = union_select(idx, [
+                        v[k] if (v is not _MISSING and k in v) else _MISSING
+                        for v in per_branch
+                    ])
+                    if sub is not _MISSING:
+                        out[k] = sub
+                return out
+            if all(isinstance(v, (list, tuple)) for v in present):
+                lens = {len(v) for v in present}
+                if len(lens) != 1:
+                    raise InvalidAnnotatedParameter(
+                        "traced hp.choice branches contain sequences of "
+                        f"different lengths {sorted(lens)}; static shapes "
+                        "cannot be selected under jit — pad the branches or "
+                        "evaluate this space on host"
+                    )
+                n = len(present[0])
+                kind = type(present[0])
+                items = [
+                    union_select(idx, [
+                        v[i] if v is not _MISSING else _MISSING
+                        for v in per_branch
+                    ])
+                    for i in range(n)
+                ]
+                return kind(items) if kind in (list, tuple) else items
+            numeric = all(
+                isinstance(v, (int, float, np.number, np.ndarray, jax.Array))
+                for v in present
+            )
+            if not numeric:
+                if any(isinstance(v, (dict, list, tuple)) for v in present):
+                    # mixed structure (dict in one branch, scalar in another)
+                    # is a space bug — omitting it would surface as a
+                    # confusing KeyError far from the cause
+                    raise InvalidAnnotatedParameter(
+                        "traced hp.choice branches mix containers and "
+                        f"leaves at the same slot ({present!r}); give every "
+                        "branch the same shape at this position"
+                    )
+                if len({repr(v) for v in present}) == 1:
+                    return present[0]  # e.g. a shared "kind" string
+                # branch-identifying strings etc. cannot be selected by a
+                # traced index (and could not participate in traced compute
+                # anyway) — omit the slot; gate on the selector value instead
+                return _MISSING
+            dtype = jnp.result_type(*present)
+            stacked = jnp.stack([
+                jnp.zeros((), dtype) if v is _MISSING
+                else jnp.asarray(v, dtype)
+                for v in per_branch
+            ])
+            return stacked[idx]
 
         def rec(node: Expr):
             if isinstance(node, Literal):
@@ -451,8 +524,20 @@ class CompiledSpace:
             if isinstance(node, Choice):
                 idx = flat[node.label]
                 if traced and isinstance(idx, jax.Array):
-                    branches = [(lambda opt: (lambda _: rec(opt)))(o) for o in node.options]
-                    return jax.lax.switch(jnp.asarray(idx, jnp.int32), branches, None)
+                    outs = [rec(o) for o in node.options]
+                    merged = union_select(jnp.asarray(idx, jnp.int32), outs)
+                    if merged is _MISSING:
+                        # e.g. hp.choice over bare strings, or branches whose
+                        # structures cannot be reconciled — never leak the
+                        # sentinel into the objective
+                        raise InvalidAnnotatedParameter(
+                            f"hp.choice({node.label!r}) branches cannot be "
+                            "merged under jit (non-numeric or structurally "
+                            "incompatible options); encode the options as "
+                            "indices/numbers for traced evaluation, or "
+                            "evaluate this space on host"
+                        )
+                    return merged
                 idx = int(np.asarray(idx).item()) if not isinstance(idx, int) else idx
                 return rec(node.options[idx])
             if isinstance(node, Op):

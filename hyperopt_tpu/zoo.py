@@ -303,6 +303,35 @@ def _hr_conditional():
     return DomainZoo(name="hr_conditional", space=space, objective=obj, loss_target=-1.0)
 
 
+_ML_N, _ML_DIM, _ML_FOLDS = 512, 16, 4
+
+
+def _ml_dataset():
+    """The shared synthetic binary-classification task for the ML domains:
+    deterministic (numpy rng 42), 16 features, label noise.  PURE NUMPY and
+    built lazily on first objective call — jax ops here would initialize the
+    backend at import (hangs when the ambient tunnel is broken) or cache
+    escaping tracers when first touched under a trace."""
+    import functools
+
+    @functools.lru_cache(maxsize=1)
+    def build():
+        import numpy as np
+
+        n, dim, folds = _ML_N, _ML_DIM, _ML_FOLDS
+        rng = np.random.default_rng(42)
+        w_true = rng.standard_normal(dim).astype(np.float32)
+        X = rng.standard_normal((n, dim)).astype(np.float32)
+        margin = X @ w_true / np.sqrt(dim)
+        y = (margin + 0.6 * rng.standard_normal(n) > 0).astype(np.float32)
+        return X.reshape(folds, n // folds, dim), y.reshape(folds, n // folds)
+
+    return build
+
+
+_ml_data = _ml_dataset()
+
+
 def _ml_logreg_cv():
     """BASELINE config #4 analog: a REAL machine-learning objective — 4-fold
     cross-validated logistic regression trained by gradient descent, all pure
@@ -314,29 +343,11 @@ def _ml_logreg_cv():
     momentum (uniform) — the classic conditioning/regularization trade-off;
     the CV loss surface has a genuine basin (lr too high diverges, L2 too
     high underfits)."""
-    import functools
-
     import jax
     from jax import lax
 
-    n, dim, folds, steps = 512, 16, 4, 120
-
-    @functools.lru_cache(maxsize=1)
-    def _data():
-        # LAZY (jax backend init at module import would hang when the
-        # ambient TPU tunnel is broken — the round-3 bench failure mode) and
-        # PURE NUMPY: jax ops here would run under whatever trace first
-        # calls the objective, caching tracers that escape their scope
-        # (UnexpectedTracerError on the second jit)
-        import numpy as np
-
-        rng = np.random.default_rng(42)
-        w_true = rng.standard_normal(dim).astype(np.float32)
-        X = rng.standard_normal((n, dim)).astype(np.float32)
-        margin = X @ w_true / np.sqrt(dim)
-        noise = 0.6 * rng.standard_normal(n)
-        y = (margin + noise > 0).astype(np.float32)
-        return X.reshape(folds, n // folds, dim), y.reshape(folds, n // folds)
+    dim, folds, steps = _ML_DIM, _ML_FOLDS, 120
+    _data = _ml_data  # shared lazily-built dataset (see _ml_dataset)
 
     def _nll(w, b, Xs, ys):
         z = Xs @ w + b
@@ -384,6 +395,95 @@ def _ml_logreg_cv():
     )
 
 
+def _ml_model_select_cv():
+    """BASELINE config #4, full shape: MODEL-FAMILY SELECTION (the sklearn
+    "SVM vs RandomForest" analog) with per-family hyperparameters, all
+    traceable.  ``hp.choice`` dispatches between an L2 logistic regression
+    and a one-hidden-layer MLP (fixed width — shapes must be static under
+    jit); the traced union-merge assembly (spaces.CompiledSpace.assemble)
+    exposes both branches' hyperparameters and the objective gates on the
+    selector, so TPE learns the family preference AND each family's
+    posterior through activation masks.  Uses _ml_logreg_cv's dataset."""
+    import jax
+    from jax import lax
+
+    base = ZOO["ml_logreg_cv"]
+
+    dim, folds, steps, hidden = _ML_DIM, _ML_FOLDS, 120, 32
+    _data = _ml_data  # SAME dataset as ml_logreg_cv (shared _ml_dataset)
+
+    def _nll(logits, ys):
+        s = 2.0 * ys - 1.0
+        return jnp.mean(jnp.log1p(jnp.exp(-s * logits)))
+
+    def _train(i, params0, forward, lr, l2):
+        Xf, yf = _data()
+        va_x, va_y = Xf[i], yf[i]
+        tr_x = jnp.concatenate([Xf[j] for j in range(folds) if j != i])
+        tr_y = jnp.concatenate([yf[j] for j in range(folds) if j != i])
+
+        def loss_fn(params):
+            reg = sum(jnp.sum(p**2) for p in jax.tree.leaves(params))
+            return _nll(forward(params, tr_x), tr_y) + l2 * reg
+
+        def step(params, _):
+            g = jax.grad(loss_fn)(params)
+            return jax.tree.map(lambda p, gg: p - lr * gg, params, g), None
+
+        params, _ = lax.scan(step, params0, None, length=steps)
+        return _nll(forward(params, va_x), va_y)
+
+    def _cv_logreg(lr, l2):
+        fwd = lambda p, X: X @ p[0] + p[1]
+        p0 = (jnp.zeros(dim), jnp.float32(0.0))
+        return jnp.mean(jnp.stack([_train(i, p0, fwd, lr, l2)
+                                   for i in range(folds)]))
+
+    def _cv_mlp(lr, l2, w_scale):
+        def fwd(p, X):
+            (W1, b1, W2, b2) = p
+            h = jnp.tanh(X @ W1 + b1)
+            return h @ W2 + b2
+
+        k = jax.random.PRNGKey(7)
+        k1, k2 = jax.random.split(k)
+        p0 = (w_scale * jax.random.normal(k1, (dim, hidden)) / jnp.sqrt(dim),
+              jnp.zeros(hidden),
+              w_scale * jax.random.normal(k2, (hidden,)) / jnp.sqrt(hidden),
+              jnp.float32(0.0))
+        return jnp.mean(jnp.stack([_train(i, p0, fwd, lr, l2)
+                                   for i in range(folds)]))
+
+    space = hp.choice("model", [
+        {"m": 0,
+         "lr_lin": hp.loguniform("lr_lin", math.log(1e-4), math.log(10.0)),
+         "l2_lin": hp.loguniform("l2_lin", math.log(1e-6), math.log(1.0))},
+        {"m": 1,
+         "lr_mlp": hp.loguniform("lr_mlp", math.log(1e-4), math.log(1.0)),
+         "l2_mlp": hp.loguniform("l2_mlp", math.log(1e-6), math.log(1.0)),
+         "w_scale": hp.loguniform("w_scale", math.log(0.1), math.log(3.0))},
+    ])
+
+    def obj(d):
+        if isinstance(d.get("m"), int):  # host path: only the live branch
+            if d["m"] == 0:
+                return _cv_logreg(d["lr_lin"], d["l2_lin"])
+            return _cv_mlp(d["lr_mlp"], d["l2_mlp"], d["w_scale"])
+        # traced path: union structure — evaluate both families, gate on m
+        # (all-branch evaluation is the XLA conditional-space doctrine)
+        loss_lin = _cv_logreg(d["lr_lin"], d["l2_lin"])
+        loss_mlp = _cv_mlp(d["lr_mlp"], d["l2_mlp"], d["w_scale"])
+        return jnp.where(jnp.asarray(d["m"]) == 0, loss_lin, loss_mlp)
+
+    return DomainZoo(
+        name="ml_model_select_cv",
+        space=space,
+        objective=obj,
+        loss_target=base.loss_target,
+        traceable=True,
+    )
+
+
 ZOO = {
     d.name: d
     for d in (
@@ -402,3 +502,4 @@ ZOO = {
         _ml_logreg_cv(),
     )
 }
+ZOO["ml_model_select_cv"] = _ml_model_select_cv()

@@ -74,3 +74,25 @@ def test_device_fmin_deterministic_per_seed():
     a = fmin_device(dom.objective, dom.space, max_evals=50, seed=7)
     b = fmin_device(dom.objective, dom.space, max_evals=50, seed=7)
     assert a == b
+
+
+def test_fmin_device_mixed_structure_conditional():
+    # branches with DIFFERENT hyperparameter sets run fully on-device via
+    # the union-merge traced assembly (inactive branch slots read as zeros)
+    import jax.numpy as jnp
+
+    space = {
+        "lr": hp.loguniform("lr", -6, 0),
+        "arch": hp.choice("arch", [
+            {"w": hp.quniform("w", 16, 256, 16)},
+            {"h": hp.randint("h", 1, 9)},
+        ]),
+    }
+
+    def obj(d):
+        a = d["arch"]
+        return (jnp.log(d["lr"]) + 3.0) ** 2 + 0.001 * (a["w"] + a["h"])
+
+    best, loss = fmin_device(obj, space, max_evals=100, seed=1)
+    assert loss < 0.5
+    assert best["arch"] in (0, 1)

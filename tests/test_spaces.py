@@ -186,3 +186,104 @@ def test_sample_flat_deterministic():
     a = cs.sample_flat_jit(jax.random.PRNGKey(42))["x"]
     b = cs.sample_flat_jit(jax.random.PRNGKey(42))["x"]
     assert jnp.array_equal(a, b)
+
+
+def test_assemble_traced_union_merges_different_branch_structures():
+    # traced choice assembly must union-merge dict branches with different
+    # keys: the selected branch's values appear, the other branch's slots
+    # read as typed zeros, equal string leaves pass through, unequal ones
+    # are omitted (they cannot participate in traced compute)
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.spaces import compile_space
+
+    space = hp.choice("arch", [
+        {"kind": "mlp", "tag": "same", "w": hp.quniform("w", 16, 256, 16)},
+        {"kind": "attn", "tag": "same", "h": hp.randint("h", 1, 9)},
+    ])
+    cs = compile_space(space)
+
+    def probe(flat):
+        d = cs.assemble(flat, traced=True)
+        assert "kind" not in d  # differing strings are omitted
+        assert d["tag"] == "same"  # equal strings pass through
+        return d["w"] + 10.0 * d["h"]
+
+    out0 = jax.jit(probe)({"arch": jnp.int32(0), "w": jnp.float32(32.0),
+                           "h": jnp.int32(5)})
+    out1 = jax.jit(probe)({"arch": jnp.int32(1), "w": jnp.float32(32.0),
+                           "h": jnp.int32(5)})
+    assert float(out0) == 32.0  # branch 0: w live, h reads as 0
+    assert float(out1) == 50.0  # branch 1: h live, w reads as 0
+
+
+def test_assemble_traced_rejects_unequal_sequence_lengths():
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.exceptions import InvalidAnnotatedParameter
+    from hyperopt_tpu.spaces import compile_space
+
+    space = hp.choice("fam", [
+        {"xs": [hp.uniform("a0", 0, 1), hp.uniform("a1", 0, 1)]},
+        {"xs": [hp.uniform("b0", 0, 1)]},
+    ])
+    cs = compile_space(space)
+    flat = {"fam": jnp.int32(0), "a0": jnp.float32(0.5),
+            "a1": jnp.float32(0.5), "b0": jnp.float32(0.5)}
+    with _pytest.raises(InvalidAnnotatedParameter, match="different lengths"):
+        jax.jit(lambda f: cs.assemble(f, traced=True)["xs"][0])(flat)
+
+
+def test_pyll_stochastic_sample_compat():
+    # the reference's canonical space-preview idiom works unchanged:
+    # hyperopt.pyll.stochastic.sample(space[, rng]) -> structured draw
+    import numpy as np
+    import pytest as _pytest
+
+    from hyperopt_tpu import hp, pyll
+
+    space = {
+        "lr": hp.loguniform("lr", -6, 0),
+        "arch": hp.choice("arch", ["a", "b"]),
+    }
+    s1 = pyll.stochastic.sample(space, np.random.default_rng(0))
+    s2 = pyll.stochastic.sample(space, np.random.RandomState(0))
+    s3 = pyll.stochastic.sample(space, 42)
+    s4 = pyll.stochastic.sample(space)  # fresh entropy
+    for s in (s1, s2, s3, s4):
+        assert np.exp(-6) <= s["lr"] <= 1.0
+        assert s["arch"] in ("a", "b")
+    # same int seed -> same draw (deterministic path)
+    assert pyll.stochastic.sample(space, 42) == s3
+    # interpreter internals give a guidance error, not an import crash
+    with _pytest.raises(AttributeError, match="compiled space IR"):
+        pyll.scope
+    # as_apply aliases the IR builder
+    assert pyll.as_apply(space) is not None
+
+
+def test_assemble_traced_string_choice_raises_with_guidance():
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.exceptions import InvalidAnnotatedParameter
+    from hyperopt_tpu.spaces import compile_space
+
+    cs = compile_space({"act": hp.choice("act", ["relu", "tanh"])})
+    with _pytest.raises(InvalidAnnotatedParameter, match="encode the options"):
+        jax.jit(lambda f: cs.assemble(f, traced=True))({"act": jnp.int32(0)})
+    # mixed container/leaf branches are a space bug, reported at the slot
+    cs2 = compile_space(hp.choice("opt", [
+        {"inner": {"lr": hp.uniform("lr", 0, 1)}},
+        {"inner": 0.5},
+    ]))
+    flat = {"opt": jnp.int32(0), "lr": jnp.float32(0.3)}
+    with _pytest.raises(InvalidAnnotatedParameter, match="mix containers"):
+        jax.jit(lambda f: cs2.assemble(f, traced=True))(flat)
