@@ -14,7 +14,7 @@ JOB_STATE_*), so ``from hyperopt_tpu import fmin, hp, tpe, Trials`` — the
 canonical reference idiom — works unchanged.
 """
 
-from . import early_stop, hp, pyll, spaces
+from . import early_stop, graphviz, hp, pyll, spaces
 from .algos import rand
 from .base import (
     JOB_STATE_CANCEL,
@@ -72,6 +72,7 @@ __all__ = [
     "hp",
     "spaces",
     "pyll",
+    "graphviz",
     "early_stop",
     "fmin",
     "FMinIter",

@@ -229,7 +229,11 @@ def test_worker_cli_rejects_unknown_flag(tmp_path):
 
 
 def test_dot_hyperparameters_renders_all_nodes():
+    # the real module plus its pre-rename back-compat alias
+    import hyperopt_tpu.graphviz as gv
     from hyperopt_tpu.graphviz_mod import dot_hyperparameters
+
+    assert gv.dot_hyperparameters is dot_hyperparameters
 
     space = {
         "lr": hp.loguniform("lr", -6, 0),

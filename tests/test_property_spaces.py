@@ -1,0 +1,193 @@
+"""Property-based space-layer tests (hypothesis).
+
+Beyond the reference's example-based doctrine: random space structures
+(mixed families, nested conditional branches, random valid parameters) must
+always produce in-bounds, correctly-quantized samples, consistent activity
+masks, and a faithful flat→structured assembly.  Catches family/param edge
+cases no hand-written table covers.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.spaces import compile_space
+
+# per-test settings (NOT a load_profile at import: hypothesis profiles are
+# process-global and would silently weaken other files' property tests)
+_SETTINGS = settings(deadline=None, max_examples=15,
+                     suppress_health_check=[HealthCheck.too_slow])
+
+_finite = st.floats(-50, 50, allow_nan=False, allow_infinity=False)
+
+
+@st.composite
+def leaf_param(draw, label):
+    """One hp.* leaf plus a validator(value) -> bool."""
+    fam = draw(st.sampled_from(
+        ["uniform", "quniform", "loguniform", "normal", "qnormal",
+         "lognormal", "randint", "uniformint"]))
+    if fam == "uniform":
+        low = draw(_finite)
+        high = low + draw(st.floats(0.5, 40))
+        return hp.uniform(label, low, high), lambda v: low <= v <= high
+    if fam == "quniform":
+        low = draw(st.floats(-40, 40))
+        high = low + draw(st.floats(1.0, 40))
+        q = draw(st.sampled_from([0.5, 1.0, 2.0]))
+        return hp.quniform(label, low, high, q), (
+            lambda v: low - q <= v <= high + q
+            and abs(v / q - round(v / q)) < 1e-4
+        )
+    if fam == "loguniform":
+        low = draw(st.floats(-5, 1))
+        high = low + draw(st.floats(0.5, 4))
+        return hp.loguniform(label, low, high), (
+            lambda v: math.exp(low) * 0.999 <= v <= math.exp(high) * 1.001
+        )
+    if fam == "normal":
+        mu = draw(_finite)
+        sigma = draw(st.floats(0.1, 10))
+        return hp.normal(label, mu, sigma), (
+            lambda v: abs(v - mu) < 8 * sigma  # 8-sigma: p(false alarm) ~ 0
+        )
+    if fam == "qnormal":
+        mu = draw(st.floats(-20, 20))
+        sigma = draw(st.floats(0.5, 5))
+        q = draw(st.sampled_from([1.0, 2.0]))
+        return hp.qnormal(label, mu, sigma, q), (
+            lambda v: abs(v / q - round(v / q)) < 1e-4
+        )
+    if fam == "lognormal":
+        mu = draw(st.floats(-2, 2))
+        sigma = draw(st.floats(0.1, 1.5))
+        return hp.lognormal(label, mu, sigma), lambda v: v > 0
+    if fam == "randint":
+        upper = draw(st.integers(1, 50))
+        return hp.randint(label, upper), (
+            lambda v: 0 <= v < upper and float(v).is_integer()
+        )
+    low = draw(st.integers(-20, 20))
+    high = low + draw(st.integers(1, 30))
+    return hp.uniformint(label, low, high), (
+        lambda v: low <= v <= high and float(v).is_integer()
+    )
+
+
+@st.composite
+def space_and_validators(draw):
+    n_top = draw(st.integers(1, 4))
+    space = {}
+    validators = {}
+    for i in range(n_top):
+        label = f"p{i}"
+        node, check = draw(leaf_param(label))
+        space[label] = node
+        validators[label] = check
+    if draw(st.booleans()):  # one conditional branch pair
+        b0, c0 = draw(leaf_param("b0"))
+        b1, c1 = draw(leaf_param("b1"))
+        space["branch"] = hp.choice("branch", [{"v": b0}, {"v": b1}])
+        validators["b0"] = c0
+        validators["b1"] = c1
+    return space, validators
+
+
+@_SETTINGS
+@given(space_and_validators(), st.integers(0, 2**31 - 1))
+def test_samples_respect_bounds_and_structure(sv, seed):
+    space, validators = sv
+    cs = compile_space(space)
+    key = jax.random.PRNGKey(seed)
+
+    # structured host sample: only live labels appear; all validated
+    s = cs.sample(key)
+    for label in space:
+        if label == "branch":
+            assert s["branch"] == {"v": s["branch"]["v"]}
+        else:
+            assert validators[label](s[label]), (label, s[label])
+
+    # vmapped flat samples: every ACTIVE value validates
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(32, dtype=jnp.uint32))
+    flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    active = jax.vmap(cs.active_flat)(flats)
+    for label, check in validators.items():
+        vals = np.asarray(flats[label])
+        act = np.asarray(active[label])
+        for v, a in zip(vals, act):
+            if a:
+                assert check(float(v)), (label, float(v))
+    # conditional exclusivity: exactly one branch live per draw
+    if "branch" in space:
+        a0 = np.asarray(active["b0"])
+        a1 = np.asarray(active["b1"])
+        assert np.all(a0 ^ a1)
+
+
+@_SETTINGS
+@given(space_and_validators(), st.integers(0, 2**31 - 1))
+def test_assemble_matches_flat(sv, seed):
+    space, _ = sv
+    cs = compile_space(space)
+    flat = {k: np.asarray(v) for k, v in
+            cs.sample_flat_jit(jax.random.PRNGKey(seed)).items()}
+    s = cs.assemble(flat)
+    for label in space:
+        if label == "branch":
+            idx = int(flat["branch"])
+            live = "b0" if idx == 0 else "b1"
+            assert s["branch"]["v"] == pytest.approx(
+                float(flat[live]), rel=1e-5, abs=1e-5)
+        else:
+            assert s[label] == pytest.approx(
+                float(flat[label]), rel=1e-5, abs=1e-5)
+
+
+@_SETTINGS
+@given(space_and_validators(), st.integers(0, 2**31 - 1),
+       st.integers(0, 64))
+def test_tpe_proposals_valid_for_arbitrary_histories(sv, seed, n_obs):
+    # the full proposal kernel must emit in-bounds, finite values for EVERY
+    # label under arbitrary history masks: empty below set, labels with zero
+    # live observations (a never-taken branch), partially-active slots
+    from hyperopt_tpu.algos import tpe
+
+    space, validators = sv
+    cs = compile_space(space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 16, "gamma": 0.25, "LF": 25}
+    rng = np.random.default_rng(seed)
+    cap = 64
+    has = np.zeros(cap, bool)
+    has[:n_obs] = True
+    # histories drawn FROM THE PRIOR so per-label values are family-valid
+    keys = jax.vmap(lambda i: jax.random.fold_in(
+        jax.random.PRNGKey(seed), i))(jnp.arange(cap, dtype=jnp.uint32))
+    flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    acts = jax.vmap(cs.active_flat)(flats)
+    history = {
+        "losses": jnp.asarray(
+            np.where(has, rng.normal(size=cap), np.inf).astype(np.float32)),
+        "has_loss": jnp.asarray(has),
+        "vals": {l: jnp.asarray(np.asarray(flats[l], np.float32)) for l in cs.labels},
+        "active": {l: jnp.asarray(np.asarray(acts[l]) & has) for l in cs.labels},
+    }
+    propose = jax.jit(tpe.build_propose(cs, cfg))
+    out = propose(history, jax.random.PRNGKey(seed ^ 0x5A5A))
+    for label in cs.labels:
+        v = float(np.asarray(out[label]))
+        assert np.isfinite(v), (label, v)
+        if label in validators:
+            assert validators[label](v), (label, v)
+        elif label == "branch":
+            assert v in (0.0, 1.0)
