@@ -26,7 +26,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..algos import tpe
-from ..spaces import label_hash
 
 __all__ = [
     "make_mesh",
@@ -99,31 +98,18 @@ def propose_sharded_candidates(cs, cfg, mesh):
     if n_cand % n_shards:
         raise ValueError(f"n_EI_candidates={n_cand} not divisible by {n_shards} shards")
     local_cfg = dict(cfg, n_EI_candidates=n_cand // n_shards)
+    scored = tpe.build_propose_with_scores(cs, local_cfg)
 
     def local_best(history, key):
-        """Per-device: local candidates + local EI max (runs inside shard_map)."""
+        """Per-device: local candidates + local EI max (runs inside shard_map).
+        Reuses the shared scored-proposal kernel (incl. its grouped uniform
+        pipeline) with a shard-folded key — the only sharding-specific code
+        is the fold and the [1]-shaped packaging for the all-gather."""
         shard = jax.lax.axis_index(CAND_AXIS)
         key = jax.random.fold_in(key, shard)
-        losses = jnp.asarray(history["losses"])
-        has_loss = jnp.asarray(history["has_loss"])
-        below, above = tpe.split_below_above(
-            losses, has_loss, local_cfg["gamma"], local_cfg["LF"]
-        )
-        best_ei = {}
-        best_val = {}
-        for label in cs.labels:
-            info = cs.params[label]
-            vals = jnp.asarray(history["vals"][label])
-            active = jnp.asarray(history["active"][label])
-            k = jax.random.fold_in(key, label_hash(label))
-            b = below & active
-            a = above & active
-            if info.dist.family in ("categorical", "randint"):
-                val, ei = tpe._propose_discrete(k, info.dist, vals, b, a, local_cfg)
-            else:
-                val, ei = tpe._propose_numeric(k, info.dist, vals, b, a, local_cfg)
-            best_ei[label] = ei[None]
-            best_val[label] = val[None]
+        out = scored(history, key)
+        best_ei = {l: ei[None] for l, (_, ei) in out.items()}
+        best_val = {l: val[None] for l, (val, _) in out.items()}
         return best_ei, best_val
 
     def propose(history, key):

@@ -318,3 +318,46 @@ def test_tpe_many_dists_smoke():
     domain = ZOO["many_dists"]
     loss = _best_loss(domain, tpe.suggest, 0, 40)
     assert np.isfinite(loss)
+
+
+def test_grouped_uniform_pipeline_matches_per_label():
+    # build_propose(group=True) routes hp.uniform labels through ONE vmapped
+    # pipeline; proposals must match the unrolled per-label path (same math,
+    # same per-label fold_in keys) on a mixed conditional space
+    import jax
+
+    from hyperopt_tpu.spaces import compile_space
+
+    space = {
+        **{f"u{i}": hp.uniform(f"u{i}", -5 + i, 5 + i) for i in range(5)},
+        "lg": hp.loguniform("lg", -4, 0),
+        "q": hp.quniform("q", 0, 10, 2),
+        "c": hp.choice("c", [{"w": hp.uniform("w", 0, 1)},
+                             {"z": hp.randint("z", 5)}]),
+    }
+    cs = compile_space(space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25, "LF": 25}
+    rng = np.random.default_rng(0)
+    cap, n_obs = 64, 40
+    has = np.zeros(cap, bool)
+    has[:n_obs] = True
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i))(
+        jnp.arange(cap, dtype=jnp.uint32))
+    flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    acts = jax.vmap(cs.active_flat)(flats)
+    hist = {
+        "losses": jnp.asarray(
+            np.where(has, rng.normal(size=cap), np.inf).astype(np.float32)),
+        "has_loss": jnp.asarray(has),
+        "vals": {l: jnp.asarray(np.asarray(flats[l], np.float32))
+                 for l in cs.labels},
+        "active": {l: jnp.asarray(np.asarray(acts[l]) & has)
+                   for l in cs.labels},
+    }
+    pk = jax.random.PRNGKey(7)
+    out_g = jax.jit(tpe.build_propose(cs, cfg, group=True))(hist, pk)
+    out_p = jax.jit(tpe.build_propose(cs, cfg, group=False))(hist, pk)
+    for label in cs.labels:
+        np.testing.assert_allclose(
+            np.asarray(out_g[label]), np.asarray(out_p[label]),
+            rtol=1e-5, atol=1e-5, err_msg=label)
