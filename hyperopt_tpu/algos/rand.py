@@ -13,7 +13,8 @@ import numpy as np
 
 import jax
 
-__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs", "seed_to_key", "fold_ids"]
+__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs", "seed_to_key",
+           "fold_ids", "pad_ids_pow2"]
 
 
 def seed_to_key(seed):
@@ -95,6 +96,19 @@ def unpack_flats(cs, mat, n):
     ]
 
 
+def pad_ids_pow2(new_ids):
+    """Pad a non-empty id batch to a power-of-two ``uint32`` array by
+    repeating the last id (callers discard the extra outputs via
+    ``unpack_flats(..., n)``).  Suggest-kernel program shapes — and hence
+    XLA compiles — stay stable across queue ramp-up/drain batch sizes;
+    shared by ``rand.suggest`` and ``tpe.suggest``."""
+    ids = [int(i) & 0xFFFFFFFF for i in new_ids]
+    B = 1
+    while B < len(ids):
+        B *= 2
+    return np.asarray(ids + [ids[-1]] * (B - len(ids)), np.uint32)
+
+
 _sample_jit_cache = {}  # space signature -> jitted batched prior sampler
 
 
@@ -126,10 +140,11 @@ def suggest(new_ids, domain, trials, seed):
 
     All ids are drawn by one vmapped device program (per-id ``fold_in``
     keys, so the draws are identical whatever the batching)."""
+    if not len(new_ids):
+        return []
     seed = int(seed)
     seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
-    ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
-    mat = _get_sample_jit(domain)(seed_words, ids)
+    mat = _get_sample_jit(domain)(seed_words, pad_ids_pow2(new_ids))
     flats = unpack_flats(domain.cs, mat, len(new_ids))
     return flat_to_new_trial_docs(domain, trials, new_ids, flats)
 

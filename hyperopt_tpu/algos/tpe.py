@@ -433,11 +433,83 @@ def _prior_probs(dist: Dist) -> np.ndarray:
     raise ValueError(f"not a discrete family: {dist.family!r}")
 
 
+def _select_candidate(key, samples, ei, cfg):
+    """Pick ONE candidate from the EI scores.
+
+    ``cfg["ei_select"]``:
+
+    * ``"argmax"`` (default) — the reference's sequential semantics
+      (tpe.py sym: broadcast_best): exploit the best-scoring candidate.
+    * ``"softmax"`` — draw ``i ∝ softmax(EI / ei_tau)`` via the Gumbel-max
+      trick.  Sequential TPE gets feedback after every proposal, so a hard
+      argmax is right; a 10k-wide *batch* shares ONE posterior, and a hard
+      argmax collapses every proposal onto the same marginal mode (measured:
+      generations got WORSE than prior sampling, BENCH_r04
+      ``parallel_trials_10k_tpe``).  Each vmapped proposal carries its own
+      key, so stochastic selection spreads the batch across the whole EI
+      landscape while still favoring high-EI regions — diversity exactly
+      where the posterior is uncertain.
+
+    ``cfg["prior_eps"]`` (handled by the callers): with that probability a
+    proposal is replaced by a fresh draw from the search-space prior, so the
+    above-model keeps seeing typical points and exploration never collapses
+    even once the posterior is sharp (the batch analog of the reference's
+    prior component inside the Parzen mixture).
+    """
+    if cfg.get("ei_select", "argmax") == "softmax":
+        tau = float(cfg.get("ei_tau", 1.0))
+        u = jax.random.uniform(
+            jax.random.fold_in(key, 0x5E1EC7), ei.shape,
+            minval=_U_TINY, maxval=1.0 - _U_TINY,
+        )
+        gumbel = -jnp.log(-jnp.log(u))
+        i = jnp.argmax(ei / tau + gumbel)
+    else:
+        i = jnp.argmax(ei)
+    return samples[i], ei[i]
+
+
+def _mix_prior(key, cfg, val, ei_sel, draw, score):
+    """With probability ``cfg['prior_eps']``, replace the selected candidate
+    with a fresh search-space prior draw, scored under the same below/above
+    models (see ``_select_candidate``'s docstring for why).  The RNG
+    contract lives HERE and only here: ``fold_in(key, 0x9B10B)`` feeds the
+    draw and ``fold_in(key, 0xE9510)`` the take-gate, so the grouped and
+    per-label kernels stay draw-for-draw identical (the agreement tests
+    depend on it).  ``draw(kp) -> scalar``; ``score(xs[1]) -> EI[1]``."""
+    eps = float(cfg.get("prior_eps", 0.0))
+    if eps <= 0.0:
+        return val, ei_sel
+    xp = draw(jax.random.fold_in(key, 0x9B10B))
+    ei_p = score(xp[None])[0]
+    take = jax.random.uniform(jax.random.fold_in(key, 0xE9510), ()) < eps
+    return jnp.where(take, xp, val), jnp.where(take, ei_p, ei_sel)
+
+
+def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
+    """One draw from the search-space PRIOR of a numeric family (the
+    distribution ``rand.suggest`` samples): uniform over finite bounds,
+    ``N(mu, sigma)`` for the unbounded normal families; exp for log-space
+    families, then quantization.  Bounds may be traced scalars (grouped
+    pipeline) or static floats — both paths avoid Python branches on traced
+    values by only branching on ``math.isfinite`` of *static* floats."""
+    static_bounds = isinstance(low, float) and isinstance(high, float)
+    if (not static_bounds) or (math.isfinite(low) and math.isfinite(high)):
+        u = jax.random.uniform(key, (), minval=0.0, maxval=1.0 - _U_TINY)
+        z = low + u * (high - low)
+    else:
+        z = prior_mu + prior_sigma * jax.random.normal(key, ())
+    x = jnp.exp(z) if log_space else z
+    if q is not None:
+        x = jnp.round(x / q) * q
+    return x
+
+
 def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
     """Sample candidates from the below model, score EI = llik_below −
-    llik_above, return ``(argmax candidate, its EI)`` (tpe.py sym:
-    broadcast_best).  The EI score is what cross-shard argmax reductions
-    consume (parallel/sharding.py)."""
+    llik_above, return ``(selected candidate, its EI)`` (tpe.py sym:
+    broadcast_best; selection policy: ``_select_candidate``).  The EI score
+    is what cross-shard argmax reductions consume (parallel/sharding.py)."""
     prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(dist)
     obs = vals
     if log_space:
@@ -462,8 +534,15 @@ def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
         ll_a = gmm1_lpdf(samples, wa, ma, sa, low, high, q)
     ei = ll_b - ll_a
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)  # -inf − -inf must never win
-    i = jnp.argmax(ei)
-    return samples[i], ei[i]
+    val, ei_sel = _select_candidate(key, samples, ei, cfg)
+    lpdf = lgmm1_lpdf if log_space else gmm1_lpdf
+    return _mix_prior(
+        key, cfg, val, ei_sel,
+        lambda kp: _prior_draw_numeric(kp, prior_mu, prior_sigma, low, high,
+                                       q, log_space),
+        lambda xs: (lpdf(xs, wb, mb, sb, low, high, q)
+                    - lpdf(xs, wa, ma, sa, low, high, q)),
+    )
 
 
 def _gmm1_sample_bounded(key, weights, mus, sigmas, low, high, n_samples):
@@ -514,19 +593,105 @@ def _gmm1_lpdf_bounded(x, weights, mus, sigmas, low, high):
     return jnp.where(inb, out, -jnp.inf)
 
 
-def _propose_uniform_group(keys, obs, below, above, statics, cfg):
-    """One vmapped proposal pipeline for a whole GROUP of ``hp.uniform``
-    labels (the dominant family in wide spaces).
+def _gmm1_sample_unbounded(key, weights, mus, sigmas, n_samples):
+    """``gmm1_sample`` for the unbounded (normal/lognormal prior) families,
+    expressed with no bound inputs so a GROUP of such labels can vmap over
+    traced (mu, sigma) statics.  Draw-for-draw identical to the static
+    general path with ``low=-inf, high=+inf`` (there, alpha=0/beta=1 make
+    ``u = a + u0*(b-a)`` collapse to ``u0`` exactly)."""
+    cdf = jnp.cumsum(weights)
+    cdf = cdf / jnp.maximum(cdf[-1], EPS)
+    k_comp, k_u = jax.random.split(key)
+    u_comp = jax.random.uniform(k_comp, (n_samples,))
+    comp = jnp.sum(u_comp[:, None] > cdf[None, :], axis=1)
+    comp = jnp.minimum(comp, weights.shape[0] - 1)
+    onehot = (comp[:, None] == jnp.arange(weights.shape[0])[None, :]).astype(
+        jnp.float32
+    )
+    picked = onehot @ jnp.stack([mus, sigmas], axis=1)
+    u = jnp.clip(jax.random.uniform(k_u, (n_samples,)), _U_TINY, 1.0 - _U_TINY)
+    return picked[:, 0] + picked[:, 1] * ndtri(u)
+
+
+def _gmm1_lpdf_unbounded(x, weights, mus, sigmas):
+    """``gmm1_lpdf`` (q=None, no truncation) with traced component params;
+    formula-identical to the static path at infinite bounds (p_accept =
+    sum(weights))."""
+    comp = jnp.log(jnp.maximum(weights, EPS))[:, None] + _normal_logpdf(
+        x[None, :], mus[:, None], sigmas[:, None]
+    )
+    comp = jnp.where(weights[:, None] > 0, comp, -jnp.inf)
+    return logsumexp(comp, axis=0) - jnp.log(jnp.maximum(jnp.sum(weights), EPS))
+
+
+def _q_lpdf_group(x, weights, mus, sigmas, lo, hi, q, islog, bounded,
+                  has_log=True):
+    """Quantized-bin log-density with TRACED statics, matching the static
+    ``gmm1_lpdf``/``lgmm1_lpdf`` q-paths bin for bin: each value-space bin
+    ``[x-q/2, x+q/2]`` is integrated by cdf differences — normal cdf on the
+    (traced-)bounded support for linear families, lognormal cdf with the
+    lower edge clamped at 0 for log families; ``islog`` selects per label.
+    ``bounded`` and ``has_log`` are static per GROUP (the quantized normal
+    families have no truncation and p_accept = sum(weights); a group with
+    no log labels skips the dead lognormal branch entirely)."""
+    xT = x[None, :]
+    ub, lb = xT + q / 2, xT - q / 2
+    ubn, lbn = (jnp.minimum(ub, hi), jnp.maximum(lb, lo)) if bounded else (ub, lb)
+    pn = jnp.sum(
+        weights[:, None]
+        * (normal_cdf(ubn, mus[:, None], sigmas[:, None])
+           - normal_cdf(lbn, mus[:, None], sigmas[:, None])),
+        axis=0,
+    )
+    if has_log:
+        lbl = jnp.maximum(lb, 0.0)
+        ubl, lbl = ((jnp.minimum(ub, jnp.exp(hi)), jnp.maximum(lbl, jnp.exp(lo)))
+                    if bounded else (ub, lbl))
+        pl = jnp.sum(
+            weights[:, None]
+            * (lognormal_cdf(ubl, mus[:, None], sigmas[:, None])
+               - lognormal_cdf(lbl, mus[:, None], sigmas[:, None])),
+            axis=0,
+        )
+        prob = jnp.where(islog, pl, pn)
+    else:
+        prob = pn
+    if bounded:
+        alpha = normal_cdf(lo, mus, sigmas)
+        beta = normal_cdf(hi, mus, sigmas)
+        p_accept = jnp.sum(weights * jnp.clip(beta - alpha, 0.0, 1.0))
+    else:
+        p_accept = jnp.sum(weights)
+    return jnp.log(jnp.maximum(prob, EPS)) - jnp.log(jnp.maximum(p_accept, EPS))
+
+
+def _propose_numeric_group(keys, obs, below, above, statics, cfg,
+                           quantized, bounded, has_log=True):
+    """One vmapped proposal pipeline for a whole GROUP of numeric labels
+    sharing a (quantized?, bounded?) shape.
 
     Per-label, this is the same math as ``_propose_numeric`` — same key
     derivation, same Parzen fit, same sampler and EI — but expressed ONCE
     and vmapped over the label axis instead of unrolled per label, so the
     traced program (and its XLA compile time) stays constant as the label
-    count grows.  Measured: a 26-uniform-label space compiles ~an order of
-    magnitude faster with no change in proposals (tests assert agreement
-    with the per-label path)."""
+    count grows (round-4 grouped only ``hp.uniform``; round 5 extends to
+    every numeric family, with q/log/bounds as traced statics and the
+    quantized/bounded branch structure static per group).  The Parzen fit,
+    sampling, EI selection and eps-prior mixing all run in z-space (log
+    space for log families; the Jacobian term of the log-space density
+    cancels inside ``EI = ll_below − ll_above``, so EI scores match the
+    per-label path exactly); quantization happens in value space as in the
+    static kernels.  Tests assert per-family agreement with the unrolled
+    path."""
 
-    def one(key, obs_l, b_l, a_l, pmu, psig, lo, hi):
+    def one(key, obs_l, b_l, a_l, pmu, psig, lo, hi, q, islog):
+        def to_value(z):
+            """z-space -> value space (identity for linear labels; skipped
+            statically when the group has no log labels)."""
+            return jnp.where(islog, jnp.exp(z), z) if has_log else z
+
+        obs_z = (jnp.where(islog, jnp.log(jnp.maximum(obs_l, EPS)), obs_l)
+                 if has_log else obs_l)
         fit = functools.partial(
             adaptive_parzen_normal,
             prior_weight=cfg["prior_weight"],
@@ -534,22 +699,104 @@ def _propose_uniform_group(keys, obs, below, above, statics, cfg):
             prior_sigma=psig,
             LF=cfg["LF"],
         )
-        wb, mb, sb = fit(obs_l, b_l)
-        wa, ma, sa = fit(obs_l, a_l)
+        wb, mb, sb = fit(obs_z, b_l)
+        wa, ma, sa = fit(obs_z, a_l)
         n_cand = cfg["n_EI_candidates"]
-        samples = _gmm1_sample_bounded(key, wb, mb, sb, lo, hi, n_cand)
-        ll_b = _gmm1_lpdf_bounded(samples, wb, mb, sb, lo, hi)
-        ll_a = _gmm1_lpdf_bounded(samples, wa, ma, sa, lo, hi)
-        ei = ll_b - ll_a
+        if bounded:
+            z = _gmm1_sample_bounded(key, wb, mb, sb, lo, hi, n_cand)
+        else:
+            z = _gmm1_sample_unbounded(key, wb, mb, sb, n_cand)
+        if quantized:
+            sel = jnp.round(to_value(z) / q) * q
+
+            def score(xs):
+                return (_q_lpdf_group(xs, wb, mb, sb, lo, hi, q, islog,
+                                      bounded, has_log)
+                        - _q_lpdf_group(xs, wa, ma, sa, lo, hi, q, islog,
+                                        bounded, has_log))
+        elif bounded:
+            sel = z
+
+            def score(xs):
+                return (_gmm1_lpdf_bounded(xs, wb, mb, sb, lo, hi)
+                        - _gmm1_lpdf_bounded(xs, wa, ma, sa, lo, hi))
+        else:
+            sel = z
+
+            def score(xs):
+                return (_gmm1_lpdf_unbounded(xs, wb, mb, sb)
+                        - _gmm1_lpdf_unbounded(xs, wa, ma, sa))
+
+        ei = score(sel)
         ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
-        i = jnp.argmax(ei)
-        return samples[i], ei[i]
+        val, ei_sel = _select_candidate(key, sel, ei, cfg)
+
+        def draw(kp):
+            if bounded:
+                u = jax.random.uniform(kp, (), minval=0.0,
+                                       maxval=1.0 - _U_TINY)
+                zp = lo + u * (hi - lo)
+            else:
+                zp = pmu + psig * jax.random.normal(kp, ())
+            return jnp.round(to_value(zp) / q) * q if quantized else zp
+
+        val, ei_sel = _mix_prior(key, cfg, val, ei_sel, draw, score)
+        if not quantized:
+            val = to_value(val)
+        return val, ei_sel
 
     return jax.vmap(one)(
         keys, obs, below, above,
         statics["prior_mu"], statics["prior_sigma"],
-        statics["low"], statics["high"],
+        statics["low"], statics["high"], statics["q"], statics["islog"],
     )
+
+
+def _propose_discrete_group(keys, obs, below, above, prior_ps, offsets, cfg):
+    """Vmapped ``_propose_discrete`` for a GROUP of discrete labels sharing
+    one bucket count K (the static shape); prior probabilities and randint
+    offsets ride the label axis as traced statics."""
+    K = prior_ps.shape[1]
+
+    def one(key, obs_l, b_l, a_l, prior_p, offset):
+        obs_i = obs_l.astype(jnp.int32) - offset
+        pb = categorical_posterior(obs_i, b_l, prior_p, cfg["prior_weight"],
+                                   cfg["LF"])
+        pa = categorical_posterior(obs_i, a_l, prior_p, cfg["prior_weight"],
+                                   cfg["LF"])
+        n_cand = cfg["n_EI_candidates"]
+        cdf = jnp.cumsum(pb)
+        cdf = cdf / jnp.maximum(cdf[-1], EPS)
+        u = jax.random.uniform(key, (n_cand,))
+        samples = jnp.minimum(jnp.sum(u[:, None] > cdf[None, :], axis=1), K - 1)
+        onehot = (samples[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+        logs = onehot @ jnp.stack(
+            [jnp.log(jnp.maximum(pb, EPS)), jnp.log(jnp.maximum(pa, EPS))],
+            axis=1,
+        )
+        ei = logs[:, 0] - logs[:, 1]
+        ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
+        val, ei_sel = _select_candidate(key, samples, ei, cfg)
+        val, ei_sel = _mix_prior(
+            key, cfg, val, ei_sel,
+            functools.partial(_prior_draw_discrete, prior_p=prior_p),
+            lambda xs: ((xs[:, None] == jnp.arange(K)[None, :]).astype(
+                jnp.float32)
+                @ (jnp.log(jnp.maximum(pb, EPS))
+                   - jnp.log(jnp.maximum(pa, EPS)))),
+        )
+        return val + offset, ei_sel
+
+    return jax.vmap(one)(keys, obs, below, above, prior_ps, offsets)
+
+
+def _prior_draw_discrete(kp, prior_p):
+    """One inverse-cdf bucket draw from the discrete prior."""
+    K = prior_p.shape[0]
+    cdfp = jnp.cumsum(prior_p)
+    cdfp = cdfp / jnp.maximum(cdfp[-1], EPS)
+    up = jax.random.uniform(kp, ())
+    return jnp.minimum(jnp.sum(up > cdfp), K - 1)
 
 
 def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
@@ -570,16 +817,26 @@ def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
     samples = jnp.minimum(jnp.sum(u[:, None] > cdf[None, :], axis=1), K - 1)
     onehot = (samples[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
     # clamp the logs: a zero-probability bucket would make the one-hot
-    # matmul compute 0 * -inf = NaN for EVERY candidate (zero-prob buckets
-    # are never sampled — cdf step width 0 — so the clamp changes nothing
-    # for buckets that can actually appear)
+    # matmul compute 0 * -inf = NaN for EVERY candidate.  The clamp never
+    # actually binds: categorical_posterior smooths with ``+ K *
+    # prior_weight * prior_p``, so every bucket's posterior is at least
+    # ``K * prior_weight * min(prior_p) / total`` ≫ EPS for any real prior
+    # (test_tpe.py::test_categorical_posterior_floor asserts the bound) —
+    # it is a NaN guard for hostile priors only, not a reweighting of ties
     logs = onehot @ jnp.stack(
         [jnp.log(jnp.maximum(pb, EPS)), jnp.log(jnp.maximum(pa, EPS))], axis=1
     )
     ei = logs[:, 0] - logs[:, 1]
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
-    i = jnp.argmax(ei)
-    return samples[i] + offset, ei[i]
+    val, ei_sel = _select_candidate(key, samples, ei, cfg)
+    val, ei_sel = _mix_prior(
+        key, cfg, val, ei_sel,
+        functools.partial(_prior_draw_discrete, prior_p=prior_p),
+        lambda xs: ((xs[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
+                    @ (jnp.log(jnp.maximum(pb, EPS))
+                       - jnp.log(jnp.maximum(pa, EPS)))),
+    )
+    return val + offset, ei_sel
 
 
 def build_propose_with_scores(cs, cfg, group=True):
@@ -587,50 +844,86 @@ def build_propose_with_scores(cs, cfg, group=True):
 
     The EI scores feed cross-shard argmax reductions
     (``parallel/sharding.py``); ``build_propose`` below drops them for the
-    plain ask path.  ``group=True`` (default) routes all plain
-    ``hp.uniform`` labels through one vmapped pipeline
-    (``_propose_uniform_group``) instead of unrolling a copy of the kernel
-    per label — same math and same per-label RNG keys, but the traced
-    program size (and compile time) stops growing with the uniform-label
-    count (measured: 28-label conditional space cold-compile 39.7 s →
-    21.7 s on v5e).  ``group=False`` forces the per-label path (used by the
-    agreement test)."""
-    uniform_labels = [
-        l for l in cs.labels if cs.params[l].dist.family == "uniform"
-    ] if group else []
-    use_group = len(uniform_labels) >= 2
-    if use_group:
-        parz = [_parzen_from(cs.params[l].dist) for l in uniform_labels]
-        statics = {
-            "prior_mu": jnp.asarray([p[0] for p in parz], jnp.float32),
-            "prior_sigma": jnp.asarray([p[1] for p in parz], jnp.float32),
-            "low": jnp.asarray([p[2] for p in parz], jnp.float32),
-            "high": jnp.asarray([p[3] for p in parz], jnp.float32),
-        }
-        grouped = set(uniform_labels)
-    else:
-        grouped = set()
+    plain ask path.  ``group=True`` (default) routes labels through vmapped
+    per-GROUP pipelines instead of unrolling a copy of the kernel per label
+    — same math and same per-label RNG keys, but the traced program size
+    (and XLA compile time) stops growing with the label count.  Groups:
+    numeric labels sharing a (quantized?, bounded?) branch shape (q, log
+    flag and bounds become traced statics; round 4 grouped only
+    ``hp.uniform``, measured 39.7 s → 21.7 s cold on a 28-label space), and
+    discrete labels sharing a bucket count K.  A family with a single label
+    keeps the per-label kernel (a width-1 vmap saves nothing).
+    ``group=False`` forces the per-label path (used by the agreement
+    tests)."""
+    by_gkey = {}
+    if group:
+        for l in cs.labels:
+            dist = cs.params[l].dist
+            if dist.family in ("categorical", "randint"):
+                gkey = ("disc", len(_prior_probs(dist)))
+            else:
+                _, _, low, high, q, _ = _parzen_from(dist)
+                gkey = ("num", q is not None,
+                        math.isfinite(low) and math.isfinite(high))
+            by_gkey.setdefault(gkey, []).append(l)
+        by_gkey = {k: ls for k, ls in by_gkey.items() if len(ls) >= 2}
+    grouped = {l for ls in by_gkey.values() for l in ls}
+
+    numeric_groups = []  # (labels, quantized, bounded, statics)
+    disc_groups = []     # (labels, prior_ps[G, K], offsets[G])
+    for gkey, ls in by_gkey.items():
+        if gkey[0] == "disc":
+            prior_ps = np.stack([_prior_probs(cs.params[l].dist) for l in ls])
+            offsets = np.asarray(
+                [int(cs.params[l].dist.params[0])
+                 if cs.params[l].dist.family == "randint" else 0
+                 for l in ls], np.int32)
+            disc_groups.append((ls, jnp.asarray(prior_ps), jnp.asarray(offsets)))
+        else:
+            _, quantized, bounded = gkey
+            parz = [_parzen_from(cs.params[l].dist) for l in ls]
+            statics = {
+                "prior_mu": jnp.asarray([p[0] for p in parz], jnp.float32),
+                "prior_sigma": jnp.asarray([p[1] for p in parz], jnp.float32),
+                # unbounded groups never read low/high; 0 placeholders keep
+                # the stacked statics finite
+                "low": jnp.asarray(
+                    [p[2] if math.isfinite(p[2]) else 0.0 for p in parz],
+                    jnp.float32),
+                "high": jnp.asarray(
+                    [p[3] if math.isfinite(p[3]) else 0.0 for p in parz],
+                    jnp.float32),
+                "q": jnp.asarray(
+                    [p[4] if p[4] is not None else 1.0 for p in parz],
+                    jnp.float32),
+                "islog": jnp.asarray([p[5] for p in parz], bool),
+            }
+            has_log = any(p[5] for p in parz)
+            numeric_groups.append((ls, quantized, bounded, has_log, statics))
 
     def propose(history, key):
         losses = jnp.asarray(history["losses"])
         has_loss = jnp.asarray(history["has_loss"])
         below, above = split_below_above(losses, has_loss, cfg["gamma"], cfg["LF"])
         out = {}
-        if use_group:
+
+        def stacked(ls):
             keys = jnp.stack([
-                jax.random.fold_in(key, label_hash(l)) for l in uniform_labels
+                jax.random.fold_in(key, label_hash(l)) for l in ls
             ])
-            obs = jnp.stack([
-                jnp.asarray(history["vals"][l]) for l in uniform_labels
-            ])
-            act = jnp.stack([
-                jnp.asarray(history["active"][l]) for l in uniform_labels
-            ])
-            vals_g, eis_g = _propose_uniform_group(
-                keys, obs, below[None, :] & act, above[None, :] & act,
-                statics, cfg,
-            )
-            for i, l in enumerate(uniform_labels):
+            obs = jnp.stack([jnp.asarray(history["vals"][l]) for l in ls])
+            act = jnp.stack([jnp.asarray(history["active"][l]) for l in ls])
+            return keys, obs, below[None, :] & act, above[None, :] & act
+
+        for ls, quantized, bounded, has_log, statics in numeric_groups:
+            vals_g, eis_g = _propose_numeric_group(
+                *stacked(ls), statics, cfg, quantized, bounded, has_log)
+            for i, l in enumerate(ls):
+                out[l] = (vals_g[i], eis_g[i])
+        for ls, prior_ps, offsets in disc_groups:
+            vals_g, eis_g = _propose_discrete_group(
+                *stacked(ls), prior_ps, offsets, cfg)
+            for i, l in enumerate(ls):
                 out[l] = (vals_g[i], eis_g[i])
         for label in cs.labels:
             if label in grouped:
@@ -686,26 +979,26 @@ def _get_propose_jit(domain, cfg_key, cfg):
 def _apply_rows(labels, history, rows):
     """Fold packed trial rows (see ``PaddedHistory._pack_row``) into the
     history arrays in-trace.  Padding rows carry an out-of-bounds index and
-    are dropped by ``mode='drop'``; the row count is a small static bucket,
-    so the loop unrolls."""
+    are dropped by ``mode='drop'``.  One VECTORIZED scatter per array (the
+    row indices are distinct by construction — every real row targets its
+    own trial slot): the traced program size is independent of the row
+    bucket, so the bucket can be a single fixed size and the fused
+    tell+ask program compiles exactly once per space."""
     L = len(labels)
-    hist = history
-    for r in range(rows.shape[0]):
-        row = rows[r]
-        i = row[2 * L + 2].astype(jnp.int32)
-        hist = {
-            "vals": {
-                l: hist["vals"][l].at[i].set(row[j], mode="drop")
-                for j, l in enumerate(labels)
-            },
-            "active": {
-                l: hist["active"][l].at[i].set(row[L + j] > 0.5, mode="drop")
-                for j, l in enumerate(labels)
-            },
-            "losses": hist["losses"].at[i].set(row[2 * L], mode="drop"),
-            "has_loss": hist["has_loss"].at[i].set(row[2 * L + 1] > 0.5, mode="drop"),
-        }
-    return hist
+    idx = rows[:, 2 * L + 2].astype(jnp.int32)  # [K]
+    return {
+        "vals": {
+            l: history["vals"][l].at[idx].set(rows[:, j], mode="drop")
+            for j, l in enumerate(labels)
+        },
+        "active": {
+            l: history["active"][l].at[idx].set(rows[:, L + j] > 0.5, mode="drop")
+            for j, l in enumerate(labels)
+        },
+        "losses": history["losses"].at[idx].set(rows[:, 2 * L], mode="drop"),
+        "has_loss": history["has_loss"].at[idx].set(rows[:, 2 * L + 1] > 0.5,
+                                                    mode="drop"),
+    }
 
 
 def _get_suggest_jit(domain, cfg_key, cfg):
@@ -760,6 +1053,9 @@ def suggest(
     n_EI_candidates=_default_n_EI_candidates,
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
+    ei_select="argmax",
+    ei_tau=1.0,
+    prior_eps=0.0,
     verbose=False,
 ):
     """Propose new trials by TPE (hyperopt/tpe.py sym: suggest).
@@ -768,7 +1064,15 @@ def suggest(
     ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` tuning.
     The first ``n_startup_jobs`` trials delegate to random search; after that
     every proposal is one jitted device program, vmapped over ``new_ids``.
+
+    ``ei_select``/``ei_tau``/``prior_eps`` are TPU-batch extensions with no
+    reference analog (the reference proposes one trial at a time):
+    stochastic EI selection and ε-prior mixing keep a WIDE ``new_ids`` batch
+    diverse when every proposal shares one posterior — see
+    ``_select_candidate``.  The defaults reproduce reference semantics.
     """
+    if not len(new_ids):
+        return []
     if len(trials.trials) < n_startup_jobs:
         return rand.suggest(new_ids, domain, trials, seed)
 
@@ -777,16 +1081,21 @@ def suggest(
         "n_EI_candidates": int(n_EI_candidates),
         "gamma": float(gamma),
         "LF": int(linear_forgetting),
+        "ei_select": str(ei_select),
+        "ei_tau": float(ei_tau),
+        "prior_eps": float(prior_eps),
     }
     cfg_key = tuple(sorted(cfg.items()))
     ph = trials.history_object(domain.cs.labels)
     dev, rows = ph.device_state()
 
     # ONE device program (fold completed trials + propose whole queue) and
-    # one single-buffer readback; the updated history stays device-resident
+    # one single-buffer readback; the updated history stays device-resident.
+    # ids pad to a power-of-two bucket (extras discarded on host) so the
+    # program shape — and hence the XLA compile — is stable across queue
+    # ramp-up/drain batch sizes.
     run = _get_suggest_jit(domain, cfg_key, cfg)
-    ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
-    new_dev, mat = run(dev, rows, _seed_words(seed), ids)
+    new_dev, mat = run(dev, rows, _seed_words(seed), rand.pad_ids_pow2(new_ids))
     ph.commit_device(new_dev)
     flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
     return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

@@ -287,7 +287,13 @@ class PaddedHistory:
         row[2 * L + 2] = float(i)  # cap ≤ 2^24: exact in f32
         return row
 
-    _ROW_BUCKETS = (1, 2, 4, 8, 16)
+    # ONE fixed row bucket: the fused tell+ask kernel folds rows with a
+    # single vectorized scatter per array (tpe._apply_rows), so a larger
+    # bucket costs nothing at trace or run time — and a FIXED bucket means
+    # the fused program compiles exactly once per space instead of once per
+    # completed-row count (round-5 compile-time item: the (rows=1, ids=4)
+    # first-call shape forced a second full XLA compile).
+    _ROW_BUCKETS = (16,)
 
     def _full_upload(self):
         self._dev = {

@@ -254,8 +254,9 @@ class FileStore:
         seconds back to NEW (worker died mid-trial) — or, with
         ``to_cancel=True``, to CANCEL instead of retrying (the SparkTrials
         timeout→JOB_STATE_CANCEL policy for jobs that must not be re-run).
-        Returns count."""
-        n = 0
+        Also sweeps aged claim-file orphans (see ``_sweep_orphan_claims``).
+        Returns count of reclaimed docs (stale RUNNING + recovered orphans)."""
+        n = self._sweep_orphan_claims(reserve_timeout)
         run_dir = os.path.join(self.root, "running")
         target = JOB_STATE_CANCEL if to_cancel else JOB_STATE_NEW
         for fname in os.listdir(run_dir):
@@ -285,6 +286,69 @@ class FileStore:
             n += 1
         return n
 
+    def _sweep_orphan_claims(self, max_age):
+        """Recover claim files orphaned by a crash mid-transition.
+
+        ``finish``/``reclaim_stale``/``cancel`` all rename the source doc to
+        a private ``*.pkl.{finish,reclaim,cancel}.<pid>`` claim before
+        writing the terminal doc; a crash in that window leaves a claim file
+        that ``load_all`` ignores (doesn't end in ``.pkl``) — the trial
+        would vanish from every state and the driver would wait until its
+        fmin timeout (advisor finding, round 4).  Any claim older than
+        ``max_age`` seconds is necessarily orphaned (live transitions take
+        milliseconds): readable finish/reclaim claims go back to NEW for
+        re-evaluation (at-least-once semantics — same policy as
+        stale-heartbeat reclaim), while cancel claims complete their
+        interrupted transition to CANCEL (a cancelled job must NOT be
+        re-run — the SparkTrials timeout policy); unreadable ones are
+        removed with a warning (there is no doc left to preserve).
+        Returns the number of docs recovered."""
+        n = 0
+        now = time.time()
+        for state_dir in _STATE_DIRS.values():
+            dirpath = os.path.join(self.root, state_dir)
+            for fname in os.listdir(dirpath):
+                if ".pkl." not in fname or ".tmp." in fname:
+                    continue
+                kind = fname.split(".pkl.", 1)[1].split(".", 1)[0]
+                if kind not in ("finish", "reclaim", "cancel"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                try:
+                    age = now - os.path.getmtime(path)
+                except FileNotFoundError:
+                    continue  # another sweeper got it
+                if age < max_age:
+                    continue
+                # claim the claim: rename to a sweep-private name so two
+                # concurrent sweepers can't both recover the same doc
+                mine = f"{path}.sweep.{os.getpid()}"
+                try:
+                    os.rename(path, mine)
+                except FileNotFoundError:
+                    continue
+                doc = self._read(mine)
+                if doc is None:
+                    logger.warning("removing unreadable orphan claim %s", fname)
+                    os.remove(mine)
+                    continue
+                if kind == "cancel":
+                    target = JOB_STATE_CANCEL
+                    doc.setdefault("result", {})
+                    doc["result"]["status"] = "fail"
+                    doc["refresh_time"] = coarse_utcnow()
+                else:
+                    target = JOB_STATE_NEW
+                    doc["owner"] = None
+                doc["state"] = target
+                _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
+                os.remove(mine)
+                logger.warning(
+                    "recovered orphaned %s claim for trial %s (%.0fs old) -> %s",
+                    kind, doc["tid"], age, _STATE_DIRS[target])
+                n += 1
+        return n
+
     def cancel(self, tid):
         """Move one NEW or RUNNING doc to CANCEL (SparkTrials job-group
         cancellation analog).  The source file is renamed away FIRST (the
@@ -301,7 +365,14 @@ class FileStore:
                 continue
             doc = self._read(claim)
             if doc is None:
-                os.remove(claim)
+                # do NOT delete: the read may have raced a partial write.
+                # Leave the claim for _sweep_orphan_claims, which recovers
+                # it (or removes it if truly unreadable) once aged —
+                # removing here would permanently destroy the trial doc
+                # (advisor finding, round 4).
+                logger.warning(
+                    "cancel(%s): claim unreadable, leaving %s for orphan sweep",
+                    tid, os.path.basename(claim))
                 continue
             doc["state"] = JOB_STATE_CANCEL
             doc.setdefault("result", {})

@@ -18,20 +18,10 @@ from .. import spaces
 __all__ = ["sample"]
 
 
-def _as_key(rng):
-    if rng is None:
-        return jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**32))
-    if isinstance(rng, jax.Array):
-        return rng
-    if isinstance(rng, (int, np.integer)):
-        return jax.random.PRNGKey(int(rng) & 0xFFFFFFFF)
-    if isinstance(rng, np.random.Generator):
-        return jax.random.PRNGKey(int(rng.integers(2**32, dtype=np.uint64)))
-    if isinstance(rng, np.random.RandomState):
-        return jax.random.PRNGKey(int(rng.randint(0, 2**31 - 1)))
-    raise TypeError(f"cannot derive a PRNG key from rng={rng!r}")
+# kept as a name for back-compat importers; the coercion lives in spaces
+_as_key = spaces.rng_to_key
 
 
 def sample(space, rng=None):
     """One structured draw from ``space`` (pyll/stochastic.py sym: sample)."""
-    return spaces.sample(space, _as_key(rng))
+    return spaces.sample(space, rng)

@@ -644,11 +644,27 @@ def draw_dist(dist: Dist, key, shape=()):
 # ---------------------------------------------------------------------------
 
 
-def sample(space: Any, key):
-    """Sample a structured point (``hyperopt.pyll.stochastic.sample``)."""
-    if isinstance(key, (int, np.integer)):
-        key = jax.random.PRNGKey(int(key))
-    return compile_space(space).sample(key)
+def rng_to_key(rng):
+    """Coerce any of a jax key / int seed / numpy ``Generator`` /
+    ``RandomState`` / None (fresh entropy) to a jax PRNG key — the single
+    coercion point shared by ``sample`` and the ``pyll.stochastic`` shim."""
+    if rng is None:
+        return jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**32))
+    if isinstance(rng, jax.Array):
+        return rng
+    if isinstance(rng, (int, np.integer)):
+        return jax.random.PRNGKey(int(rng) & 0xFFFFFFFF)
+    if isinstance(rng, np.random.Generator):
+        return jax.random.PRNGKey(int(rng.integers(2**32, dtype=np.uint64)))
+    if isinstance(rng, np.random.RandomState):
+        return jax.random.PRNGKey(int(rng.randint(0, 2**31 - 1)))
+    raise TypeError(f"cannot derive a PRNG key from rng={rng!r}")
+
+
+def sample(space: Any, key=None):
+    """Sample a structured point (``hyperopt.pyll.stochastic.sample``).
+    ``key`` may be a jax key, int seed, numpy Generator/RandomState, or None."""
+    return compile_space(space).sample(rng_to_key(key))
 
 
 def space_eval(space: Any, hp_assignment: dict):

@@ -290,6 +290,67 @@ def test_load_all_dedupes_by_state_precedence(tmp_path):
     assert len(t) == 1
 
 
+def test_orphan_claim_is_swept_back_to_new(tmp_path):
+    # a crash between the finish()/cancel()/reclaim_stale() rename-claim and
+    # the terminal write leaves a '*.pkl.finish.<pid>'-style claim that
+    # load_all ignores — the trial would vanish from every state (advisor
+    # finding, round 4).  reclaim_stale must recover aged claims to NEW.
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(lambda d: d["x"] ** 2, SPACE)
+    _insert_new(t, domain, 2)
+    doc = t.store.reserve("worker")
+    run_path = t.store._path(JOB_STATE_RUNNING, doc["tid"])
+    claim = f"{run_path}.finish.99999"
+    os.rename(run_path, claim)  # simulated crash mid-finish
+    assert all(d["tid"] != doc["tid"] for d in t.store.load_all())  # vanished
+    # fresh claims are not touched (a live transition may be in flight)
+    assert t.store.reclaim_stale(30) == 0
+    assert os.path.exists(claim)
+    # age it past the reserve timeout -> recovered to NEW for re-evaluation
+    old = time.time() - 120
+    os.utime(claim, (old, old))
+    assert t.store.reclaim_stale(30) == 1
+    recovered = [d for d in t.store.load_all() if d["tid"] == doc["tid"]]
+    assert len(recovered) == 1 and recovered[0]["state"] == JOB_STATE_NEW
+    assert not os.path.exists(claim)
+    # an orphaned CANCEL claim completes its transition to CANCEL — a
+    # cancelled job must never be resurrected to NEW and re-run
+    from hyperopt_tpu import JOB_STATE_CANCEL
+
+    doc2 = t.store.reserve("worker")
+    run2 = t.store._path(JOB_STATE_RUNNING, doc2["tid"])
+    claim2 = f"{run2}.cancel.88888"
+    os.rename(run2, claim2)  # simulated crash mid-cancel
+    os.utime(claim2, (old, old))
+    assert t.store.reclaim_stale(30) == 1
+    got = [d for d in t.store.load_all() if d["tid"] == doc2["tid"]]
+    assert len(got) == 1 and got[0]["state"] == JOB_STATE_CANCEL
+    assert got[0]["result"]["status"] == "fail"
+    # an unreadable aged claim is removed (nothing left to preserve)
+    junk = os.path.join(t.store.root, "running", "7.pkl.cancel.12345")
+    with open(junk, "wb") as f:
+        f.write(b"\x00not-a-pickle")
+    os.utime(junk, (old, old))
+    assert t.store.reclaim_stale(30) == 0
+    assert not os.path.exists(junk)
+
+
+def test_cancel_leaves_unreadable_claim_for_sweep(tmp_path):
+    # cancel() reading back None must NOT delete the claim (the read may have
+    # raced a partial write); it leaves it for the orphan sweep instead.
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(lambda d: d["x"] ** 2, SPACE)
+    _insert_new(t, domain, 1)
+    tid = t.store.load_all()[0]["tid"]
+    new_path = t.store._path(JOB_STATE_NEW, tid)
+    with open(new_path, "wb") as f:
+        f.write(b"\x00truncated")  # corrupt doc
+    assert t.store.cancel(tid) is False
+    claims = [f for f in os.listdir(os.path.join(t.store.root, "new"))
+              if ".pkl.cancel." in f]
+    assert len(claims) == 1  # preserved, not destroyed
+
+
 def test_ctrl_checkpoint_survives_worker_crash(tmp_path):
     # MongoCtrl.checkpoint doctrine: a worker checkpoints a partial result,
     # then dies -9; the partial must survive in the store — reclaimed doc

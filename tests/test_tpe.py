@@ -361,3 +361,197 @@ def test_grouped_uniform_pipeline_matches_per_label():
         np.testing.assert_allclose(
             np.asarray(out_g[label]), np.asarray(out_p[label]),
             rtol=1e-5, atol=1e-5, err_msg=label)
+
+
+# ---------------------------------------------------------------------------
+# NumPy <-> JAX formula parity (round-5 verdict #5): bench.py carries a
+# faithful numpy reimplementation of the reference hot path
+# (hyperopt/tpe.py sym: adaptive_parzen_normal, GMM1_lpdf); the jitted
+# kernels must reproduce its *formulas* on shared inputs — this catches
+# algebra drift that distribution-level statistical tests cannot.
+# ---------------------------------------------------------------------------
+
+
+def _np_ref():
+    import sys
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    return bench
+
+
+@pytest.mark.parametrize("n_obs", [1, 3, 24, 60])
+def test_adaptive_parzen_matches_numpy_reference(n_obs):
+    bench = _np_ref()
+    rng = np.random.default_rng(42 + n_obs)
+    mus = rng.uniform(-5, 5, size=n_obs)
+    prior_mu, prior_sigma, LF = 0.0, 10.0, 25
+    w_np, m_np, s_np = bench.np_adaptive_parzen_normal(
+        mus, 1.0, prior_mu, prior_sigma, LF=LF)
+
+    obs, mask = _obs(mus.astype(np.float32))
+    w_j, m_j, s_j = tpe.adaptive_parzen_normal(
+        obs, mask, 1.0, jnp.float32(prior_mu), jnp.float32(prior_sigma), LF)
+    m = n_obs + 1  # live components incl. prior
+    w_j, m_j, s_j = (np.asarray(a)[:m] for a in (w_j, m_j, s_j))
+    # the reference's 1-obs special case (obs sigma = prior_sigma/2) is
+    # deliberately subsumed by the general clip (documented substitution in
+    # adaptive_parzen_normal's docstring) — exclude sigmas for n_obs==1
+    np.testing.assert_allclose(w_j, w_np, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m_j, m_np, rtol=2e-5, atol=2e-5)
+    if n_obs > 1:
+        np.testing.assert_allclose(s_j, s_np, rtol=2e-4, atol=2e-4)
+    # dead slots carry no weight
+    assert np.asarray(tpe.adaptive_parzen_normal(
+        obs, mask, 1.0, jnp.float32(prior_mu), jnp.float32(prior_sigma), LF
+    )[0])[m:].sum() == 0.0
+
+
+def test_gmm1_lpdf_matches_numpy_reference():
+    bench = _np_ref()
+    rng = np.random.default_rng(7)
+    n_comp = 9
+    weights = rng.uniform(0.1, 1.0, n_comp)
+    weights /= weights.sum()
+    mus = np.sort(rng.uniform(-4, 4, n_comp))
+    sigmas = rng.uniform(0.3, 2.0, n_comp)
+    low, high = -5.0, 5.0
+    x = rng.uniform(low, high - 1e-3, 257)
+
+    ref = bench.np_gmm1_lpdf(x, weights, mus, sigmas, low, high)
+    got = np.asarray(tpe.gmm1_lpdf(
+        jnp.asarray(x, jnp.float32), jnp.asarray(weights, jnp.float32),
+        jnp.asarray(mus, jnp.float32), jnp.asarray(sigmas, jnp.float32),
+        low, high, None))
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+# ---------------------------------------------------------------------------
+# batch diversity (round-5 verdict #1): stochastic EI selection + eps-prior
+# mixing keep a wide batch of proposals (one shared posterior) diverse
+# ---------------------------------------------------------------------------
+
+
+def _diversity_hist(cap=64, n_obs=40, seed=0):
+    rng = np.random.default_rng(seed)
+    has = np.zeros(cap, bool)
+    has[:n_obs] = True
+    vals = np.where(has, rng.uniform(-5, 5, cap), 0).astype(np.float32)
+    # losses correlate with |x - 2|: the below model concentrates near 2
+    losses = np.where(has, np.abs(vals - 2.0) + 0.1 * rng.normal(size=cap),
+                      np.inf).astype(np.float32)
+    return {
+        "losses": jnp.asarray(losses),
+        "has_loss": jnp.asarray(has),
+        "vals": {"x": jnp.asarray(vals)},
+        "active": {"x": jnp.asarray(has)},
+    }
+
+
+def _batch_propose(cfg, batch=512):
+    from hyperopt_tpu.spaces import compile_space
+
+    cs = compile_space({"x": hp.uniform("x", -5, 5)})
+    hist = _diversity_hist()
+    propose = jax.jit(jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0)))
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(3), i))(
+        jnp.arange(batch, dtype=jnp.uint32))
+    return np.asarray(propose(hist, keys)["x"])
+
+
+def test_softmax_selection_diversifies_shared_posterior_batch():
+    base = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25, "LF": 64}
+    hard = _batch_propose(base)
+    soft = _batch_propose(dict(base, ei_select="softmax", ei_tau=1.0))
+    # argmax collapses a shared-posterior batch; softmax must spread it
+    assert np.std(soft) > np.std(hard)
+    assert len(np.unique(np.round(soft, 3))) > len(np.unique(np.round(hard, 3)))
+    # ...while still exploiting: the batch mean stays near the good region
+    assert abs(np.mean(soft) - 2.0) < 1.5
+    # and stays deterministic in the keys
+    soft2 = _batch_propose(dict(base, ei_select="softmax", ei_tau=1.0))
+    np.testing.assert_array_equal(soft, soft2)
+
+
+def test_prior_eps_mixes_in_prior_draws():
+    base = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25,
+            "LF": 64, "ei_select": "softmax", "ei_tau": 0.5}
+    pure = _batch_propose(base)
+    mixed = _batch_propose(dict(base, prior_eps=1.0))
+    # eps=1: every proposal is a prior draw -> close to uniform over [-5, 5)
+    assert np.min(mixed) < -4.0 and np.max(mixed) > 4.0
+    ks = np.max(np.abs(np.sort((mixed + 5) / 10) - np.linspace(0, 1, len(mixed))))
+    assert ks < 0.08, ks
+    # eps=0 keeps the posterior-shaped batch
+    assert np.std(pure) < np.std(mixed)
+
+
+def test_categorical_posterior_floor():
+    # the EPS clamp in _propose_discrete must never bind: prior smoothing
+    # (+ K * prior_weight * prior_p) lower-bounds every bucket's posterior
+    obs, mask = _obs([1.0] * 60)  # all mass on bucket 1
+    p = jnp.asarray([0.01, 0.98, 0.01])
+    post = np.asarray(tpe.categorical_posterior(obs, mask, p, 1.0, 100))
+    K = 3
+    total = 60.0 + K * 1.0  # counts + smoothing mass
+    floor = K * 1.0 * 0.01 / total
+    assert post.min() >= floor - 1e-7
+    assert post.min() > 1e6 * tpe.EPS  # clamp is a NaN guard, never binds
+
+
+@pytest.mark.parametrize("select_cfg", [
+    {},
+    {"ei_select": "softmax", "ei_tau": 0.7, "prior_eps": 0.3},
+])
+def test_grouped_pipelines_match_per_label_all_families(select_cfg):
+    # round-5: grouping extends beyond hp.uniform to every numeric family
+    # (quantized/log/bounds as traced statics) and discrete labels sharing a
+    # bucket count.  Each group's vmapped pipeline must reproduce the
+    # unrolled per-label kernels, including stochastic selection and
+    # eps-prior mixing (same per-label fold_in keys both ways).
+    from hyperopt_tpu.spaces import compile_space
+
+    space = {
+        # bounded continuous group: uniform + loguniform
+        "u1": hp.uniform("u1", -5, 5), "u2": hp.uniform("u2", 0, 1),
+        "lg1": hp.loguniform("lg1", -4, 0), "lg2": hp.loguniform("lg2", -2, 2),
+        # bounded quantized group: quniform + uniformint + qloguniform
+        "q1": hp.quniform("q1", 0, 10, 2), "q2": hp.quniform("q2", -4, 4, 0.5),
+        "ui": hp.uniformint("ui", 1, 9), "qlg": hp.qloguniform("qlg", 0, 3, 2),
+        # unbounded continuous group: normal + lognormal
+        "n1": hp.normal("n1", 0, 2), "n2": hp.normal("n2", 4, 7),
+        "ln": hp.lognormal("ln", -1, 1),
+        # unbounded quantized group: qnormal + qlognormal
+        "qn": hp.qnormal("qn", 0, 10, 2), "qln": hp.qlognormal("qln", 0, 2, 1),
+        # discrete groups: two K=3 categoricals, two K=6 randints
+        "c1": hp.choice("c1", [0, 1, 2]), "c2": hp.pchoice(
+            "c2", [(0.2, 0), (0.3, 1), (0.5, 2)]),
+        "r1": hp.randint("r1", 6), "r2": hp.randint("r2", 2, 8),
+    }
+    cs = compile_space(space)
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 32, "gamma": 0.25,
+           "LF": 25, **select_cfg}
+    rng = np.random.default_rng(1)
+    cap, n_obs = 64, 40
+    has = np.zeros(cap, bool)
+    has[:n_obs] = True
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i))(
+        jnp.arange(cap, dtype=jnp.uint32))
+    flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    hist = {
+        "losses": jnp.asarray(
+            np.where(has, rng.normal(size=cap), np.inf).astype(np.float32)),
+        "has_loss": jnp.asarray(has),
+        "vals": {l: jnp.asarray(np.asarray(flats[l], np.float32))
+                 for l in cs.labels},
+        "active": {l: jnp.asarray(has) for l in cs.labels},
+    }
+    pk = jax.random.PRNGKey(11)
+    out_g = jax.jit(tpe.build_propose(cs, cfg, group=True))(hist, pk)
+    out_p = jax.jit(tpe.build_propose(cs, cfg, group=False))(hist, pk)
+    for label in cs.labels:
+        np.testing.assert_allclose(
+            np.asarray(out_g[label]), np.asarray(out_p[label]),
+            rtol=1e-5, atol=1e-5, err_msg=label)
