@@ -490,11 +490,11 @@ def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
     """One draw from the search-space PRIOR of a numeric family (the
     distribution ``rand.suggest`` samples): uniform over finite bounds,
     ``N(mu, sigma)`` for the unbounded normal families; exp for log-space
-    families, then quantization.  Bounds may be traced scalars (grouped
-    pipeline) or static floats — both paths avoid Python branches on traced
-    values by only branching on ``math.isfinite`` of *static* floats."""
-    static_bounds = isinstance(low, float) and isinstance(high, float)
-    if (not static_bounds) or (math.isfinite(low) and math.isfinite(high)):
+    families, then quantization.  ``low``/``high`` must be STATIC floats —
+    the per-label kernel's contract (the grouped pipeline draws inline with
+    its own static ``bounded`` flag; see ``_propose_numeric_group``)."""
+    low, high = float(low), float(high)  # a traced bound raises here, loudly
+    if math.isfinite(low) and math.isfinite(high):
         u = jax.random.uniform(key, (), minval=0.0, maxval=1.0 - _U_TINY)
         z = low + u * (high - low)
     else:

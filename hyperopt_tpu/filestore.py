@@ -77,6 +77,28 @@ def _atomic_write(path, payload: bytes):
     os.replace(tmp, path)
 
 
+def _touch(path):
+    """Reset a claim file's mtime to NOW.  ``os.rename`` preserves the
+    source's mtime (the doc's last heartbeat write — arbitrarily old), and
+    the orphan sweep ages claims by mtime; without the touch a LIVE
+    finish/reclaim transition could be swept mid-flight."""
+    try:
+        os.utime(path, None)
+    except FileNotFoundError:
+        pass
+
+
+def _remove_quiet(path):
+    """Remove a claim, tolerating its theft by the orphan sweep (possible
+    only if this process stalled longer than the sweep's max_age between
+    rename and remove — the terminal doc is already written either way and
+    state precedence dedupes)."""
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+
+
 class FileStore:
     """Low-level durable job store (hyperopt/mongoexp.py sym: MongoJobs)."""
 
@@ -238,6 +260,7 @@ class FileStore:
                 "trial %s was cancelled/reclaimed before finish; dropping %s",
                 tid, "error" if error is not None else "result")
             return False
+        _touch(claim)  # claim age = NOW, not the doc's last heartbeat write
         doc["refresh_time"] = coarse_utcnow()
         if error is not None:
             doc["state"] = JOB_STATE_ERROR
@@ -246,17 +269,18 @@ class FileStore:
             doc["state"] = JOB_STATE_DONE
             doc["result"] = result
         self.write_doc(doc)
-        os.remove(claim)
+        _remove_quiet(claim)
         return True
 
     def reclaim_stale(self, reserve_timeout, to_cancel=False):
         """Move RUNNING docs whose heartbeat is older than reserve_timeout
         seconds back to NEW (worker died mid-trial) — or, with
         ``to_cancel=True``, to CANCEL instead of retrying (the SparkTrials
-        timeout→JOB_STATE_CANCEL policy for jobs that must not be re-run).
-        Also sweeps aged claim-file orphans (see ``_sweep_orphan_claims``).
-        Returns count of reclaimed docs (stale RUNNING + recovered orphans)."""
-        n = self._sweep_orphan_claims(reserve_timeout)
+        timeout→JOB_STATE_CANCEL policy for jobs that must not be re-run;
+        the orphan sweep honors the same policy).  Also sweeps aged
+        claim-file orphans (see ``_sweep_orphan_claims``).  Returns count of
+        reclaimed docs (stale RUNNING + recovered orphans)."""
+        n = self._sweep_orphan_claims(reserve_timeout, to_cancel=to_cancel)
         run_dir = os.path.join(self.root, "running")
         target = JOB_STATE_CANCEL if to_cancel else JOB_STATE_NEW
         for fname in os.listdir(run_dir):
@@ -277,16 +301,17 @@ class FileStore:
                 os.rename(path, claim)
             except FileNotFoundError:
                 continue
+            _touch(claim)
             doc["state"] = target
             doc["owner"] = None
             _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
-            os.remove(claim)
+            _remove_quiet(claim)
             logger.warning("reclaimed stale trial %s (heartbeat %.0fs old) -> %s",
                            doc["tid"], age, _STATE_DIRS[target])
             n += 1
         return n
 
-    def _sweep_orphan_claims(self, max_age):
+    def _sweep_orphan_claims(self, max_age, to_cancel=False):
         """Recover claim files orphaned by a crash mid-transition.
 
         ``finish``/``reclaim_stale``/``cancel`` all rename the source doc to
@@ -295,13 +320,14 @@ class FileStore:
         that ``load_all`` ignores (doesn't end in ``.pkl``) — the trial
         would vanish from every state and the driver would wait until its
         fmin timeout (advisor finding, round 4).  Any claim older than
-        ``max_age`` seconds is necessarily orphaned (live transitions take
-        milliseconds): readable finish/reclaim claims go back to NEW for
-        re-evaluation (at-least-once semantics — same policy as
-        stale-heartbeat reclaim), while cancel claims complete their
-        interrupted transition to CANCEL (a cancelled job must NOT be
-        re-run — the SparkTrials timeout policy); unreadable ones are
-        removed with a warning (there is no doc left to preserve).
+        ``max_age`` seconds is necessarily orphaned — live transitions
+        ``_touch`` their claim at creation, so claim mtime measures claim
+        age, not the doc's last heartbeat.  Readable finish/reclaim claims
+        go back to NEW for re-evaluation (at-least-once semantics — same
+        policy as stale-heartbeat reclaim), or to CANCEL under
+        ``to_cancel=True`` (the must-not-re-run policy); cancel claims
+        always complete their interrupted transition to CANCEL; unreadable
+        ones are removed with a warning (there is no doc left to preserve).
         Returns the number of docs recovered."""
         n = 0
         now = time.time()
@@ -332,7 +358,7 @@ class FileStore:
                     logger.warning("removing unreadable orphan claim %s", fname)
                     os.remove(mine)
                     continue
-                if kind == "cancel":
+                if kind == "cancel" or to_cancel:
                     target = JOB_STATE_CANCEL
                     doc.setdefault("result", {})
                     doc["result"]["status"] = "fail"
@@ -363,6 +389,7 @@ class FileStore:
                 os.rename(src, claim)
             except FileNotFoundError:
                 continue
+            _touch(claim)
             doc = self._read(claim)
             if doc is None:
                 # do NOT delete: the read may have raced a partial write.
@@ -379,7 +406,7 @@ class FileStore:
             doc["result"]["status"] = "fail"
             doc["refresh_time"] = coarse_utcnow()
             _atomic_write(self._path(JOB_STATE_CANCEL, tid), pickle.dumps(doc))
-            os.remove(claim)
+            _remove_quiet(claim)
             return True
         return False
 

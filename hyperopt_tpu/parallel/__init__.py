@@ -14,7 +14,13 @@ MongoDB workers or Spark executors (``hyperopt/mongoexp.py`` sym: MongoTrials,
   ``Trials.asynchronous`` protocol (``ExecutorTrials``: worker pool for
   arbitrary objectives, one vmapped device call per queue for traceable
   ones).
+* ``multihost`` — the ``jax.distributed`` wiring (global mesh, replication,
+  deterministic global key batches).
+* ``driver`` — the end-to-end SPMD multi-controller ``fmin_multihost``:
+  global proposals, per-controller evaluation shards, deterministic folds,
+  divergence checksum (the MongoTrials.fmin + MongoWorker analog).
 """
 
 from . import executor, sharding  # noqa: F401
 from .executor import ExecutorTrials  # noqa: F401
+from .driver import fmin_multihost, MultihostResult, ControllerDivergence  # noqa: F401

@@ -1,0 +1,258 @@
+"""End-to-end multi-controller fmin: the whole ask→tell loop under
+``jax.distributed``.
+
+Parity target: the reference's distributed story is a complete driver —
+``hyperopt/mongoexp.py`` (sym: MongoTrials + MongoWorker, SURVEY.md §3.4):
+one mongod holds the trial state, N worker hosts race to claim and evaluate
+trials, the driver folds results as they land.  The TPU-native equivalent is
+**SPMD**: every controller process runs this SAME driver; there is no
+coordinator beyond ``jax.distributed``'s runtime.  Per generation:
+
+1. **Propose globally** — one batch of ``B`` proposals from the shared TPE
+   posterior via ``sharding.suggest_batch_sharded`` over the GLOBAL mesh
+   (per-trial keys sharded across every process's devices; history
+   replicated).  Proposals are deterministic in ``(seed, global trial id,
+   history)``, so every controller sees the same global batch.
+2. **Shard evaluation** — controller ``p`` evaluates trials ``j`` with
+   ``j % P == p`` (round-robin keeps the load balanced when objective cost
+   varies with position in the batch).  This is the MongoWorker analog: the
+   expensive objective work is what distributes.
+3. **Fold deterministically** — per-controller losses are exchanged with
+   ``multihost_utils.process_allgather`` and folded into the padded history
+   in GLOBAL trial-id order, so every controller assembles a bitwise
+   identical history whatever the completion interleaving (the async
+   out-of-order hazard of the Mongo design cannot occur by construction).
+4. **Divergence checksum** — a digest of the folded rows is allgathered and
+   compared; any mismatch (nondeterministic objective, history corruption,
+   compiler divergence across hosts) raises ``ControllerDivergence``
+   immediately on every controller instead of silently optimizing different
+   posteriors.  (``multihost.replicate_global`` trusts cross-process value
+   equality; this is the guard that makes the trust checkable.)
+
+The loop is deterministic in ``(seed, batch, max_evals)`` and INDEPENDENT of
+the process count: ``fmin_multihost(..., _force_single=True)`` runs the
+identical algorithm on one process, and the 2-process test asserts the
+results match bitwise (tests/_multihost_child.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..exceptions import AllTrialsFailed
+from ..spaces import compile_space
+from ..algos import tpe
+
+__all__ = ["fmin_multihost", "MultihostResult", "ControllerDivergence"]
+
+
+class ControllerDivergence(RuntimeError):
+    """Controllers assembled different global histories (nondeterministic
+    objective or corrupt replication) — optimization state is no longer
+    consistent across processes."""
+
+
+@dataclasses.dataclass
+class MultihostResult:
+    """What every controller returns (identical on all of them)."""
+
+    best: dict            # structured best sample (space_eval form)
+    best_loss: float
+    n_evals: int
+    losses: np.ndarray    # [n_evals] in global trial-id order
+    vals: dict            # {label: np.ndarray[n_evals]} flat history
+    checksum: str         # digest of the folded history (divergence guard)
+
+
+def _default_cfg(batch):
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 1.0,
+           "LF": 100}
+    if batch > 1:
+        # wide shared-posterior batches need diversity-preserving selection
+        # (see tpe._select_candidate)
+        cfg.update(ei_select="softmax", ei_tau=0.5, prior_eps=0.1)
+    return cfg
+
+
+def _gen_seed(seed, gen):
+    """Per-generation base seed, deterministic in (seed, gen)."""
+    return (int(seed) + 0x9E3779B1 * (gen + 1)) & 0xFFFFFFFF
+
+
+def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
+                   n_startup=None, _force_single=False):
+    """Minimize ``fn`` over ``space`` across every process of a
+    ``jax.distributed`` runtime.  Call from ALL processes with identical
+    arguments (SPMD); returns the same :class:`MultihostResult` everywhere.
+
+    ``fn`` is a host callable on the structured sample (the reference's
+    objective contract).  ``batch`` proposals are issued per generation
+    (default: one per global device).  ``_force_single`` runs the identical
+    algorithm on this process alone — the determinism reference the
+    multi-process result must match bitwise.
+    """
+    single = _force_single or jax.process_count() == 1
+    if single:
+        pid, P = 0, 1
+    else:
+        pid, P = jax.process_index(), jax.process_count()
+        from jax.experimental import multihost_utils
+
+    cs = compile_space(space)
+    labels = cs.labels
+    n_dev = len(jax.devices())
+    if batch is None:
+        batch = n_dev
+    cfg = dict(_default_cfg(batch), **(cfg or {}))
+    if n_startup is None:
+        n_startup = max(batch, 20)
+
+    cap = 128
+    while cap < max_evals:
+        cap *= 2
+    hist = {
+        "losses": np.full(cap, np.inf, np.float32),
+        "has_loss": np.zeros(cap, bool),
+        "vals": {l: np.zeros(cap, np.float32) for l in labels},
+        "active": {l: np.zeros(cap, bool) for l in labels},
+    }
+
+    # the proposal kernels: a plain local vmap in single mode, the
+    # global-mesh sharded program otherwise (bitwise-identical outputs —
+    # the mesh test asserts it)
+    if single:
+        propose_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg),
+                                      in_axes=(None, 0)))
+        sample_fn = jax.jit(jax.vmap(cs.sample_flat))
+    else:
+        from . import multihost, sharding
+
+        mesh = multihost.global_mesh()
+        # packed=True: one [batch, L] buffer -> ONE cross-host collective
+        # per generation instead of one per label
+        propose_sharded = sharding.suggest_batch_sharded(cs, cfg, mesh,
+                                                         packed=True)
+        sample_fn = jax.jit(jax.vmap(cs.sample_flat))
+
+    def local_keys(gseed):
+        return jax.vmap(
+            lambda i: jax.random.fold_in(jax.random.PRNGKey(gseed), i)
+        )(jnp.arange(batch, dtype=jnp.uint32))
+
+    def gather_packed(mat):
+        """GLOBALLY-SHARDED ``[batch, L]`` packed proposals -> per-label
+        host arrays on every process, via ONE allgather.  (Locally-computed
+        arrays — the startup sampler — are already whole on every process
+        and must NOT be allgathered: process_allgather concatenates local
+        arrays.)"""
+        full = np.asarray(
+            multihost_utils.process_allgather(mat, tiled=True)
+        ).reshape(batch, len(labels))
+        return {l: full[:, j] for j, l in enumerate(labels)}
+
+    digest = hashlib.sha256()
+    n_done = 0
+    gen = 0
+    while n_done < max_evals:
+        B = min(batch, max_evals - n_done)
+        gseed = _gen_seed(seed, gen)
+        if n_done < n_startup:
+            # deterministic in (gseed, index): every process computes the
+            # whole startup batch locally, no exchange needed
+            out = sample_fn(local_keys(gseed))
+            flats = {l: np.asarray(out[l]) for l in labels}
+        elif single:
+            out = propose_fn(jax.tree.map(jnp.asarray, hist),
+                             local_keys(gseed))
+            flats = {l: np.asarray(out[l]) for l in labels}
+        else:
+            keys = multihost.global_key_batch(gseed, batch, mesh)
+            hist_dev = multihost.replicate_global(hist, mesh)
+            flats = gather_packed(propose_sharded(hist_dev, keys))
+
+        def flat_j(j):
+            """Host-typed flat sample (int families come back exact off the
+            packed f32 arrays — same coercion as rand.unpack_flats)."""
+            return {
+                l: (int(round(float(flats[l][j]))) if cs.params[l].is_int
+                    else float(flats[l][j]))
+                for l in labels
+            }
+
+        # evaluate MY shard (round-robin by global position in the batch)
+        my_js = [j for j in range(B) if j % P == pid]
+        my_losses = np.full(len(my_js), np.nan, np.float32)
+        for k, j in enumerate(my_js):
+            try:
+                my_losses[k] = float(fn(cs.assemble(flat_j(j))))
+            except Exception:
+                my_losses[k] = np.nan  # failed trial: no loss, stays typical
+        if single:
+            losses = my_losses
+        else:
+            # pad to the max shard width so allgather shapes agree, then
+            # reassemble in global order: j = p + k*P
+            width = (B + P - 1) // P
+            padded = np.full(width, np.nan, np.float32)
+            padded[: len(my_losses)] = my_losses
+            gathered = np.asarray(
+                multihost_utils.process_allgather(jnp.asarray(padded))
+            ).reshape(P, width)
+            losses = np.full(B, np.nan, np.float32)
+            for p in range(P):
+                js = np.arange(p, B, P)
+                losses[js] = gathered[p, : len(js)]
+
+        # deterministic fold, global trial-id order
+        for j in range(B):
+            i = n_done + j
+            ok = np.isfinite(losses[j])
+            hist["losses"][i] = losses[j] if ok else np.inf
+            hist["has_loss"][i] = ok
+            for l in labels:
+                hist["vals"][l][i] = flats[l][j]
+            act = cs.active_flat(flat_j(j))
+            for l in labels:
+                hist["active"][l][i] = bool(act[l])
+            digest.update(np.float32(losses[j]).tobytes())
+            digest.update(
+                b"".join(np.float32(flats[l][j]).tobytes() for l in labels))
+        n_done += B
+        gen += 1
+
+        # divergence checksum: every controller must have folded the same
+        # bytes in the same order
+        if not single:
+            h = int.from_bytes(digest.digest()[:8], "big")
+            all_h = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray(np.uint64(h))))
+            if not np.all(all_h == all_h.reshape(-1)[0]):
+                raise ControllerDivergence(
+                    f"history checksums diverged after {n_done} trials: "
+                    f"{[hex(int(x)) for x in all_h.reshape(-1)]}")
+
+    live = hist["has_loss"][:n_done]
+    losses_all = hist["losses"][:n_done]
+    if not live.any():
+        raise AllTrialsFailed(
+            f"all {n_done} trials failed (objective raised on every call)")
+    best_i = int(np.argmin(np.where(live, losses_all, np.inf)))
+    best_flat = {
+        l: (int(round(float(hist["vals"][l][best_i])))
+            if cs.params[l].is_int else float(hist["vals"][l][best_i]))
+        for l in labels
+    }
+    return MultihostResult(
+        best=cs.assemble(best_flat),
+        best_loss=float(losses_all[best_i]),
+        n_evals=n_done,
+        losses=losses_all.copy(),
+        vals={l: hist["vals"][l][:n_done].copy() for l in labels},
+        checksum=digest.hexdigest(),
+    )

@@ -58,14 +58,21 @@ def replicate_history(history, mesh):
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), history)
 
 
-def suggest_batch_sharded(cs, cfg, mesh):
+def suggest_batch_sharded(cs, cfg, mesh, packed=False):
     """Data-parallel batched proposal: keys sharded over every mesh device,
-    history replicated.  Returns ``fn(history, keys) -> {label: [batch]}``.
+    history replicated.  Returns ``fn(history, keys) -> {label: [batch]}``
+    — or, with ``packed=True``, ``-> [batch, L]`` (``rand.pack_labels``
+    order), the one-buffer form: a multi-controller driver can then
+    exchange a whole generation with a SINGLE cross-host collective instead
+    of one per label (collective launch latency dominates [batch]-sized
+    transfers over DCN).
 
     Mathematically identical to the unsharded ``vmap`` (each proposal is
     independent), so results match a single-device run bitwise — the dryrun
     asserts exactly that.
     """
+    from ..algos import rand
+
     propose = jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0))
     key_sharding = NamedSharding(mesh, P((TRIALS_AXIS, CAND_AXIS)))
     rep = NamedSharding(mesh, P())
@@ -74,9 +81,14 @@ def suggest_batch_sharded(cs, cfg, mesh):
         "vals": {l: 0 for l in cs.labels},
         "active": {l: 0 for l in cs.labels},
     })
-    out_sharding = {l: key_sharding for l in cs.labels}
+    if packed:
+        fn = lambda h, k: rand.pack_labels(cs, propose(h, k))  # noqa: E731
+        out_sharding = key_sharding  # [batch, L]: batch axis sharded
+    else:
+        fn = propose
+        out_sharding = {l: key_sharding for l in cs.labels}
     return jax.jit(
-        propose,
+        fn,
         in_shardings=(hist_shardings, key_sharding),
         out_shardings=out_sharding,
     )

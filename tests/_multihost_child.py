@@ -97,7 +97,25 @@ def main():
         v = np.asarray(multihost_utils.process_allgather(out2[label], tiled=True))
         assert np.all(np.isfinite(v)), f"non-finite proposal for {label}"
 
-    print(f"MULTIHOST_OK process={pid}", flush=True)
+    # END-TO-END multi-controller fmin (round-5 verdict #2): both
+    # controllers run the whole ask->tell loop — global sharded proposals,
+    # per-controller evaluation shards, allgather fold, checksum — and the
+    # result must match the single-process reference algorithm BITWISE.
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    obj = lambda d: float(dom.objective(d))  # noqa: E731
+    res = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=0)
+    assert res.n_evals == 48
+    ref = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=0,
+                         _force_single=True)
+    assert res.checksum == ref.checksum, (res.checksum, ref.checksum)
+    assert res.best_loss == ref.best_loss, (res.best_loss, ref.best_loss)
+    np.testing.assert_array_equal(res.losses, ref.losses)
+    assert res.best_loss < 2.0, res.best_loss  # it optimized, not just ran
+
+    print(f"MULTIHOST_OK process={pid} fmin_best={res.best_loss:.4f}", flush=True)
 
 
 if __name__ == "__main__":

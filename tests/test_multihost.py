@@ -54,3 +54,61 @@ def test_two_process_mesh_matches_single_process():
     for rc, out, err in outs:
         assert rc == 0, f"child failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
         assert "MULTIHOST_OK" in out, out
+
+
+def test_fmin_multihost_single_process_deterministic():
+    # the same SPMD driver runs single-process (P=1): deterministic in seed,
+    # optimizes, and exposes the divergence-guard checksum
+    import numpy as np
+
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    obj = lambda d: float(dom.objective(d))  # noqa: E731
+    r1 = fmin_multihost(obj, dom.space, max_evals=64, batch=16, seed=0)
+    r2 = fmin_multihost(obj, dom.space, max_evals=64, batch=16, seed=0)
+    assert r1.n_evals == 64 and r1.losses.shape == (64,)
+    assert r1.checksum == r2.checksum
+    assert r1.best_loss == r2.best_loss < 2.0
+    r3 = fmin_multihost(obj, dom.space, max_evals=64, batch=16, seed=1)
+    assert r3.checksum != r1.checksum  # seed actually matters
+
+
+def test_fmin_multihost_conditional_space():
+    # conditional space: int coercion for choice indices, activation masks,
+    # and failed-trial (exception) handling
+    import numpy as np
+
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["q1_choice"]
+
+    calls = {"n": 0}
+
+    def obj(d):
+        calls["n"] += 1
+        if calls["n"] % 7 == 3:
+            raise RuntimeError("flaky trial")
+        return float(dom.objective(d))
+
+    r = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=0)
+    assert r.n_evals == 48
+    assert np.isfinite(r.best_loss) and r.best_loss < 3.0
+    assert "x" in r.best  # structured sample assembled from the best flat
+
+
+def test_fmin_multihost_all_failed_raises():
+    import pytest as _pytest
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.exceptions import AllTrialsFailed
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    def bad(_):
+        raise RuntimeError("boom")
+
+    with _pytest.raises(AllTrialsFailed):
+        fmin_multihost(bad, {"x": hp.uniform("x", 0, 1)}, max_evals=8,
+                       batch=8, seed=0)
