@@ -669,6 +669,7 @@ class Trials:
         show_progressbar=True,
         early_stop_fn=None,
         trials_save_file="",
+        device_loop=False,
     ):
         from .fmin import fmin as _fmin
 
@@ -690,6 +691,7 @@ class Trials:
             show_progressbar=show_progressbar,
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
+            device_loop=device_loop,
         )
 
     # pickle: drop the numpy history (rebuilt lazily) for a compact file, and

@@ -135,7 +135,9 @@ class FMinIter:
         show_progressbar=True,
         early_stop_fn=None,
         trials_save_file="",
+        device_loop=False,
     ):
+        self.device_loop = device_loop
         self.algo = algo
         self.domain = domain
         self.trials = trials
@@ -156,6 +158,11 @@ class FMinIter:
             poll_interval_secs = getattr(trials, "poll_interval_secs", 1.0)
         self.poll_interval_secs = poll_interval_secs
         self.max_evals = max_evals
+        # surface the eval budget to budget-aware suggesters (aTPE reads it
+        # via featurize_trials; the reference's suggest protocol has no
+        # budget channel, so it rides the trials object)
+        if max_evals != float("inf"):
+            trials.max_evals_hint = int(max_evals)
         self.timeout = timeout
         self.loss_threshold = loss_threshold
         self.start_time = time.time()
@@ -276,7 +283,146 @@ class FMinIter:
         with self._profiler_ctx():
             self._run(N, block_until_done)
 
+    def _device_loop_plan(self):
+        """Resolve ``device_loop`` eligibility.  Returns ``(plan, reasons)``
+        where plan is ``(tpe_cfg, n_startup)`` or None with the blocking
+        reasons.  Eligible = queue-1 synchronous fresh run, a tpe/rand
+        suggester (possibly ``functools.partial``-tuned), and an objective
+        that traces to a scalar float."""
+        import functools as _ft
+
+        from .algos import rand as _rand
+        from .algos import tpe as _tpe
+        from .device_fmin import objective_is_traceable
+
+        reasons = []
+        if self.asynchronous:
+            reasons.append("asynchronous trials backend")
+        if self.max_queue_len != 1:
+            reasons.append("max_queue_len != 1 (host loop already amortizes)")
+        if self.max_evals == float("inf"):
+            reasons.append("unbounded max_evals")
+        if len(self.trials):
+            reasons.append("non-empty trials (resume is host-loop only)")
+        algo, kwargs = self.algo, {}
+        while isinstance(algo, _ft.partial):
+            for k, v in (algo.keywords or {}).items():
+                kwargs.setdefault(k, v)
+            algo = algo.func
+        if algo not in (_tpe.suggest, _rand.suggest):
+            reasons.append("algo is not tpe.suggest / rand.suggest")
+        allowed = {"prior_weight", "n_startup_jobs", "n_EI_candidates",
+                   "gamma", "linear_forgetting", "ei_select", "ei_tau",
+                   "prior_eps"}
+        unknown = set(kwargs) - allowed
+        if unknown:
+            reasons.append(f"unsupported algo kwargs {sorted(unknown)}")
+        if not reasons and not objective_is_traceable(self.domain):
+            reasons.append("objective does not trace to a scalar float")
+        if reasons:
+            return None, reasons
+        cfg = {
+            "prior_weight": float(kwargs.get("prior_weight", 1.0)),
+            "n_EI_candidates": int(kwargs.get("n_EI_candidates", 24)),
+            "gamma": float(kwargs.get("gamma", 0.25)),
+            "LF": int(kwargs.get("linear_forgetting", 25)),
+        }
+        for k in ("ei_select", "ei_tau", "prior_eps"):
+            if k in kwargs:
+                cfg[k] = kwargs[k]
+        n_startup = (int(self.max_evals) if algo is _rand.suggest
+                     else int(kwargs.get("n_startup_jobs", 20)))
+        return (cfg, n_startup), []
+
+    def _run_device(self, N, plan):
+        """The device-stepped queue-1 loop: CHUNK fresh-posterior trials per
+        dispatch, one readback each (see ``device_fmin.DeviceLoopRunner``).
+        Reference-shaped docs, chunk-granular timeout / early_stop /
+        loss_threshold / checkpointing."""
+        from .device_fmin import DeviceLoopRunner
+
+        cfg, n_startup = plan
+        trials = self.trials
+        cs = self.domain.cs
+        L = len(cs.labels)
+        cap = int(self.max_evals)
+        runner = DeviceLoopRunner(self.domain, cfg, n_startup, cap)
+        state = runner.init_state()
+        target = min(cap, int(N))
+        n_done = 0
+        stopped = False
+        best_loss = float("inf")
+        with progress_mod.get_progress_callback(self.show_progressbar)(
+            initial=0, total=self.max_evals
+        ) as progress_ctx:
+            while n_done < target and not stopped:
+                limit = min(n_done + runner.CHUNK, target)
+                seed = (self.rstate.integers(2**31 - 1)
+                        if hasattr(self.rstate, "integers")
+                        else self.rstate.randint(2**31 - 1))
+                with self._timed("suggest"):
+                    state, rows = runner.run_chunk(state, n_done, limit, seed)
+                k = limit - n_done
+                new_ids = trials.new_trial_ids(k)
+                now = coarse_utcnow()
+                # reference-shaped docs via the one doc builder every
+                # suggester uses (rand.flat_to_new_trial_docs recomputes the
+                # active masks from the full flat sample — same math the
+                # kernel applied in-trace), then mark them completed
+                from .algos import rand as _rand
+
+                flats = [
+                    {l: (int(round(float(rows[j][jj])))
+                         if cs.params[l].is_int else float(rows[j][jj]))
+                     for jj, l in enumerate(cs.labels)}
+                    for j in range(k)
+                ]
+                docs = _rand.flat_to_new_trial_docs(
+                    self.domain, trials, new_ids, flats)
+                for j, doc in enumerate(docs):
+                    loss = float(rows[j][2 * L])
+                    if np.isfinite(loss):
+                        best_loss = min(best_loss, loss)
+                        doc["result"] = {"loss": loss, "status": STATUS_OK}
+                    else:
+                        doc["result"] = {"status": "fail"}
+                    doc["state"] = JOB_STATE_DONE
+                    doc["book_time"] = now
+                    doc["refresh_time"] = now
+                trials.insert_trial_docs(docs)
+                with self._timed("refresh"):
+                    trials.refresh()
+                n_done = limit
+                if self.trials_save_file != "":
+                    with self._timed("save"):
+                        self._save_trials()
+                if self.early_stop_fn is not None:
+                    stop, kw = self.early_stop_fn(trials, *self.early_stop_args)
+                    self.early_stop_args = kw
+                    if stop:
+                        logger.info("Early stop triggered")
+                        stopped = True
+                if np.isfinite(best_loss):
+                    progress_ctx.postfix = f"best loss: {best_loss:.6g}"
+                progress_ctx.update(k)
+                if (self.timeout is not None
+                        and time.time() - self.start_time >= self.timeout):
+                    stopped = True
+                if (self.loss_threshold is not None
+                        and best_loss <= self.loss_threshold):
+                    stopped = True
+
     def _run(self, N, block_until_done=True):
+        if self.device_loop:
+            plan, reasons = self._device_loop_plan()
+            if plan is not None:
+                return self._run_device(N, plan)
+            if self.device_loop is True:
+                raise ValueError(
+                    "device_loop=True requested but the run is ineligible: "
+                    + "; ".join(reasons))
+            logger.info("device_loop='auto': using host loop (%s)",
+                        "; ".join(reasons))
         trials = self.trials
         algo = self.algo
         n_queued = 0
@@ -429,11 +575,20 @@ def fmin(
     show_progressbar=True,
     early_stop_fn=None,
     trials_save_file="",
+    device_loop=False,
 ):
     """Minimize ``fn`` over ``space`` (hyperopt/fmin.py sym: fmin).
 
     Full keyword parity with the reference; seed defaults to the
     ``HYPEROPT_FMIN_SEED`` environment variable when set.
+
+    ``device_loop`` (TPU extension, no reference analog): ``True`` or
+    ``"auto"`` runs the queue-1 loop as chunked device programs when the
+    objective is JAX-traceable — identical fresh-posterior-per-trial
+    semantics, but ~one accelerator round trip per 10 trials instead of
+    per trial (the high-latency-link mitigation; see
+    ``device_fmin.DeviceLoopRunner``).  ``"auto"`` silently falls back to
+    the host loop when ineligible; ``True`` raises with the reasons.
     """
     if algo is None:
         try:
@@ -488,6 +643,7 @@ def fmin(
             show_progressbar=show_progressbar,
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
+            device_loop=device_loop,
         )
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
@@ -505,6 +661,7 @@ def fmin(
         show_progressbar=show_progressbar,
         early_stop_fn=early_stop_fn,
         trials_save_file=trials_save_file,
+        device_loop=device_loop,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()

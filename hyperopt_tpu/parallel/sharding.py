@@ -94,9 +94,11 @@ def suggest_batch_sharded(cs, cfg, mesh, packed=False):
     )
 
 
-def propose_sharded_candidates(cs, cfg, mesh):
+def propose_sharded_candidates(cs, cfg, mesh, packed=False):
     """One proposal with the candidate axis sharded over ``mesh``'s ``cand``
-    axis via ``shard_map``.
+    axis via ``shard_map``.  ``packed=True`` returns a ``[1, L]`` buffer
+    (``rand.pack_labels`` order) so the host fetches ONE transfer instead
+    of one per label.
 
     Each device fits the same below/above Parzen models (history replicated),
     draws ``n_EI_candidates / n_shards`` candidates with a device-folded key,
@@ -132,8 +134,11 @@ def propose_sharded_candidates(cs, cfg, mesh):
             out_specs=(P(CAND_AXIS), P(CAND_AXIS)),
         )(history, key)
         # ei_g/val_g: [n_shards] per label; global argmax over shards
-        return {
-            l: val_g[l][jnp.argmax(ei_g[l])] for l in cs.labels
-        }
+        out = {l: val_g[l][jnp.argmax(ei_g[l])] for l in cs.labels}
+        if packed:
+            from ..algos import rand
+
+            return rand.pack_labels(cs, {l: out[l][None] for l in cs.labels})
+        return out
 
     return jax.jit(propose)

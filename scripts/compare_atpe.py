@@ -1,0 +1,60 @@
+"""aTPE-vs-TPE zoo comparison (generates the BASELINE.md table).
+
+Run on forced CPU:
+
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu PYTHONPATH=/root/repo \
+        python scripts/compare_atpe.py [--domains d1,d2] [--seeds N] [--evals N]
+
+Prints one line per domain with mean best loss for each algo and a final
+summary JSON.
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin
+from hyperopt_tpu.algos import atpe, tpe
+from hyperopt_tpu.zoo import ZOO
+
+DOMAINS = ["branin", "hartmann6", "gauss_wave", "distractor", "rosenbrock4",
+           "quadratic1", "hr_conditional"]
+
+
+def best_loss(domain, algo, seed, max_evals):
+    t = Trials()
+    fmin(domain.objective, domain.space, algo=algo, max_evals=max_evals,
+         trials=t, rstate=np.random.default_rng(seed), show_progressbar=False)
+    return min(l for l in t.losses() if l is not None)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--domains", default=",".join(DOMAINS))
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--evals", type=int, default=75)
+    args = ap.parse_args()
+
+    rows = {}
+    for name in args.domains.split(","):
+        dom = ZOO[name]
+        t_best = [best_loss(dom, tpe.suggest, s, args.evals)
+                  for s in range(args.seeds)]
+        a_best = [best_loss(dom, atpe.suggest, s, args.evals)
+                  for s in range(args.seeds)]
+        t_m, a_m = float(np.mean(t_best)), float(np.mean(a_best))
+        span = max(abs(t_m), 1e-9)
+        rows[name] = {"tpe": t_m, "atpe": a_m,
+                      "atpe_wins": bool(a_m <= t_m),
+                      "rel_worse": float(max(a_m - t_m, 0.0) / span)}
+        print(f"{name}: tpe={t_m:.6g} atpe={a_m:.6g} "
+              f"{'WIN' if a_m <= t_m else 'LOSS'}", flush=True)
+    wins = sum(r["atpe_wins"] for r in rows.values())
+    print(json.dumps({"wins": wins, "total": len(rows), "rows": rows},
+                     indent=1), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

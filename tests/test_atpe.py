@@ -146,3 +146,38 @@ def test_atpe_optimizer_overrides_win():
     domain = Domain(dom.objective, dom.space)
     rec = opt.recommend(domain, t)
     assert rec["n_EI_candidates"] == 64 and rec["gamma"] == 0.3
+
+
+def test_predict_is_budget_aware():
+    # round-5: random startup must never eat more than ~a fifth of a known
+    # eval budget (the round-4 rule spent up to 60 of 75 evals exploring)
+    wide_cond = _space_feats(n_params=25, frac_conditional=0.9)
+    no_budget = atpe.predict_tpe_params(wide_cond, _feats(n_trials=0))
+    assert no_budget["n_startup_jobs"] >= 40  # the unconstrained rule
+    capped = atpe.predict_tpe_params(
+        wide_cond, {**_feats(n_trials=0), "budget": 75})
+    assert capped["n_startup_jobs"] <= 15
+    # and fmin actually surfaces the budget on the trials object
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.zoo import ZOO
+
+    t = Trials()
+    dom = ZOO["quadratic1"]
+    fmin(dom.objective, dom.space, algo=atpe.suggest, max_evals=25, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert t.max_evals_hint == 25
+    assert atpe.featurize_trials(t)["budget"] == 25
+
+
+def test_predict_gamma_and_candidates_bounded():
+    # gamma adaptation clips at 0.35 and n_EI_candidates no longer ramps
+    # with history length (both measured hurting low-dim domains, BASELINE.md)
+    stuck_small = atpe.predict_tpe_params(
+        _space_feats(n_params=2),
+        _feats(n_trials=70, loss_spread=0.05, recent_improvement=0.0))
+    assert stuck_small["gamma"] <= 0.35 + 1e-9
+    early = atpe.predict_tpe_params(_space_feats(n_params=2), _feats(n_trials=20))
+    late = atpe.predict_tpe_params(_space_feats(n_params=2), _feats(n_trials=70))
+    assert early["n_EI_candidates"] == late["n_EI_candidates"]

@@ -21,6 +21,61 @@ def test_domain_runs_under_rand(name):
     assert np.all(np.isfinite(losses))
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_tpe_beats_random_zoo_wide(name):
+    # round-5 verdict #7: the reference's suggester doctrine is
+    # TPE-beats-random across the WHOLE zoo (hyperopt/tests/test_tpe.py
+    # CasePerDomain), not on a favored subset.  Paired seeds, matched eval
+    # budget; tolerance admits ties on domains both solve (n_arms) and seed
+    # noise on the rest.
+    domain = ZOO[name]
+    # the ML CV domains cost ~1s/eval in the eager host loop; a smaller
+    # paired budget keeps the suite's wall clock sane without changing the
+    # comparison's validity
+    heavy = name.startswith("ml_")
+    seeds, budget = (range(2), 30) if heavy else (range(3), 50)
+
+    # traceable objectives run eagerly in the host loop — jit once so the
+    # evals don't pay per-op dispatch.  Branch-shaped host samples (e.g.
+    # ml_model_select_cv carries only the live branch's params) cannot
+    # trace; fall back to the eager objective on the first failure.
+    import jax
+
+    state = {"fn": jax.jit(domain.objective) if domain.traceable
+             else domain.objective,
+             "jitted": domain.traceable}
+
+    def obj(d):
+        # diverged ML fits return NaN; the host loop's reference semantics
+        # raise InvalidLoss on NaN, so report those as failed trials (the
+        # status='fail' contract) instead
+        try:
+            v = float(state["fn"](d))
+        except Exception:
+            if not state["jitted"]:
+                raise
+            state["fn"], state["jitted"] = domain.objective, False
+            v = float(state["fn"](d))
+        return {"loss": v, "status": "ok"} if np.isfinite(v) else {
+            "status": "fail"}
+
+    def mean_best(algo):
+        outs = []
+        for s in seeds:
+            t = Trials()
+            fmin(obj, domain.space, algo=algo, max_evals=budget,
+                 trials=t, rstate=np.random.default_rng(s),
+                 show_progressbar=False)
+            outs.append(min(l for l in t.losses() if l is not None))
+        return float(np.mean(outs))
+
+    tpe_mean = mean_best(tpe.suggest)
+    rand_mean = mean_best(rand.suggest)
+    assert tpe_mean <= rand_mean + 0.05 * abs(rand_mean) + 1e-3, (
+        name, tpe_mean, rand_mean)
+
+
 @pytest.mark.parametrize("name", ["quadratic1", "branin", "q1_choice"])
 def test_tpe_hits_loss_target(name):
     domain = ZOO[name]

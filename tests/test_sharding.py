@@ -112,3 +112,42 @@ def test_graft_entry_single_chip_and_multichip():
     out = jax.jit(fn)(*args)
     assert set(out) == set(compile_space(__graft_entry__._flagship_space()).labels)
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_suggest_sharded_fmin_end_to_end():
+    # round-5 verdict #6: the sharded kernels must be reachable from the
+    # user-facing algo= boundary — a real fmin on the 8-device CPU mesh,
+    # trial-axis sharding for queue batches
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    t = Trials()
+    algo = tpe.suggest_sharded(n_startup_jobs=16, n_EI_candidates=32)
+    fmin(dom.objective, dom.space, algo=algo, max_evals=64, trials=t,
+         max_queue_len=8, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    assert len(t) == 64
+    best = min(l for l in t.losses() if l is not None)
+    assert best < 2.0, best
+
+
+def test_suggest_sharded_candidate_axis_fmin():
+    # single-proposal queue -> candidate-axis shard_map path (all-gather EI
+    # argmax across devices), end to end through fmin
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import tpe
+
+    t = Trials()
+    algo = tpe.suggest_sharded(n_cand_shards=2, n_startup_jobs=10,
+                               n_EI_candidates=64)
+    fmin(lambda d: (d["x"] - 2.0) ** 2, {"x": hp.uniform("x", -5, 5)},
+         algo=algo, max_evals=30, trials=t,
+         rstate=np.random.default_rng(1), show_progressbar=False)
+    assert len(t) == 30
+    assert min(l for l in t.losses() if l is not None) < 1.0
