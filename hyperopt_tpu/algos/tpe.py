@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.scipy.special import logsumexp, ndtri
 
 from ..spaces import Dist, label_hash
+from ..utils import LRUCache
 from . import rand
 
 __all__ = [
@@ -963,7 +964,9 @@ def build_propose(cs, cfg, group=True):
 
 
 _propose_jit_cache = {}  # (space signature, cfg) -> jitted vmapped propose
-_suggest_jit_cache = {}  # (space signature, cfg) -> fused tell+ask program
+# (space signature, cfg) -> fused tell+ask program; LRU-bounded — every
+# entry pins a compiled XLA executable
+_suggest_jit_cache = LRUCache(32)
 
 
 def _get_propose_jit(domain, cfg_key, cfg):
@@ -1028,7 +1031,8 @@ def _get_suggest_jit(domain, cfg_key, cfg):
             out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
             return hist, rand.pack_labels(cs, out)
 
-        fn = _suggest_jit_cache[key] = jax.jit(run)
+        fn = jax.jit(run)
+        _suggest_jit_cache.put(key, fn)
     return fn
 
 
@@ -1102,7 +1106,9 @@ def suggest(
     return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)
 
 
-_sharded_jit_cache = {}  # (space sig, cfg, mesh geometry, kind) -> jitted fn
+# (space sig, cfg, mesh geometry, kind) -> jitted fn; LRU-bounded like
+# _suggest_jit_cache
+_sharded_jit_cache = LRUCache(32)
 
 
 def suggest_sharded(
@@ -1185,7 +1191,7 @@ def suggest_sharded(
                 fn = _sh.suggest_batch_sharded(cs, cfg, m, packed=True)
             else:
                 fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True)
-            _sharded_jit_cache[cache_key] = fn
+            _sharded_jit_cache.put(cache_key, fn)
 
         ph = trials.history_object(cs.labels)
         hv = ph.device_view()

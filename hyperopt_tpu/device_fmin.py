@@ -25,56 +25,66 @@ import jax.numpy as jnp
 
 from .algos import tpe
 from .base import JOB_STATE_DONE, STATUS_OK, Trials
+from .utils import LRUCache
 from .spaces import compile_space, draw_dist, label_hash
 
 __all__ = ["fmin_device", "DeviceLoopRunner", "objective_is_traceable"]
 
 # compiled-run cache: (space expr, objective, capacity, cfg) -> jitted run.
 # Expr trees are frozen dataclasses (hashable); objectives hash by identity.
-# LRU-bounded: each entry pins the user's closure AND a compiled XLA program,
-# so an unbounded dict would leak memory across sweeps of per-call lambdas.
-_RUN_CACHE_MAX = 16
-_RUN_CACHE: "dict" = {}
+_RUN_CACHE = LRUCache(16)
 
 
-def _cache_get(key):
-    fn = _RUN_CACHE.pop(key, None)
-    if fn is not None:
-        _RUN_CACHE[key] = fn  # re-insert: most-recently-used at the end
-    return fn
+def _int_labels(cs):
+    """Labels whose evaluation dtype is i32 — the same rule as
+    ``ParamInfo.is_int`` (INT_FAMILIES incl. ``uniformint``, plus int-cast
+    q-families), so the traced objective sees exactly the dtypes the host
+    loop's trial docs deliver."""
+    return {l for l, info in cs.params.items() if info.is_int}
 
 
-def _cache_put(key, fn):
-    while len(_RUN_CACHE) >= _RUN_CACHE_MAX:
-        _RUN_CACHE.pop(next(iter(_RUN_CACHE)))  # evict least-recently-used
-    _RUN_CACHE[key] = fn
+def _flat_samplers(cs, cfg, with_tpe=True):
+    """``(rand_flat, tpe_flat, typed)`` shared by the whole-run scan and the
+    chunked runner — one copy of the sampling/typing semantics.
 
-
-def _build_step(cs, fn, cap, cfg, n_startup):
-    """One ask→tell step: carry = (vals, active, losses, has_loss, key)."""
-    propose = tpe.build_propose(cs, cfg)
-    int_labels = {
-        l for l, info in cs.params.items()
-        if info.dist.family in ("categorical", "randint")
-    }
+    ``with_tpe=False`` (a pure random run: startup covers the whole
+    capacity) makes ``tpe_flat`` an alias of the prior sampler instead of
+    tracing the TPE posterior — XLA compiles BOTH ``lax.cond`` branches, so
+    a never-taken TPE branch would still pay its full compile time."""
+    ints = _int_labels(cs)
 
     def rand_flat(key):
-        out = {}
-        for label, info in cs.params.items():
-            k = jax.random.fold_in(key, label_hash(label))
-            out[label] = draw_dist(info.dist, k).astype(jnp.float32)
-        return out
+        return {
+            l: draw_dist(info.dist,
+                         jax.random.fold_in(key, label_hash(l))
+                         ).astype(jnp.float32)
+            for l, info in cs.params.items()
+        }
 
-    def tpe_flat(history, key):
-        out = propose(history, key)
-        return {l: v.astype(jnp.float32) for l, v in out.items()}
+    if with_tpe:
+        propose = tpe.build_propose(cs, cfg)
+
+        def tpe_flat(history, key):
+            return {l: v.astype(jnp.float32)
+                    for l, v in propose(history, key).items()}
+    else:
+        def tpe_flat(history, key):
+            return rand_flat(key)
 
     def typed(flat):
         """Per-label values with evaluation dtypes (discrete → i32)."""
         return {
-            l: jnp.round(v).astype(jnp.int32) if l in int_labels else v
+            l: jnp.round(v).astype(jnp.int32) if l in ints else v
             for l, v in flat.items()
         }
+
+    return rand_flat, tpe_flat, typed
+
+
+def _build_step(cs, fn, cap, cfg, n_startup):
+    """One ask→tell step: carry = (vals, active, losses, has_loss, key)."""
+    rand_flat, tpe_flat, typed = _flat_samplers(cs, cfg,
+                                                with_tpe=n_startup < cap)
 
     def step(carry, i):
         vals, active, losses, has_loss, key = carry
@@ -109,13 +119,9 @@ def objective_is_traceable(domain):
     if domain.pass_expr_memo_ctrl:
         return False
     cs = domain.cs
-    int_labels = {
-        l for l, info in cs.params.items()
-        if info.dist.family in ("categorical", "randint")
-    }
+    ints = _int_labels(cs)
     flat = {
-        l: jax.ShapeDtypeStruct((), jnp.int32 if l in int_labels
-                                else jnp.float32)
+        l: jax.ShapeDtypeStruct((), jnp.int32 if l in ints else jnp.float32)
         for l in cs.labels
     }
     try:
@@ -161,38 +167,17 @@ class DeviceLoopRunner:
         # (space, objective, cap, cfg) must not recompile
         cache_key = ("chunk", cs.expr, domain.fn, self.cap, int(n_startup),
                      tuple(sorted(cfg.items())), self.CHUNK)
-        cached = _cache_get(cache_key)
+        cached = _RUN_CACHE.get(cache_key)
         if cached is not None:
             self._run_chunk = cached
             self._L = L
             return
-        propose = tpe.build_propose(cs, cfg)
-        int_labels = {
-            l for l, info in cs.params.items()
-            if info.dist.family in ("categorical", "randint")
-        }
         fn = domain.fn
         cap_i = self.cap
         chunk = self.CHUNK
         n_startup = int(n_startup)
-
-        def rand_flat(key):
-            return {
-                l: draw_dist(info.dist,
-                             jax.random.fold_in(key, label_hash(l))
-                             ).astype(jnp.float32)
-                for l, info in cs.params.items()
-            }
-
-        def tpe_flat(history, key):
-            return {l: v.astype(jnp.float32)
-                    for l, v in propose(history, key).items()}
-
-        def typed(flat):
-            return {
-                l: jnp.round(v).astype(jnp.int32) if l in int_labels else v
-                for l, v in flat.items()
-            }
+        rand_flat, tpe_flat, typed = _flat_samplers(
+            cs, cfg, with_tpe=n_startup < cap_i)
 
         @jax.jit
         def run_chunk(state, start, limit, seed_words):
@@ -245,7 +230,7 @@ class DeviceLoopRunner:
 
         self._run_chunk = run_chunk
         self._L = L
-        _cache_put(cache_key, run_chunk)
+        _RUN_CACHE.put(cache_key, run_chunk)
 
     def init_state(self):
         cap = self.cap
@@ -298,7 +283,7 @@ def fmin_device(
     }
 
     cache_key = (cs.expr, fn, cap, int(n_startup_jobs), tuple(sorted(cfg.items())))
-    run = _cache_get(cache_key)
+    run = _RUN_CACHE.get(cache_key)
     if run is None:
         step = _build_step(cs, fn, cap, cfg, int(n_startup_jobs))
 
@@ -313,7 +298,7 @@ def fmin_device(
             vals, active, losses, has_loss, _ = carry
             return vals, active, losses, has_loss, trace
 
-        _cache_put(cache_key, run)
+        _RUN_CACHE.put(cache_key, run)
 
     key = seed if isinstance(seed, jax.Array) else jax.random.PRNGKey(int(seed))
     vals, active, losses, has_loss, trace = run(key)

@@ -302,7 +302,9 @@ class FMinIter:
             reasons.append("max_queue_len != 1 (host loop already amortizes)")
         if self.max_evals == float("inf"):
             reasons.append("unbounded max_evals")
-        if len(self.trials):
+        # trials this iter's own device loop populated are resumable (the
+        # device-side history is retained on self); foreign history is not
+        if len(self.trials) != getattr(self, "_device_n_done", 0):
             reasons.append("non-empty trials (resume is host-loop only)")
         algo, kwargs = self.algo, {}
         while isinstance(algo, _ft.partial):
@@ -321,17 +323,22 @@ class FMinIter:
             reasons.append("objective does not trace to a scalar float")
         if reasons:
             return None, reasons
+        # tpe's own defaults, so host and device loops stay one optimizer
         cfg = {
-            "prior_weight": float(kwargs.get("prior_weight", 1.0)),
-            "n_EI_candidates": int(kwargs.get("n_EI_candidates", 24)),
-            "gamma": float(kwargs.get("gamma", 0.25)),
-            "LF": int(kwargs.get("linear_forgetting", 25)),
+            "prior_weight": float(
+                kwargs.get("prior_weight", _tpe._default_prior_weight)),
+            "n_EI_candidates": int(
+                kwargs.get("n_EI_candidates", _tpe._default_n_EI_candidates)),
+            "gamma": float(kwargs.get("gamma", _tpe._default_gamma)),
+            "LF": int(kwargs.get("linear_forgetting",
+                                 _tpe._default_linear_forgetting)),
         }
         for k in ("ei_select", "ei_tau", "prior_eps"):
             if k in kwargs:
                 cfg[k] = kwargs[k]
         n_startup = (int(self.max_evals) if algo is _rand.suggest
-                     else int(kwargs.get("n_startup_jobs", 20)))
+                     else int(kwargs.get("n_startup_jobs",
+                                         _tpe._default_n_startup_jobs)))
         return (cfg, n_startup), []
 
     def _run_device(self, N, plan):
@@ -347,13 +354,18 @@ class FMinIter:
         L = len(cs.labels)
         cap = int(self.max_evals)
         runner = DeviceLoopRunner(self.domain, cfg, n_startup, cap)
-        state = runner.init_state()
-        target = min(cap, int(N))
-        n_done = 0
+        # incremental runs (iterator protocol / repeated run()) continue from
+        # the device-side history this iter accumulated; _device_loop_plan
+        # guarantees len(trials) == _device_n_done when we get here
+        n_done = getattr(self, "_device_n_done", 0)
+        state = (self._device_state if n_done
+                 else runner.init_state())
+        target = min(cap, n_done + int(N))
         stopped = False
-        best_loss = float("inf")
+        prior = [l for l in trials.losses() if l is not None] if n_done else []
+        best_loss = min(prior) if prior else float("inf")
         with progress_mod.get_progress_callback(self.show_progressbar)(
-            initial=0, total=self.max_evals
+            initial=n_done, total=self.max_evals
         ) as progress_ctx:
             while n_done < target and not stopped:
                 limit = min(n_done + runner.CHUNK, target)
@@ -371,12 +383,7 @@ class FMinIter:
                 # kernel applied in-trace), then mark them completed
                 from .algos import rand as _rand
 
-                flats = [
-                    {l: (int(round(float(rows[j][jj])))
-                         if cs.params[l].is_int else float(rows[j][jj]))
-                     for jj, l in enumerate(cs.labels)}
-                    for j in range(k)
-                ]
+                flats = _rand.unpack_flats(cs, rows[:, :L], k)
                 docs = _rand.flat_to_new_trial_docs(
                     self.domain, trials, new_ids, flats)
                 for j, doc in enumerate(docs):
@@ -411,6 +418,8 @@ class FMinIter:
                 if (self.loss_threshold is not None
                         and best_loss <= self.loss_threshold):
                     stopped = True
+                self._device_state = state
+                self._device_n_done = n_done
 
     def _run(self, N, block_until_done=True):
         if self.device_loop:

@@ -27,7 +27,34 @@ __all__ = [
     "path_split_all",
     "get_closest_dir",
     "coarse_utcnow",
+    "LRUCache",
 ]
+
+
+class LRUCache:
+    """Bounded most-recently-used mapping for compiled-program caches (no
+    reference analog — upstream has no compiled programs to cache).  Each
+    entry pins an XLA executable and possibly a user closure, so the
+    unbounded-dict alternative leaks memory across sweeps of spaces, configs,
+    or per-call lambdas."""
+
+    def __init__(self, maxsize):
+        self.maxsize = int(maxsize)
+        self._d = {}
+
+    def get(self, key):
+        v = self._d.pop(key, None)
+        if v is not None:
+            self._d[key] = v  # re-insert: most-recently-used at the end
+        return v
+
+    def put(self, key, value):
+        while len(self._d) >= self.maxsize:
+            self._d.pop(next(iter(self._d)))  # evict least-recently-used
+        self._d[key] = value
+
+    def __len__(self):
+        return len(self._d)
 
 
 def import_tokens(tokens):
