@@ -11,9 +11,42 @@ per call site.
 
 from __future__ import annotations
 
+import os
 import re
 
-__all__ = ["forced_cpu_env"]
+__all__ = ["forced_cpu_env", "enable_persistent_compilation_cache"]
+
+_CACHE_CONFIGURED = False
+
+
+def enable_persistent_compilation_cache():
+    """Point jax at an on-disk compilation cache (once per process) unless
+    the user already configured one or opted out via
+    ``HYPEROPT_TPU_NO_CACHE=1``.
+
+    The TPE/rand suggest kernels cost seconds of XLA compile per space
+    (BASELINE.md compile-vs-execute split); with the persistent cache that
+    cost is paid once per MACHINE instead of once per process — every later
+    "cold" ``fmin`` starts near-warm.  Called lazily by the fmin entry
+    points, never at import (mutating global jax config on import would
+    surprise embedders)."""
+    global _CACHE_CONFIGURED
+    opt_out = os.environ.get("HYPEROPT_TPU_NO_CACHE", "").strip().lower()
+    if _CACHE_CONFIGURED or opt_out not in ("", "0", "false", "no"):
+        return
+    _CACHE_CONFIGURED = True
+    import jax
+
+    if getattr(jax.config, "jax_compilation_cache_dir", None):
+        return  # user (or bench harness) already picked a cache dir
+    path = os.path.join(os.path.expanduser("~"), ".cache", "hyperopt_tpu",
+                        "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # unwritable HOME etc.: cache is an optimization only
+        pass
 
 
 def forced_cpu_env(environ, n_devices=None):

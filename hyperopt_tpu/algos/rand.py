@@ -13,8 +13,10 @@ import numpy as np
 
 import jax
 
+from ..utils import LRUCache
+
 __all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs", "seed_to_key",
-           "fold_ids", "pad_ids_pow2"]
+           "fold_ids", "pad_ids_pow2", "pad_ids_sticky"]
 
 
 def seed_to_key(seed):
@@ -96,20 +98,37 @@ def unpack_flats(cs, mat, n):
     ]
 
 
-def pad_ids_pow2(new_ids):
-    """Pad a non-empty id batch to a power-of-two ``uint32`` array by
-    repeating the last id (callers discard the extra outputs via
-    ``unpack_flats(..., n)``).  Suggest-kernel program shapes — and hence
-    XLA compiles — stay stable across queue ramp-up/drain batch sizes;
-    shared by ``rand.suggest`` and ``tpe.suggest``."""
+def pad_ids_pow2(new_ids, min_bucket=1):
+    """Pad a non-empty id batch to a power-of-two ``uint32`` array (at least
+    ``min_bucket`` wide) by repeating the last id (callers discard the extra
+    outputs via ``unpack_flats(..., n)``).  Suggest-kernel program shapes —
+    and hence XLA compiles — stay stable across queue ramp-up/drain batch
+    sizes; shared by ``rand.suggest`` and ``tpe.suggest``.  Padding never
+    changes the kept proposals: per-id keys derive from the id VALUE, not
+    the batch position."""
     ids = [int(i) & 0xFFFFFFFF for i in new_ids]
     B = 1
-    while B < len(ids):
+    while B < max(len(ids), int(min_bucket)):
         B *= 2
     return np.asarray(ids + [ids[-1]] * (B - len(ids)), np.uint32)
 
 
-_sample_jit_cache = {}  # space signature -> jitted batched prior sampler
+def pad_ids_sticky(domain, new_ids):
+    """``pad_ids_pow2`` with a per-domain sticky floor: the bucket never
+    shrinks below the widest batch this domain has already compiled, so a
+    queue-drain tail (e.g. 2 ids after steady batches of 4) reuses the
+    existing program instead of paying a full XLA compile for a narrower
+    copy of the same kernel.  ``FMinIter`` seeds the floor from
+    ``max_queue_len`` so even the first ramp-up batch compiles the steady
+    shape."""
+    padded = pad_ids_pow2(new_ids, getattr(domain, "_ids_bucket", 1))
+    domain._ids_bucket = len(padded)
+    return padded
+
+
+# space signature -> jitted batched prior sampler; LRU-bounded — every entry
+# pins a compiled XLA executable
+_sample_jit_cache = LRUCache(32)
 
 
 def _get_sample_jit(domain):
@@ -131,7 +150,8 @@ def _get_sample_jit(domain):
             keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
             return pack_labels(cs, jax.vmap(sample_flat)(keys))
 
-        fn = _sample_jit_cache[key] = jax.jit(run)
+        fn = jax.jit(run)
+        _sample_jit_cache.put(key, fn)
     return fn
 
 
@@ -144,7 +164,7 @@ def suggest(new_ids, domain, trials, seed):
         return []
     seed = int(seed)
     seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
-    mat = _get_sample_jit(domain)(seed_words, pad_ids_pow2(new_ids))
+    mat = _get_sample_jit(domain)(seed_words, pad_ids_sticky(domain, new_ids))
     flats = unpack_flats(domain.cs, mat, len(new_ids))
     return flat_to_new_trial_docs(domain, trials, new_ids, flats)
 

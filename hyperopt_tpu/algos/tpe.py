@@ -963,21 +963,9 @@ def build_propose(cs, cfg, group=True):
     return propose
 
 
-_propose_jit_cache = {}  # (space signature, cfg) -> jitted vmapped propose
 # (space signature, cfg) -> fused tell+ask program; LRU-bounded — every
 # entry pins a compiled XLA executable
 _suggest_jit_cache = LRUCache(32)
-
-
-def _get_propose_jit(domain, cfg_key, cfg):
-    """Module-level cache of the jitted (and vmapped-over-keys) proposal fn,
-    keyed by space signature so fresh Domains reuse compiled kernels."""
-    key = (domain.cs.signature(), cfg_key)
-    fn = _propose_jit_cache.get(key)
-    if fn is None:
-        propose = build_propose(domain.cs, cfg)
-        fn = _propose_jit_cache[key] = jax.jit(jax.vmap(propose, in_axes=(None, 0)))
-    return fn
 
 
 def _apply_rows(labels, history, rows):
@@ -1100,7 +1088,7 @@ def suggest(
     # program shape — and hence the XLA compile — is stable across queue
     # ramp-up/drain batch sizes.
     run = _get_suggest_jit(domain, cfg_key, cfg)
-    new_dev, mat = run(dev, rows, _seed_words(seed), rand.pad_ids_pow2(new_ids))
+    new_dev, mat = run(dev, rows, _seed_words(seed), rand.pad_ids_sticky(domain, new_ids))
     ph.commit_device(new_dev)
     flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
     return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)
@@ -1204,7 +1192,7 @@ def suggest_sharded(
             # by the mesh (a tail queue batch of 3 on an 8-device mesh
             # would otherwise abort the run)
             n_dev = int(np.prod(list(m.shape.values())))
-            padded = rand.pad_ids_pow2(new_ids)
+            padded = rand.pad_ids_sticky(domain, new_ids)
             if len(padded) % n_dev:
                 B = ((len(padded) + n_dev - 1) // n_dev) * n_dev
                 padded = np.concatenate(

@@ -273,6 +273,9 @@ def fmin_device(
     when ``return_trials=True`` (every trial materialized as a document, so
     downstream tooling/plots work unchanged).
     """
+    from ._env import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     cs = compile_space(space)
     cap = int(max_evals)
     cfg = {

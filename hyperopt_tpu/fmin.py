@@ -137,6 +137,9 @@ class FMinIter:
         trials_save_file="",
         device_loop=False,
     ):
+        from ._env import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
         self.device_loop = device_loop
         self.algo = algo
         self.domain = domain
@@ -151,6 +154,15 @@ class FMinIter:
         if max_queue_len is None:
             max_queue_len = getattr(trials, "default_max_queue_len", 1)
         self.max_queue_len = max_queue_len
+        # seed the suggesters' sticky id-bucket floor (rand.pad_ids_sticky)
+        # from the queue depth: the first ramp-up batch then compiles the
+        # steady-state kernel shape, and queue-drain tails reuse it instead
+        # of compiling a narrower copy of the same program
+        if max_queue_len != float("inf"):
+            from .algos.rand import pad_ids_pow2
+
+            b = len(pad_ids_pow2([0], min_bucket=int(max_queue_len)))
+            domain._ids_bucket = max(getattr(domain, "_ids_bucket", 1), b)
         # precedence: explicit argument > backend attribute > 1.0s default.
         # An async Trials backend may dictate its own polling cadence (the
         # SparkTrials pattern); in-process pools poll much faster than a DB.

@@ -395,13 +395,44 @@ class CompiledSpace:
 
     # -- sampling ---------------------------------------------------------
 
+    def _sample_groups(self):
+        """Labels grouped for batched prior draws: same family (and, for
+        categorical, same bucket count) share one vmapped kernel so the
+        traced sampler — and its XLA compile time — stops growing with the
+        label count.  Cached; order within a group follows ``self.labels``."""
+        groups = getattr(self, "_sample_groups_cache", None)
+        if groups is None:
+            groups = {}
+            for label, info in self.params.items():
+                fam = info.dist.family
+                gkey = (fam, len(info.dist.params)) if fam == "categorical" \
+                    else fam
+                groups.setdefault(gkey, []).append(label)
+            self._sample_groups_cache = groups
+        return groups
+
     def sample_flat(self, key) -> dict:
-        """Draw every parameter unconditionally; pure & jittable."""
+        """Draw every parameter unconditionally; pure & jittable.
+
+        Same-family labels draw through ONE batched kernel
+        (:func:`draw_dist_group`) — bitwise identical per label to unrolled
+        :func:`draw_dist` calls (same ``fold_in`` keys, same formulas;
+        asserted by tests/test_spaces.py), but the program no longer grows
+        with the label count."""
         out = {}
-        for label, info in self.params.items():
-            k = jax.random.fold_in(key, label_hash(label))
-            out[label] = draw_dist(info.dist, k)
-        return out
+        for _, labels in self._sample_groups().items():
+            if len(labels) == 1:
+                label = labels[0]
+                k = jax.random.fold_in(key, label_hash(label))
+                out[label] = draw_dist(self.params[label].dist, k)
+                continue
+            hashes = jnp.asarray([label_hash(l) for l in labels], jnp.uint32)
+            keys = jax.vmap(lambda h: jax.random.fold_in(key, h))(hashes)
+            vals = draw_dist_group(
+                [self.params[l].dist for l in labels], keys)
+            for i, label in enumerate(labels):
+                out[label] = vals[i]
+        return {label: out[label] for label in self.labels}
 
     def sample_flat_jit(self, key) -> dict:
         if self._sample_flat_jit is None:
@@ -636,6 +667,52 @@ def draw_dist(dist: Dist, key, shape=()):
     if fam == "categorical":
         probs = jnp.asarray(p)
         return jax.random.categorical(key, jnp.log(probs), shape=shape)
+    raise InvalidAnnotatedParameter(f"unknown family {fam!r}")
+
+
+def draw_dist_group(dists, keys):
+    """Vectorized :func:`draw_dist` for ≥2 SAME-family nodes: one batched
+    threefry per group instead of one per label, so sampler compile time is
+    O(families), not O(labels).  ``keys``: ``[G, key]`` (one per node).
+
+    Per-node results are bitwise identical to the unrolled scalar draws —
+    the vmapped primitives consume each key exactly as the scalar calls do,
+    and the per-node params broadcast through the same formulas
+    (tests/test_spaces.py::test_grouped_sampler_bitwise_matches_unrolled).
+    """
+    fam = dists[0].family
+    if fam in ("uniform", "quniform", "loguniform", "qloguniform"):
+        low = jnp.asarray([d.params[0] for d in dists])
+        high = jnp.asarray([d.params[1] for d in dists])
+        x = jax.vmap(
+            lambda k, lo, hi: jax.random.uniform(k, (), minval=lo, maxval=hi)
+        )(keys, low, high)
+        if fam in ("loguniform", "qloguniform"):
+            x = jnp.exp(x)
+        if fam in ("quniform", "qloguniform"):
+            x = _qround(x, jnp.asarray([d.params[2] for d in dists]))
+        return x
+    if fam in ("normal", "qnormal", "lognormal", "qlognormal"):
+        mu = jnp.asarray([d.params[0] for d in dists])
+        sigma = jnp.asarray([d.params[1] for d in dists])
+        x = mu + sigma * jax.vmap(lambda k: jax.random.normal(k, ()))(keys)
+        if fam in ("lognormal", "qlognormal"):
+            x = jnp.exp(x)
+        if fam in ("qnormal", "qlognormal"):
+            x = _qround(x, jnp.asarray([d.params[2] for d in dists]))
+        return x
+    if fam in ("randint", "uniformint"):
+        off = 1 if fam == "uniformint" else 0
+        lo = jnp.asarray([int(d.params[0]) for d in dists])
+        hi = jnp.asarray([int(d.params[1]) + off for d in dists])
+        return jax.vmap(
+            lambda k, a, b: jax.random.randint(k, (), a, b)
+        )(keys, lo, hi)
+    if fam == "categorical":
+        logp = jnp.log(jnp.asarray([list(d.params) for d in dists]))
+        return jax.vmap(
+            lambda k, lp: jax.random.categorical(k, lp, shape=())
+        )(keys, logp)
     raise InvalidAnnotatedParameter(f"unknown family {fam!r}")
 
 

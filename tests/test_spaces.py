@@ -287,3 +287,49 @@ def test_assemble_traced_string_choice_raises_with_guidance():
     flat = {"opt": jnp.int32(0), "lr": jnp.float32(0.3)}
     with _pytest.raises(InvalidAnnotatedParameter, match="mix containers"):
         jax.jit(lambda f: cs2.assemble(f, traced=True))(flat)
+
+
+def test_grouped_sampler_bitwise_matches_unrolled():
+    # sample_flat batches same-family labels through draw_dist_group; every
+    # per-label draw must equal the unrolled draw_dist call bitwise (same
+    # fold_in keys, same formulas) — eager AND under jit+vmap (the rand
+    # suggest kernel's shape)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.spaces import compile_space, draw_dist, label_hash
+
+    space = {
+        "u1": hp.uniform("u1", -5, 5), "u2": hp.uniform("u2", 0, 1),
+        "qu1": hp.quniform("qu1", 0, 10, 2), "qu2": hp.quniform("qu2", -4, 4, 0.5),
+        "lu1": hp.loguniform("lu1", -3, 2), "lu2": hp.loguniform("lu2", 0, 1),
+        "qlu1": hp.qloguniform("qlu1", 0, 3, 1), "qlu2": hp.qloguniform("qlu2", 1, 4, 2),
+        "n1": hp.normal("n1", 0, 1), "n2": hp.normal("n2", 3, 0.5),
+        "qn1": hp.qnormal("qn1", 0, 2, 1), "qn2": hp.qnormal("qn2", 5, 1, 0.5),
+        "ln1": hp.lognormal("ln1", 0, 1), "ln2": hp.lognormal("ln2", 1, 0.25),
+        "qln1": hp.qlognormal("qln1", 0, 1, 1), "qln2": hp.qlognormal("qln2", 1, 1, 2),
+        "ri1": hp.randint("ri1", 0, 7), "ri2": hp.randint("ri2", 3, 20),
+        "ui1": hp.uniformint("ui1", 1, 9), "ui2": hp.uniformint("ui2", 0, 3),
+        "c1": hp.choice("c1", ["a", "b", "c"]), "c2": hp.choice("c2", [1, 2, 3]),
+        "c4": hp.choice("c4", [1, 2, 3, 4]),  # different K: its own group
+    }
+    cs = compile_space(space)
+    for seed in (0, 42):
+        key = jax.random.PRNGKey(seed)
+        grouped = cs.sample_flat(key)
+        for label, info in cs.params.items():
+            ref = draw_dist(info.dist, jax.random.fold_in(key, label_hash(label)))
+            assert np.array_equal(np.asarray(ref), np.asarray(grouped[label])), label
+
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i)
+    )(jnp.arange(4, dtype=jnp.uint32))
+    outj = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    for j in range(4):
+        for label, info in cs.params.items():
+            ref = draw_dist(info.dist,
+                            jax.random.fold_in(keys[j], label_hash(label)))
+            assert np.array_equal(np.asarray(ref),
+                                  np.asarray(outj[label][j])), (j, label)
