@@ -220,11 +220,16 @@ def bench_jax(n_obs=60, n_cand=8192, repeats=50, seed=0, n_params=1, batch=None)
 
     out = propose(hist, np.uint32(0))  # compile
     force(out)
-    t0 = time.perf_counter()
-    for i in range(repeats):
-        out = propose(hist, np.uint32(i))
-    force(out)
-    dt = (time.perf_counter() - t0) / repeats
+    # best-of-3 timing blocks (same policy as the numpy baseline): transient
+    # contention on a shared tunneled chip swung single-block numbers ±40%
+    # between rounds.  Each block keeps the strict force() readback.
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(repeats):
+            out = propose(hist, np.uint32(i))
+        force(out)
+        dt = min(dt, (time.perf_counter() - t0) / repeats)
     eff = n_cand * n_params * (batch or 1)
     return {"proposals_per_sec": (batch or 1) / dt,
             "candidates_per_sec": eff / dt,
@@ -520,12 +525,18 @@ def bench_ml_cv(max_evals=64, batch=4096, seed=0):
     flats = jax.jit(jax.vmap(cs.sample_flat))(keys)
     losses = batch_eval(flats)
     jax.block_until_ready(losses)  # compile
-    t0 = time.perf_counter()
-    losses = batch_eval(flats)
-    # diverged fits (lr at the top of the log range) return NaN — real trial
-    # batches contain failures; nanmin is the honest best
-    best_prior = float(jnp.nanmin(jax.block_until_ready(losses)))
-    dt = time.perf_counter() - t0
+    # best-of-3 timing blocks, same policy as the numpy baseline: a shared
+    # tunneled chip has transient contention, and a single timed repeat
+    # (round-4's method) swung 6x between runs.  float(nanmin) forces a
+    # real host readback, so each block has strict completion semantics.
+    # Diverged fits (lr at the top of the log range) return NaN — real
+    # trial batches contain failures; nanmin is the honest best.
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        losses = batch_eval(flats)
+        best_prior = float(jnp.nanmin(jax.block_until_ready(losses)))
+        dt = min(dt, time.perf_counter() - t0)
 
     # (b) on-device HPO over the CV objective
     t1 = time.perf_counter()
