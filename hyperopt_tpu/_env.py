@@ -39,8 +39,31 @@ def enable_persistent_compilation_cache():
 
     if getattr(jax.config, "jax_compilation_cache_dir", None):
         return  # user (or bench harness) already picked a cache dir
+    # partition by configuration fingerprint: XLA's cache key does not cover
+    # every host-machine/flag difference, and loading an AOT entry compiled
+    # under another configuration logs machine-feature mismatch errors (and
+    # can SIGILL).  Processes with different platforms/XLA flags/CPUs get
+    # disjoint directories instead of sharing one.
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            # x86 spells it 'flags', aarch64 'Features'
+            cpu = next((ln for ln in f
+                        if ln.startswith(("flags", "Features"))), "")
+    except OSError:
+        cpu = ""
+    if not cpu:
+        cpu = platform.processor() or platform.machine()
+    tag = hashlib.sha1("|".join([
+        os.environ.get("JAX_PLATFORMS", ""),
+        os.environ.get("XLA_FLAGS", ""),
+        jax.__version__,
+        cpu,
+    ]).encode()).hexdigest()[:10]
     path = os.path.join(os.path.expanduser("~"), ".cache", "hyperopt_tpu",
-                        "xla")
+                        f"xla-{tag}")
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
