@@ -1,13 +1,13 @@
-"""Child process for tests/test_multihost.py: one controller of a 2-process
+"""Child process for tests/test_multihost.py: one controller of an N-process
 JAX runtime over virtual CPU devices.
 
-Usage: python _multihost_child.py <coordinator_port> <process_id>
+Usage: python _multihost_child.py <coordinator_port> <process_id> [N]
 
-The parent launches two of these; each joins the distributed runtime, forms
-the 8-device global mesh (4 local + 4 remote), runs the mesh-sharded batched
-TPE proposal, gathers the result, and compares it against the plain
-single-device computation of the SAME history and keys.  Prints
-``MULTIHOST_OK`` on success.
+The parent launches N of these (default 2); each joins the distributed
+runtime, forms the 8-device global mesh (N × 8/N local), runs the
+mesh-sharded batched TPE proposal, gathers the result, and compares it
+against the plain single-device computation of the SAME history and keys.
+Prints ``MULTIHOST_OK`` on success.
 """
 
 import sys
@@ -17,20 +17,22 @@ import numpy as np
 
 def main():
     port, pid = sys.argv[1], int(sys.argv[2])
+    n_proc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
     from hyperopt_tpu.parallel import multihost
 
     multihost.initialize(
-        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        coordinator_address=f"127.0.0.1:{port}", num_processes=n_proc,
+        process_id=pid,
     )
 
     import jax
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
-    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_count() == n_proc, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
-    assert jax.local_device_count() == 4, jax.local_device_count()
+    assert jax.local_device_count() == 8 // n_proc, jax.local_device_count()
 
     from hyperopt_tpu import hp
     from hyperopt_tpu.algos import tpe

@@ -111,6 +111,27 @@ def test_fmin_early_stop_fn():
     assert len(t) < 500
 
 
+def test_fmin_tpe_crosses_history_capacity_bucket():
+    # 150 TPE evals crosses the 128-slot PaddedHistory bucket mid-run: the
+    # fused tell+ask kernel re-specializes on the 256-cap shapes and the
+    # device mirror re-uploads — the optimizer must keep improving across
+    # the boundary and the trial docs stay intact
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=150, trials=t,
+         max_queue_len=4, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    assert len(t) == 150
+    assert t.history_object(("x",)).cap == 256
+    losses = [l for l in t.losses() if l is not None]
+    assert len(losses) == 150
+    # the post-growth tail is still posterior-guided, not prior noise: its
+    # best lands near the optimum (a uniform draw on [-5,5] hits
+    # quad<1.0 with p≈0.1; 22 prior draws would miss far more often than
+    # the seed-pinned posterior does)
+    assert min(losses[128:]) < 1.0
+    assert min(losses) < 0.05
+
+
 def test_fmin_points_to_evaluate():
     t = generate_trials_to_calculate([{"x": 0.0}, {"x": 1.0}])
     best = fmin(quad, SPACE, algo=rand.suggest, max_evals=12, trials=t,

@@ -56,6 +56,41 @@ def test_two_process_mesh_matches_single_process():
         assert "MULTIHOST_OK" in out, out
 
 
+@pytest.mark.slow
+def test_four_process_mesh_matches_single_process():
+    # N>2 generality: 4 controllers × 2 virtual devices form the same
+    # 8-device global mesh; the round-robin evaluation shards, the
+    # allgather fold, and the divergence checksum must all hold at P=4
+    # exactly as at P=2 (the child asserts bitwise identity with the
+    # single-process reference)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never claim the real chip
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, str(port), str(pid), "4"],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(4)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+        assert "MULTIHOST_OK" in out, out
+
+
 def test_fmin_multihost_single_process_deterministic():
     # the same SPMD driver runs single-process (P=1): deterministic in seed,
     # optimizes, and exposes the divergence-guard checksum
