@@ -722,6 +722,46 @@ def trials_from_docs(docs, validate=True, **kwargs):
     return rval
 
 
+def trials_from_flat_history(cs, vals, active, losses, cmd):
+    """Materialize a dense flat history as a reference-shaped :class:`Trials`
+    — one DONE document per trial, sparse idxs/vals built from the active
+    masks (inactive conditional params get empty lists, the
+    hyperopt/vectorize.py doc form), finite loss → STATUS_OK else
+    STATUS_FAIL.  The one doc builder behind every device-resident bridge
+    (``device_fmin.fmin_device(return_trials=True)``,
+    ``parallel.MultihostResult.to_trials``).
+
+    ``vals``/``active``: ``{label: array[n]}``; ``losses``: ``array[n]``
+    (non-finite = failed trial); ``cmd``: the ``misc["cmd"]`` tag naming the
+    producing driver.
+    """
+    n = len(losses)
+    docs = []
+    for i in range(n):
+        idxs, vs = {}, {}
+        for l in cs.labels:
+            if active[l][i]:
+                v = vals[l][i]
+                v = int(round(float(v))) if cs.params[l].is_int else float(v)
+                idxs[l], vs[l] = [i], [v]
+            else:
+                idxs[l], vs[l] = [], []
+        loss = float(losses[i])
+        result = ({"loss": loss, "status": STATUS_OK}
+                  if np.isfinite(loss) else {"status": STATUS_FAIL})
+        docs.append({
+            "state": JOB_STATE_DONE, "tid": i, "spec": None,
+            "result": result,
+            "misc": {"tid": i, "cmd": (cmd, None), "idxs": idxs, "vals": vs},
+            "exp_key": None, "owner": None, "version": 0,
+            "book_time": None, "refresh_time": None,
+        })
+    trials = Trials()
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return trials
+
+
 class Domain:
     """Binds objective + compiled search space
     (hyperopt/base.py sym: Domain.__init__, Domain.evaluate).

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .algos import tpe
-from .base import JOB_STATE_DONE, STATUS_OK, Trials
+from .base import trials_from_flat_history
 from .utils import LRUCache
 from .spaces import compile_space, draw_dist, label_hash
 
@@ -321,35 +321,4 @@ def fmin_device(
     if not return_trials:
         return best_flat, best_loss
 
-    trials = Trials()
-    docs = []
-    for i in range(cap):
-        idxs, vs = {}, {}
-        for l in cs.labels:
-            if active[l][i]:
-                v = vals[l][i]
-                v = int(round(float(v))) if cs.params[l].is_int else float(v)
-                idxs[l], vs[l] = [i], [v]
-            else:
-                idxs[l], vs[l] = [], []
-        loss = float(losses[i])
-        result = (
-            {"loss": loss, "status": STATUS_OK}
-            if np.isfinite(loss)
-            else {"status": "fail"}
-        )
-        docs.append({
-            "state": JOB_STATE_DONE,
-            "tid": i,
-            "spec": None,
-            "result": result,
-            "misc": {"tid": i, "cmd": ("device_fmin", None), "idxs": idxs, "vals": vs},
-            "exp_key": None,
-            "owner": None,
-            "version": 0,
-            "book_time": None,
-            "refresh_time": None,
-        })
-    trials.insert_trial_docs(docs)
-    trials.refresh()
-    return trials
+    return trials_from_flat_history(cs, vals, active, losses, "device_fmin")

@@ -68,6 +68,19 @@ class MultihostResult:
     losses: np.ndarray    # [n_evals] in global trial-id order
     vals: dict            # {label: np.ndarray[n_evals]} flat history
     checksum: str         # digest of the folded history (divergence guard)
+    active: dict = dataclasses.field(repr=False)  # {label: bool[n_evals]}
+    _cs: object = dataclasses.field(repr=False)   # CompiledSpace of the run
+
+    def to_trials(self):
+        """Materialize the run as a reference-shaped :class:`Trials` (every
+        trial a document with sparse idxs/vals, inactive conditional params
+        empty) so downstream tooling — ``plotting.*``, ``argmin``,
+        ``best_trial``, checkpoint pickling — works unchanged, the same
+        bridge ``device_fmin.fmin_device(return_trials=True)`` provides."""
+        from ..base import trials_from_flat_history
+
+        return trials_from_flat_history(
+            self._cs, self.vals, self.active, self.losses, "fmin_multihost")
 
 
 def _default_cfg(batch):
@@ -255,4 +268,6 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         losses=losses_all.copy(),
         vals={l: hist["vals"][l][:n_done].copy() for l in labels},
         checksum=digest.hexdigest(),
+        active={l: hist["active"][l][:n_done].copy() for l in labels},
+        _cs=cs,
     )

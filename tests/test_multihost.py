@@ -134,6 +134,45 @@ def test_fmin_multihost_conditional_space():
     assert "x" in r.best  # structured sample assembled from the best flat
 
 
+def test_fmin_multihost_to_trials_bridge():
+    # the MultihostResult -> Trials bridge gives reference-shaped docs:
+    # argmin/best_trial/losses/plotting inputs work, inactive conditional
+    # params have empty idxs, failed trials carry status=fail
+    import numpy as np
+
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["q1_choice"]
+    calls = {"n": 0}
+
+    def obj(d):
+        calls["n"] += 1
+        if calls["n"] % 9 == 5:
+            raise RuntimeError("flaky")
+        return float(dom.objective(d))
+
+    r = fmin_multihost(obj, dom.space, max_evals=32, batch=8, seed=0)
+    t = r.to_trials()
+    assert len(t) == 32
+    losses = t.losses()
+    finite = [l for l in losses if l is not None]
+    assert min(finite) == r.best_loss
+    assert any(l is None for l in losses)  # the flaky trials became fails
+    doc = t.best_trial
+    assert doc["state"] == 2 and doc["result"]["status"] == "ok"
+    # q1_choice is conditional: some docs must have an inactive param with
+    # empty idxs/vals
+    assert any(
+        any(len(v) == 0 for v in d["misc"]["vals"].values())
+        for d in t.trials
+    )
+    # argmin recovers the best flat values recorded in the result
+    for l, v in t.argmin.items():
+        assert abs(float(r.vals[l][np.argmin(np.where(
+            np.isfinite(r.losses), r.losses, np.inf))]) - float(v)) < 1e-6
+
+
 def test_fmin_multihost_all_failed_raises():
     import pytest as _pytest
 
