@@ -99,7 +99,7 @@ def _gen_seed(seed, gen):
 
 
 def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
-                   n_startup=None, _force_single=False):
+                   n_startup=None, checkpoint_file=None, _force_single=False):
     """Minimize ``fn`` over ``space`` across every process of a
     ``jax.distributed`` runtime.  Call from ALL processes with identical
     arguments (SPMD); returns the same :class:`MultihostResult` everywhere.
@@ -109,7 +109,17 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     (default: one per global device).  ``_force_single`` runs the identical
     algorithm on this process alone — the determinism reference the
     multi-process result must match bitwise.
-    """
+
+    ``checkpoint_file``: atomically persist the folded history after every
+    generation (controller 0 writes; the file is identical whichever
+    controller would write it, by the divergence guarantee) and RESUME from
+    it on restart — the multi-controller analog of ``fmin``'s
+    ``trials_save_file`` (the reference's distributed driver gets this from
+    mongod's durability; SURVEY.md §5 checkpoint row).  A resumed run
+    continues the exact trial sequence of an uninterrupted one: generation
+    seeds depend only on ``(seed, generation)``, checkpoints land on
+    generation boundaries, and the fold digest is replayed from the saved
+    rows (the post-resume checksum equals the uninterrupted run's)."""
     single = _force_single or jax.process_count() == 1
     if single:
         pid, P = 0, 1
@@ -126,8 +136,37 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     if n_startup is None:
         n_startup = max(batch, 20)
 
+    saved = None
+    if checkpoint_file is not None:
+        import os
+        import pickle
+
+        if os.path.exists(checkpoint_file):
+            with open(checkpoint_file, "rb") as f:
+                saved = pickle.load(f)
+    # a bitwise resume requires the identical run parameters: generation
+    # seeds depend on (seed, gen), gen boundaries on batch, the
+    # startup/posterior switch on n_startup, and the proposals on cfg
+    run_params = {"labels": list(labels), "batch": int(batch),
+                  "seed": int(seed), "n_startup": int(n_startup),
+                  "cfg": sorted(cfg.items())}
+    if saved is not None:
+        for k, v in run_params.items():
+            if saved["run_params"][k] != v:
+                raise ValueError(
+                    f"checkpoint {checkpoint_file} was written with "
+                    f"{k}={saved['run_params'][k]!r}; this run has {k}={v!r}"
+                    " — bitwise resume requires identical run parameters")
+        if saved["n_done"] % batch and saved["n_done"] < max_evals:
+            raise ValueError(
+                f"checkpoint ends in a partial final generation "
+                f"(n_done={saved['n_done']}, batch={batch}): the original "
+                "run completed at its own max_evals, and a completed run "
+                "cannot be extended bitwise — delete the checkpoint to "
+                "start a fresh run")
+
     cap = 128
-    while cap < max_evals:
+    while cap < max(max_evals, saved["n_done"] if saved else 0):
         cap *= 2
     hist = {
         "losses": np.full(cap, np.inf, np.float32),
@@ -135,6 +174,10 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         "vals": {l: np.zeros(cap, np.float32) for l in labels},
         "active": {l: np.zeros(cap, bool) for l in labels},
     }
+    # raw per-trial losses as evaluated (NaN for raised trials, ±inf if the
+    # objective returned it) — the digest folds THESE, and the checkpoint
+    # must replay them bit-exactly; hist only keeps the sanitized form
+    raw_losses = np.full(cap, np.nan, np.float32)
 
     # the proposal kernels: a plain local vmap in single mode, the
     # global-mesh sharded program otherwise (bitwise-identical outputs —
@@ -172,6 +215,63 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     digest = hashlib.sha256()
     n_done = 0
     gen = 0
+    if saved is not None:
+        n_done = saved["n_done"]
+        gen = n_done // batch
+        hist["losses"][:n_done] = saved["losses"]
+        hist["has_loss"][:n_done] = saved["has_loss"]
+        raw_losses[:n_done] = saved["raw_losses"]
+        for l in labels:
+            hist["vals"][l][:n_done] = saved["vals"][l]
+            hist["active"][l][:n_done] = saved["active"][l]
+        # replay the fold digest so the divergence checksum (and the final
+        # result checksum) match an uninterrupted run bitwise.  One
+        # vectorized update: the live fold writes, per row, the f32 raw
+        # loss then each label's f32 value — exactly a row-major
+        # [n_done, 1+L] f32 matrix
+        if n_done:
+            rows = np.concatenate(
+                [np.asarray(saved["raw_losses"], np.float32)[:, None]]
+                + [np.asarray(saved["vals"][l], np.float32)[:, None]
+                   for l in labels], axis=1)
+            digest.update(np.ascontiguousarray(rows, np.float32).tobytes())
+    if not single:
+        # resume agreement: only controller 0 writes the checkpoint, so a
+        # per-host disk (or NFS lag) could hand each controller a different
+        # resume point — mismatched generation counters mean mismatched
+        # collective schedules, i.e. a silent deadlock.  Fail loudly
+        # instead: every controller must have loaded identical state.
+        state8 = np.frombuffer(digest.digest()[:8], np.uint64)[0]
+        mine = jnp.asarray(np.asarray([n_done, state8], np.uint64))
+        all_s = np.asarray(
+            multihost_utils.process_allgather(mine)).reshape(P, 2)
+        if not (all_s == all_s[0]).all():
+            raise ValueError(
+                f"controllers disagree on the resume state {all_s.tolist()}"
+                " — checkpoint_file must live on a filesystem shared by"
+                " every controller")
+
+    def _save_checkpoint():
+        """Atomic generation-boundary snapshot; controller 0 writes (every
+        controller holds an identical history — that is the divergence
+        guarantee this driver enforces)."""
+        if checkpoint_file is None or pid != 0:
+            return
+        import pickle
+
+        from ..filestore import _atomic_write
+
+        state = {
+            "run_params": run_params,
+            "n_done": n_done,
+            "losses": hist["losses"][:n_done].copy(),
+            "has_loss": hist["has_loss"][:n_done].copy(),
+            "raw_losses": raw_losses[:n_done].copy(),
+            "vals": {l: hist["vals"][l][:n_done].copy() for l in labels},
+            "active": {l: hist["active"][l][:n_done].copy() for l in labels},
+        }
+        _atomic_write(checkpoint_file, pickle.dumps(state))
+
     while n_done < max_evals:
         B = min(batch, max_evals - n_done)
         gseed = _gen_seed(seed, gen)
@@ -228,6 +328,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             ok = np.isfinite(losses[j])
             hist["losses"][i] = losses[j] if ok else np.inf
             hist["has_loss"][i] = ok
+            raw_losses[i] = losses[j]
             for l in labels:
                 hist["vals"][l][i] = flats[l][j]
             act = cs.active_flat(flat_j(j))
@@ -238,7 +339,6 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 b"".join(np.float32(flats[l][j]).tobytes() for l in labels))
         n_done += B
         gen += 1
-
         # divergence checksum: every controller must have folded the same
         # bytes in the same order
         if not single:
@@ -249,6 +349,8 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 raise ControllerDivergence(
                     f"history checksums diverged after {n_done} trials: "
                     f"{[hex(int(x)) for x in all_h.reshape(-1)]}")
+        # persist only checksum-verified generations
+        _save_checkpoint()
 
     live = hist["has_loss"][:n_done]
     losses_all = hist["losses"][:n_done]

@@ -173,6 +173,77 @@ def test_fmin_multihost_to_trials_bridge():
             np.isfinite(r.losses), r.losses, np.inf))]) - float(v)) < 1e-6
 
 
+def test_fmin_multihost_checkpoint_resume_bitwise():
+    # kill-and-resume must continue the EXACT trial sequence of an
+    # uninterrupted run: generation seeds depend only on (seed, gen), the
+    # checkpoint lands on generation boundaries, and the fold digest is
+    # replayed from the saved raw losses (incl. NaN for raised trials)
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    calls = {"n": 0}
+
+    def obj(d):
+        calls["n"] += 1
+        if calls["n"] % 11 == 4:
+            raise RuntimeError("flaky")  # raw-loss NaN must survive resume
+        return float(dom.objective(d))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "mh.ckpt")
+
+        # uninterrupted reference (no checkpoint involved)
+        calls["n"] = 0
+        ref = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=5)
+
+        # first leg: 24 evals, checkpoint written at each generation
+        calls["n"] = 0
+        fmin_multihost(obj, dom.space, max_evals=24, batch=8, seed=5,
+                       checkpoint_file=ck)
+        assert os.path.exists(ck)
+        # resumed leg: continue to 48.  The objective's call counter keeps
+        # running from the first leg (25th call overall = trial 25), exactly
+        # as a restarted process re-evaluating only NEW trials would see.
+        res = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=5,
+                             checkpoint_file=ck)
+        assert res.checksum == ref.checksum
+        assert res.best_loss == ref.best_loss
+        np.testing.assert_array_equal(res.losses, ref.losses)
+
+        # changed run parameters are refused (bitwise resume impossible)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="identical run parameters"):
+            fmin_multihost(obj, dom.space, max_evals=64, batch=7, seed=5,
+                           checkpoint_file=ck)
+        with _pytest.raises(ValueError, match="identical run parameters"):
+            fmin_multihost(obj, dom.space, max_evals=64, batch=8, seed=6,
+                           checkpoint_file=ck)
+
+        # a run that completed on a partial final generation cannot be
+        # extended bitwise — clear refusal, not a misleading batch hint
+        ck2 = os.path.join(tmp, "partial.ckpt")
+        fmin_multihost(obj, dom.space, max_evals=20, batch=8, seed=5,
+                       checkpoint_file=ck2)  # final generation B=4
+        with _pytest.raises(ValueError, match="partial final generation"):
+            fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=5,
+                           checkpoint_file=ck2)
+        # but re-materializing the completed result (same or smaller
+        # max_evals) still works, even when cap must grow past max_evals
+        r20 = fmin_multihost(obj, dom.space, max_evals=20, batch=8, seed=5,
+                             checkpoint_file=ck2)
+        assert r20.n_evals == 20
+        r8 = fmin_multihost(obj, dom.space, max_evals=8, batch=8, seed=5,
+                            checkpoint_file=ck2)
+        assert r8.n_evals == 20  # restored history is the run's true length
+
+
 def test_fmin_multihost_all_failed_raises():
     import pytest as _pytest
 
