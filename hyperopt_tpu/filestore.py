@@ -40,6 +40,7 @@ import fcntl
 import logging
 import os
 import pickle
+import threading
 import time
 
 from .base import (
@@ -70,8 +71,17 @@ class ReserveTimeout(Exception):
     (hyperopt/mongoexp.py sym: ReserveTimeout)."""
 
 
+# seconds below which a transition claim is assumed to be a LIVE in-flight
+# transition regardless of the sweep's max_age (see _sweep_orphan_claims)
+_CLAIM_GRACE = 5.0
+
+
 def _atomic_write(path, payload: bytes):
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid AND thread id: two same-process threads writing the same target
+    # (a heartbeat thread racing the claim path, concurrent reclaim+cancel)
+    # would otherwise share one tmp name — the loser's os.replace then
+    # crashes on the winner's already-consumed tmp file
+    tmp = f"{path}.tmp.{_claim_suffix()}"
     with open(tmp, "wb") as f:
         f.write(payload)
     os.replace(tmp, path)
@@ -97,6 +107,15 @@ def _remove_quiet(path):
         os.remove(path)
     except FileNotFoundError:
         pass
+
+
+def _claim_suffix():
+    """pid AND thread id: same-process threads (a heartbeat thread beside
+    the worker loop, concurrent reclaim+cancel) would otherwise compute the
+    SAME claim/tmp name for one trial, and ``os.rename`` silently clobbers
+    an existing destination — one thread's live claim file would vanish
+    under the other."""
+    return f"{os.getpid()}.{threading.get_ident()}"
 
 
 class FileStore:
@@ -212,6 +231,12 @@ class FileStore:
                 continue
             tid = fname[:-4]
             src = os.path.join(new_dir, fname)
+            if self._settled(tid):
+                # zombie NEW doc: an at-least-once reclaim raced a finish/
+                # cancel that already settled this trial — remove instead of
+                # re-running settled work
+                _remove_quiet(src)
+                continue
             dst = os.path.join(self.root, "running", fname)
             try:
                 os.rename(src, dst)
@@ -229,6 +254,18 @@ class FileStore:
             return doc
         return None
 
+    def _settled(self, tid):
+        """True when a terminal doc (DONE/ERROR/CANCEL) exists for ``tid``.
+        The shared zombie guard: heartbeat/reserve/reclaim/sweep all refuse
+        to act on (or resurrect) a trial that has already settled — the
+        at-least-once reclaim races can leave NEW/RUNNING leftovers beside a
+        terminal doc, and re-running settled work both wastes evaluations
+        and leaves duplicate files for precedence to hide."""
+        return any(
+            os.path.exists(self._path(s, tid))
+            for s in (JOB_STATE_DONE, JOB_STATE_ERROR, JOB_STATE_CANCEL)
+        )
+
     def heartbeat(self, doc):
         """Bump refresh_time on a RUNNING doc (MongoWorker heartbeat).
         A cancelled/finished trial is not resurrected: the write is skipped
@@ -236,9 +273,8 @@ class FileStore:
         absorbed by ``load_all``'s state precedence)."""
         doc["refresh_time"] = coarse_utcnow()
         tid = doc["tid"]
-        for terminal in (JOB_STATE_CANCEL, JOB_STATE_DONE, JOB_STATE_ERROR):
-            if os.path.exists(self._path(terminal, tid)):
-                return  # trial already settled: do not resurrect running/
+        if self._settled(tid):
+            return  # trial already settled: do not resurrect running/
         path = self._path(JOB_STATE_RUNNING, tid)
         if os.path.exists(path):
             _atomic_write(path, pickle.dumps(doc))
@@ -252,7 +288,7 @@ class FileStore:
         tid in ``load_all``)."""
         tid = doc["tid"]
         run_path = self._path(JOB_STATE_RUNNING, tid)
-        claim = f"{run_path}.finish.{os.getpid()}"
+        claim = f"{run_path}.finish.{_claim_suffix()}"
         try:
             os.rename(run_path, claim)
         except FileNotFoundError:
@@ -261,6 +297,16 @@ class FileStore:
                 tid, "error" if error is not None else "result")
             return False
         _touch(claim)  # claim age = NOW, not the doc's last heartbeat write
+        if self._settled(tid):
+            # the running file we claimed was a zombie (a heartbeat-TOCTOU
+            # resurrection after a concurrent cancel/finish settled the
+            # trial): drop this result rather than writing a SECOND
+            # terminal doc beside the first
+            _remove_quiet(claim)
+            logger.warning(
+                "trial %s already settled; dropping duplicate %s",
+                tid, "error" if error is not None else "result")
+            return False
         doc["refresh_time"] = coarse_utcnow()
         if error is not None:
             doc["state"] = JOB_STATE_ERROR
@@ -278,9 +324,12 @@ class FileStore:
         ``to_cancel=True``, to CANCEL instead of retrying (the SparkTrials
         timeout→JOB_STATE_CANCEL policy for jobs that must not be re-run;
         the orphan sweep honors the same policy).  Also sweeps aged
-        claim-file orphans (see ``_sweep_orphan_claims``).  Returns count of
-        reclaimed docs (stale RUNNING + recovered orphans)."""
+        claim-file orphans (see ``_sweep_orphan_claims``) and prunes
+        duplicate TERMINAL docs (see ``_prune_terminal_duplicates``).
+        Returns count of reclaimed docs (stale RUNNING + recovered
+        orphans)."""
         n = self._sweep_orphan_claims(reserve_timeout, to_cancel=to_cancel)
+        self._prune_terminal_duplicates()
         run_dir = os.path.join(self.root, "running")
         target = JOB_STATE_CANCEL if to_cancel else JOB_STATE_NEW
         for fname in os.listdir(run_dir):
@@ -290,13 +339,20 @@ class FileStore:
             doc = self._read(path)
             if doc is None or doc.get("refresh_time") is None:
                 continue
+            if self._settled(doc["tid"]):
+                # zombie RUNNING file beside a terminal doc (a heartbeat
+                # TOCTOU resurrection): delete it — a concurrent finish
+                # loses its rename and drops the duplicate result, which is
+                # the documented contract
+                _remove_quiet(path)
+                continue
             age = (coarse_utcnow() - doc["refresh_time"]).total_seconds()
             if age < reserve_timeout:
                 continue
             # claim the transition by renaming the running file away first;
             # losing the rename means the worker finished (or another
             # reclaimer won) in the meantime — skip, don't duplicate
-            claim = f"{path}.reclaim.{os.getpid()}"
+            claim = f"{path}.reclaim.{_claim_suffix()}"
             try:
                 os.rename(path, claim)
             except FileNotFoundError:
@@ -311,18 +367,46 @@ class FileStore:
             n += 1
         return n
 
+    def _prune_terminal_duplicates(self):
+        """Remove precedence-loser duplicates among TERMINAL docs.
+
+        The ``_settled`` guards are check-then-write: a ``finish`` and a
+        ``cancel`` acting on different zombie copies of one tid can both
+        pass their check in the same instant and both write a terminal doc.
+        ``load_all``'s precedence already hides the loser from every
+        reader; this pass makes the store physically CONVERGE to one doc
+        per trial (a fresh write can transiently recreate the race — the
+        next reclaim prunes again)."""
+        best = {}
+        # descending precedence: the first state a tid is seen in wins
+        for s in (JOB_STATE_DONE, JOB_STATE_ERROR, JOB_STATE_CANCEL):
+            d = os.path.join(self.root, _STATE_DIRS[s])
+            for fname in os.listdir(d):
+                if not fname.endswith(".pkl"):
+                    continue
+                tid = fname[:-4]
+                if tid in best:
+                    logger.warning(
+                        "pruning duplicate terminal doc %s/%s (kept %s)",
+                        _STATE_DIRS[s], fname, _STATE_DIRS[best[tid]])
+                    _remove_quiet(os.path.join(d, fname))
+                else:
+                    best[tid] = s
+
     def _sweep_orphan_claims(self, max_age, to_cancel=False):
         """Recover claim files orphaned by a crash mid-transition.
 
         ``finish``/``reclaim_stale``/``cancel`` all rename the source doc to
-        a private ``*.pkl.{finish,reclaim,cancel}.<pid>`` claim before
+        a private ``*.pkl.{finish,reclaim,cancel}.<pid>.<tid>`` claim before
         writing the terminal doc; a crash in that window leaves a claim file
         that ``load_all`` ignores (doesn't end in ``.pkl``) — the trial
         would vanish from every state and the driver would wait until its
-        fmin timeout (advisor finding, round 4).  Any claim older than
-        ``max_age`` seconds is necessarily orphaned — live transitions
-        ``_touch`` their claim at creation, so claim mtime measures claim
-        age, not the doc's last heartbeat.  Readable finish/reclaim claims
+        fmin timeout (advisor finding, round 4).  A claim is recovered once
+        older than ``max(max_age, _CLAIM_GRACE)`` seconds (60 s for
+        sweep-private files) — live transitions ``_touch`` their claim at
+        creation, so claim mtime measures claim age, not the doc's last
+        heartbeat, and the grace floor keeps a zero/short ``max_age`` from
+        stealing a LIVE in-flight transition.  Readable finish/reclaim claims
         go back to NEW for re-evaluation (at-least-once semantics — same
         policy as stale-heartbeat reclaim), or to CANCEL under
         ``to_cancel=True`` (the must-not-re-run policy); cancel claims
@@ -344,19 +428,44 @@ class FileStore:
                     age = now - os.path.getmtime(path)
                 except FileNotFoundError:
                     continue  # another sweeper got it
-                if age < max_age:
+                # LIVENESS GRACE: a transition claim is _touch()ed at
+                # creation and completes in milliseconds, so a claim younger
+                # than the grace window is almost certainly a LIVE
+                # transition, whatever ``max_age`` says — stealing it would
+                # let the victim's unconditional terminal write race the
+                # recovery into a duplicated trial (found by the randomized
+                # storm test at reserve_timeout=0).  A >grace mid-transition
+                # stall still loses this protection; that residue is the
+                # same zombie-writer hazard Mongo's stale-reclaim accepts.
+                # Sweep-private files get a larger floor: same reasoning,
+                # one more indirection.
+                floor = max(max_age,
+                            60.0 if ".sweep." in fname else _CLAIM_GRACE)
+                if age < floor:
                     continue
                 # claim the claim: rename to a sweep-private name so two
                 # concurrent sweepers can't both recover the same doc
-                mine = f"{path}.sweep.{os.getpid()}"
+                mine = f"{path}.sweep.{_claim_suffix()}"
                 try:
                     os.rename(path, mine)
                 except FileNotFoundError:
                     continue
+                # rename preserves the source mtime (the ALREADY-AGED claim
+                # time) — without the touch, the 60s in-flight floor above
+                # would measure the original claim's age and a concurrent
+                # sweeper could still steal this file mid-transition
+                _touch(mine)
                 doc = self._read(mine)
                 if doc is None:
                     logger.warning("removing unreadable orphan claim %s", fname)
-                    os.remove(mine)
+                    _remove_quiet(mine)
+                    continue
+                if self._settled(doc["tid"]):
+                    # the interrupted transition already completed (its
+                    # terminal doc exists): the claim is a leftover, not a
+                    # lost trial — recovering it to NEW would re-run settled
+                    # work and leave a duplicate doc behind
+                    _remove_quiet(mine)
                     continue
                 if kind == "cancel" or to_cancel:
                     target = JOB_STATE_CANCEL
@@ -368,7 +477,7 @@ class FileStore:
                     doc["owner"] = None
                 doc["state"] = target
                 _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
-                os.remove(mine)
+                _remove_quiet(mine)
                 logger.warning(
                     "recovered orphaned %s claim for trial %s (%.0fs old) -> %s",
                     kind, doc["tid"], age, _STATE_DIRS[target])
@@ -384,12 +493,19 @@ class FileStore:
         was cancelled."""
         for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
             src = self._path(state, tid)
-            claim = f"{src}.cancel.{os.getpid()}"
+            claim = f"{src}.cancel.{_claim_suffix()}"
             try:
                 os.rename(src, claim)
             except FileNotFoundError:
                 continue
             _touch(claim)
+            if self._settled(tid):
+                # the claimed file was a zombie copy (an at-least-once
+                # reclaim raced the transition that settled this trial):
+                # nothing to cancel, and writing CANCEL would duplicate the
+                # existing terminal doc
+                _remove_quiet(claim)
+                return False
             doc = self._read(claim)
             if doc is None:
                 # do NOT delete: the read may have raced a partial write.
