@@ -117,6 +117,28 @@ def main():
     np.testing.assert_array_equal(res.losses, ref.losses)
     assert res.best_loss < 2.0, res.best_loss  # it optimized, not just ran
 
+    # checkpointed kill-and-resume ACROSS CONTROLLERS: controller 0 writes
+    # per-generation snapshots to a path all processes share; a second run
+    # resumes (every controller loads the same state — the resume-agreement
+    # allgather verifies it) and must reproduce the uninterrupted 48-eval
+    # run bitwise
+    import os
+
+    ck = f"/tmp/mh_child_ck_{port}.pkl"
+    if pid == 0 and os.path.exists(ck):
+        os.remove(ck)
+    multihost_utils.sync_global_devices("ck-clean")
+    fmin_multihost(obj, dom.space, max_evals=24, batch=8, seed=0,
+                   checkpoint_file=ck)
+    multihost_utils.sync_global_devices("ck-leg1")
+    resumed = fmin_multihost(obj, dom.space, max_evals=48, batch=8, seed=0,
+                             checkpoint_file=ck)
+    assert resumed.checksum == res.checksum, "resume diverged from straight run"
+    np.testing.assert_array_equal(resumed.losses, res.losses)
+    multihost_utils.sync_global_devices("ck-done")
+    if pid == 0:
+        os.remove(ck)
+
     print(f"MULTIHOST_OK process={pid} fmin_best={res.best_loss:.4f}", flush=True)
 
 
