@@ -238,6 +238,30 @@ def bench_jax(n_obs=60, n_cand=8192, repeats=50, seed=0, n_params=1, batch=None)
             "backend": jax.devices()[0].platform}
 
 
+def _obs_device_snapshot():
+    """Compact compile/execute/cache-rate summary from the process-global
+    "device" metrics namespace (hyperopt_tpu/obs/) — attached to stage
+    results so BENCH_*.json tracks the perf BREAKDOWN, not just the
+    headline throughput."""
+    from hyperopt_tpu.obs import get_metrics
+
+    dev = get_metrics("device").snapshot()["metrics"]
+
+    def hist(name):
+        h = dev.get(name)
+        return {"sum_sec": h["sum"], "count": h["count"]} if h else None
+
+    hits = dev.get("run_cache.hits", 0)
+    misses = dev.get("run_cache.misses", 0)
+    return {
+        "whole_run_compile": hist("whole_run.compile_sec"),
+        "whole_run_execute": hist("whole_run.execute_sec"),
+        "chunk_compile": hist("chunk.compile_sec"),
+        "chunk_execute": hist("chunk.execute_sec"),
+        "run_cache_hit_rate": hits / max(1, hits + misses),
+    }
+
+
 def bench_branin_device(max_evals=1000, seeds=(1, 2, 3, 4)):
     """BASELINE north star: Branin to loss<0.40 in <1s on one chip, via the
     fully on-device lax.scan fmin.  gamma/LF widened beyond the reference
@@ -259,7 +283,8 @@ def bench_branin_device(max_evals=1000, seeds=(1, 2, 3, 4)):
     return {"best_losses": losses, "wall_clock_sec_max": max(walls),
             "wall_clock_sec_mean": sum(walls) / len(walls),
             "max_evals": max_evals,
-            "target": "loss<0.40 in <1s"}
+            "target": "loss<0.40 in <1s",
+            "obs": _obs_device_snapshot()}
 
 
 def _host_branin(d):
@@ -318,6 +343,11 @@ def bench_branin_fmin(max_evals=100, seed=0, queues=(1, 4)):
         runs.append({"attempt": attempt, "wall_clock_sec": dt, "best_loss": best})
     out["queue_1_device_loop"] = runs
     out["max_evals"] = max_evals
+    # per-phase breakdown of the host loop (suggest vs evaluate vs refresh)
+    # plus the device-loop compile/execute split — the measurement substrate
+    # later perf PRs diff against
+    out["obs"] = {"phase_timings": trials.phase_timings.summary(),
+                  **_obs_device_snapshot()}
     return out
 
 
@@ -793,13 +823,22 @@ def main():
         cps = detail["numpy_cpu"]["candidates_per_sec"]
         backend = "none"
         speedup = 1.0
+    # perf breakdown (compile sec / execute sec / cache hit rate) from the
+    # obs metrics the stage children collected: BENCH_*.json tracks where
+    # the time goes, not just the headline number
+    obs_summary = {}
+    for stage_name in ("branin_device_1000", "branin_fmin_tpe"):
+        rec = stages.get(stage_name)
+        if rec and rec.get("ok") and rec["result"].get("obs"):
+            obs_summary[stage_name] = rec["result"]["obs"]
     print(json.dumps({
         "metric": "tpe_candidate_proposal_throughput",
         "value": round(cps, 1),
         "unit": "candidates/sec",
         "vs_baseline": round(speedup, 2),
         "backend": backend,
-    }))
+        "obs": obs_summary,
+    }, default=float))
 
 
 if __name__ == "__main__":

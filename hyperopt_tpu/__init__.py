@@ -14,7 +14,7 @@ JOB_STATE_*), so ``from hyperopt_tpu import fmin, hp, tpe, Trials`` — the
 canonical reference idiom — works unchanged.
 """
 
-from . import early_stop, graphviz, hp, pyll, spaces
+from . import early_stop, graphviz, hp, obs, pyll, spaces
 from .algos import rand
 from .base import (
     JOB_STATE_CANCEL,
@@ -74,6 +74,7 @@ __all__ = [
     "pyll",
     "graphviz",
     "early_stop",
+    "obs",
     "fmin",
     "FMinIter",
     "fmin_pass_expr_memo_ctrl",

@@ -670,6 +670,7 @@ class Trials:
         early_stop_fn=None,
         trials_save_file="",
         device_loop=False,
+        obs=None,
     ):
         from .fmin import fmin as _fmin
 
@@ -692,6 +693,7 @@ class Trials:
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
             device_loop=device_loop,
+            obs=obs,
         )
 
     # pickle: drop the numpy history (rebuilt lazily) for a compact file, and

@@ -18,6 +18,8 @@ jitted TPE proposal under ``lax.cond``.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -25,14 +27,53 @@ import jax.numpy as jnp
 
 from .algos import tpe
 from .base import trials_from_flat_history
+from .obs import get_metrics
 from .utils import LRUCache
 from .spaces import compile_space, draw_dist, label_hash
 
 __all__ = ["fmin_device", "DeviceLoopRunner", "objective_is_traceable"]
 
-# compiled-run cache: (space expr, objective, capacity, cfg) -> jitted run.
-# Expr trees are frozen dataclasses (hashable); objectives hash by identity.
+# compiled-run cache: (space expr, objective, capacity, cfg) -> a holder
+# {"jit": jitted fn, "compiled": AOT executable or None}.  Expr trees are
+# frozen dataclasses (hashable); objectives hash by identity.  The holder
+# (not the bare jitted fn) is cached so the one-time AOT compile — the
+# measured "compile" half of the obs split — is shared across runner
+# instances exactly like the program itself.
 _RUN_CACHE = LRUCache(16)
+
+# compile/execute split + cache hit rates live in the process-global
+# "device" metrics namespace: the cache itself is process-global, so its
+# rates are a property of the process, not of any one run
+_METRICS = get_metrics("device")
+
+
+def _record_cache_stats():
+    s = _RUN_CACHE.stats()
+    _METRICS.gauge("run_cache.hits").set(s["hits"])
+    _METRICS.gauge("run_cache.misses").set(s["misses"])
+    _METRICS.gauge("run_cache.size").set(s["size"])
+
+
+def _aot_compile(holder, args, hist_name, obs=None):
+    """Fill ``holder["compiled"]`` with the AOT executable for ``args``,
+    recording compile wall time under ``hist_name``.  Falls back to the
+    jitted callable (compile time then folds into the first execute) on
+    backends where AOT lowering is unavailable."""
+    span = (obs.span("device.compile", aggregate=False)
+            if obs is not None else None)
+    t0 = time.perf_counter()
+    try:
+        if span is not None:
+            with span:
+                compiled = holder["jit"].lower(*args).compile()
+        else:
+            compiled = holder["jit"].lower(*args).compile()
+    except Exception:  # pragma: no cover - backend-dependent AOT support
+        _METRICS.counter("aot_fallbacks").inc()
+        compiled = holder["jit"]
+    _METRICS.histogram(hist_name).observe(time.perf_counter() - t0)
+    holder["compiled"] = compiled
+    return compiled
 
 
 def _int_labels(cs):
@@ -156,11 +197,12 @@ class DeviceLoopRunner:
 
     CHUNK = 10
 
-    def __init__(self, domain, cfg, n_startup, cap):
+    def __init__(self, domain, cfg, n_startup, cap, obs=None):
         cs = domain.cs
         self.cs = cs
         self.cap = int(cap)
         self.labels = cs.labels
+        self._obs = obs
         L = len(cs.labels)
         # the jitted chunk program is cached across runner instances (the
         # shared LRU with fmin_device): a warm re-run of the same
@@ -168,8 +210,9 @@ class DeviceLoopRunner:
         cache_key = ("chunk", cs.expr, domain.fn, self.cap, int(n_startup),
                      tuple(sorted(cfg.items())), self.CHUNK)
         cached = _RUN_CACHE.get(cache_key)
+        _record_cache_stats()
         if cached is not None:
-            self._run_chunk = cached
+            self._holder = cached
             self._L = L
             return
         fn = domain.fn
@@ -228,9 +271,9 @@ class DeviceLoopRunner:
                 jnp.arange(chunk, dtype=jnp.int32))
             return state, rows
 
-        self._run_chunk = run_chunk
+        self._holder = {"jit": run_chunk, "compiled": None}
         self._L = L
-        _RUN_CACHE.put(cache_key, run_chunk)
+        _RUN_CACHE.put(cache_key, self._holder)
 
     def init_state(self):
         cap = self.cap
@@ -243,13 +286,28 @@ class DeviceLoopRunner:
 
     def run_chunk(self, state, start, limit, seed):
         """Run one chunk; returns ``(state', rows[limit-start, 2L+1])`` with
-        rows already on host (the single readback)."""
+        rows already on host (the single readback).
+
+        Obs: the first dispatch AOT-compiles the chunk program under a
+        timed "device.compile" span; every dispatch records its execute
+        wall clock (call through host readback — the full round trip) into
+        the "device" metrics namespace, so a run's suggest time decomposes
+        into XLA-compile vs device-execute instead of one opaque number."""
         seed = int(seed)
         words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF],
                            np.uint32)
-        state, rows = self._run_chunk(
-            state, np.int32(start), np.int32(limit), words)
-        return state, np.asarray(rows)[: limit - start]
+        args = (state, np.int32(start), np.int32(limit), words)
+        fn = self._holder["compiled"]
+        if fn is None:
+            fn = _aot_compile(self._holder, args, "chunk.compile_sec",
+                              obs=self._obs)
+        t0 = time.perf_counter()
+        state, rows = fn(*args)
+        rows = np.asarray(rows)[: limit - start]  # the blocking readback
+        _METRICS.histogram("chunk.execute_sec").observe(
+            time.perf_counter() - t0)
+        _METRICS.counter("chunk.dispatches").inc()
+        return state, rows
 
 
 def fmin_device(
@@ -286,8 +344,9 @@ def fmin_device(
     }
 
     cache_key = (cs.expr, fn, cap, int(n_startup_jobs), tuple(sorted(cfg.items())))
-    run = _RUN_CACHE.get(cache_key)
-    if run is None:
+    holder = _RUN_CACHE.get(cache_key)
+    _record_cache_stats()
+    if holder is None:
         step = _build_step(cs, fn, cap, cfg, int(n_startup_jobs))
 
         @jax.jit
@@ -301,10 +360,24 @@ def fmin_device(
             vals, active, losses, has_loss, _ = carry
             return vals, active, losses, has_loss, trace
 
-        _RUN_CACHE.put(cache_key, run)
+        holder = {"jit": run, "compiled": None}
+        _RUN_CACHE.put(cache_key, holder)
 
     key = seed if isinstance(seed, jax.Array) else jax.random.PRNGKey(int(seed))
-    vals, active, losses, has_loss, trace = run(key)
+    # the AOT executable freezes the key's aval; a raw uint32[2] key and a
+    # typed jax.random.key() must not poison each other's cache entry —
+    # recompile (jit's lowering cache still makes it cheap) on a sig change
+    sig = (key.shape, str(key.dtype))
+    run = holder["compiled"] if holder.get("compiled_sig") == sig else None
+    if run is None:
+        run = _aot_compile(holder, (key,), "whole_run.compile_sec")
+        holder["compiled_sig"] = sig
+    t0 = time.perf_counter()
+    out = run(key)
+    jax.block_until_ready(out)  # strict completion: execute_sec is honest
+    _METRICS.histogram("whole_run.execute_sec").observe(
+        time.perf_counter() - t0)
+    vals, active, losses, has_loss, trace = out
 
     vals = {l: np.asarray(v) for l, v in vals.items()}
     active = {l: np.asarray(v) for l, v in active.items()}

@@ -52,6 +52,18 @@ from .base import (
     Trials,
     coarse_utcnow,
 )
+from .obs import get_metrics
+from .obs.events import (
+    TRIAL_CANCELLED,
+    TRIAL_CLAIMED,
+    TRIAL_FINISHED,
+    TRIAL_HEARTBEAT,
+    TRIAL_NEW,
+    TRIAL_RECLAIMED,
+    EventLog,
+    FileEventSink,
+    load_events,
+)
 
 __all__ = ["FileStore", "FileTrials", "ReserveTimeout"]
 
@@ -118,8 +130,20 @@ def _claim_suffix():
     return f"{os.getpid()}.{threading.get_ident()}"
 
 
+# the durable trial-lifecycle event log rides the attachments namespace so
+# it shares the store's durability story and is readable as an attachment
+_EVENTS_ATTACHMENT = "obs_events.jsonl"
+
+
 class FileStore:
-    """Low-level durable job store (hyperopt/mongoexp.py sym: MongoJobs)."""
+    """Low-level durable job store (hyperopt/mongoexp.py sym: MongoJobs).
+
+    Obs: every state transition (new/claimed/heartbeat/finished/cancelled/
+    reclaimed) appends one line to the ``obs_events.jsonl`` attachment —
+    O_APPEND writes, so driver and worker processes interleave whole
+    records and a post-mortem survives every process on the store dying
+    (``read_events()``).  Contention and reclaim counters land in the
+    process-global "filestore" metrics namespace."""
 
     def __init__(self, root):
         self.root = str(root)
@@ -128,6 +152,15 @@ class FileStore:
         counter = os.path.join(self.root, "counter")
         if not os.path.exists(counter):
             _atomic_write(counter, b"0")
+        self.events = EventLog(sink=FileEventSink(
+            os.path.join(self.root, "attachments", _EVENTS_ATTACHMENT)))
+        self.metrics = get_metrics("filestore")
+
+    def read_events(self):
+        """The durable lifecycle log, parsed — every event any process on
+        this store ever emitted (the post-mortem entry point)."""
+        return load_events(
+            os.path.join(self.root, "attachments", _EVENTS_ATTACHMENT))
 
     # -- tid allocation (counter-doc analog) ------------------------------
 
@@ -168,7 +201,11 @@ class FileStore:
 
     def write_doc(self, doc):
         """Write (or overwrite) a doc in the directory matching its state."""
+        fresh = (doc["state"] == JOB_STATE_NEW
+                 and not os.path.exists(self._path(JOB_STATE_NEW, doc["tid"])))
         _atomic_write(self._path(doc["state"], doc["tid"]), pickle.dumps(doc))
+        if fresh:
+            self.events.emit(TRIAL_NEW, doc["tid"])
 
     def _read(self, path):
         try:
@@ -241,7 +278,10 @@ class FileStore:
             try:
                 os.rename(src, dst)
             except FileNotFoundError:
-                continue  # another claimant won this one
+                # another claimant won this one: the contention counter is
+                # the store's "how many workers fight per job" signal
+                self.metrics.counter("reserve.contention").inc()
+                continue
             doc = self._read(dst)
             if doc is None:
                 continue
@@ -251,6 +291,8 @@ class FileStore:
             doc["book_time"] = now
             doc["refresh_time"] = now
             _atomic_write(dst, pickle.dumps(doc))
+            self.metrics.counter("reserve.claims").inc()
+            self.events.emit(TRIAL_CLAIMED, doc["tid"], owner=str(owner))
             return doc
         return None
 
@@ -278,6 +320,8 @@ class FileStore:
         path = self._path(JOB_STATE_RUNNING, tid)
         if os.path.exists(path):
             _atomic_write(path, pickle.dumps(doc))
+            self.events.emit(TRIAL_HEARTBEAT, tid,
+                             owner=str(doc.get("owner")))
 
     def finish(self, doc, result=None, error=None):
         """RUNNING → DONE/ERROR.  Ownership of the transition is the running
@@ -292,6 +336,7 @@ class FileStore:
         try:
             os.rename(run_path, claim)
         except FileNotFoundError:
+            self.metrics.counter("finish.dropped").inc()
             logger.warning(
                 "trial %s was cancelled/reclaimed before finish; dropping %s",
                 tid, "error" if error is not None else "result")
@@ -303,6 +348,7 @@ class FileStore:
             # trial): drop this result rather than writing a SECOND
             # terminal doc beside the first
             _remove_quiet(claim)
+            self.metrics.counter("finish.dropped").inc()
             logger.warning(
                 "trial %s already settled; dropping duplicate %s",
                 tid, "error" if error is not None else "result")
@@ -316,6 +362,12 @@ class FileStore:
             doc["result"] = result
         self.write_doc(doc)
         _remove_quiet(claim)
+        sec = None
+        if doc.get("book_time") is not None:
+            sec = (doc["refresh_time"] - doc["book_time"]).total_seconds()
+        self.events.emit(TRIAL_FINISHED, tid,
+                         status="error" if error is not None else "ok",
+                         sec=sec, owner=str(doc.get("owner")))
         return True
 
     def reclaim_stale(self, reserve_timeout, to_cancel=False):
@@ -362,6 +414,10 @@ class FileStore:
             doc["owner"] = None
             _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
             _remove_quiet(claim)
+            self.metrics.counter("reclaims.stale").inc()
+            self.events.emit(TRIAL_RECLAIMED, doc["tid"],
+                             heartbeat_age_sec=age,
+                             target=_STATE_DIRS[target])
             logger.warning("reclaimed stale trial %s (heartbeat %.0fs old) -> %s",
                            doc["tid"], age, _STATE_DIRS[target])
             n += 1
@@ -478,6 +534,10 @@ class FileStore:
                 doc["state"] = target
                 _atomic_write(self._path(target, doc["tid"]), pickle.dumps(doc))
                 _remove_quiet(mine)
+                self.metrics.counter("reclaims.orphan").inc()
+                self.events.emit(TRIAL_RECLAIMED, doc["tid"],
+                                 orphan_kind=kind, claim_age_sec=age,
+                                 target=_STATE_DIRS[target])
                 logger.warning(
                     "recovered orphaned %s claim for trial %s (%.0fs old) -> %s",
                     kind, doc["tid"], age, _STATE_DIRS[target])
@@ -523,6 +583,9 @@ class FileStore:
             doc["refresh_time"] = coarse_utcnow()
             _atomic_write(self._path(JOB_STATE_CANCEL, tid), pickle.dumps(doc))
             _remove_quiet(claim)
+            self.metrics.counter("cancels").inc()
+            self.events.emit(TRIAL_CANCELLED, tid,
+                             from_state=_STATE_DIRS[state])
             return True
         return False
 

@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from . import obs as obs_mod
 from . import progress as progress_mod
 from .base import (
     Ctrl,
@@ -50,24 +51,11 @@ __all__ = [
 
 logger = logging.getLogger(__name__)
 
-
-class PhaseTimings(dict):
-    """Per-phase wall-clock accounting for the ask→tell loop (SURVEY.md §5
-    tracing row).  Maps phase name → ``{"sec": total, "count": calls}``;
-    lives on the trials object (``trials.phase_timings``) so it survives
-    pickling/resume and is inspectable after ``fmin`` returns."""
-
-    def add(self, phase, dt):
-        e = self.setdefault(phase, {"sec": 0.0, "count": 0})
-        e["sec"] += dt
-        e["count"] += 1
-
-    def summary(self):
-        total = sum(e["sec"] for e in self.values()) or 1.0
-        return {
-            k: {**e, "frac": e["sec"] / total}
-            for k, e in sorted(self.items(), key=lambda kv: -kv[1]["sec"])
-        }
+# PhaseTimings moved into the obs layer (obs/trace.py): the tracer now owns
+# the measurement and this dict is its aggregate view.  Re-exported here so
+# ``from hyperopt_tpu.fmin import PhaseTimings`` and pickled Trials carrying
+# one keep working unchanged.
+PhaseTimings = obs_mod.PhaseTimings
 
 
 def fmin_pass_expr_memo_ctrl(f):
@@ -136,6 +124,7 @@ class FMinIter:
         early_stop_fn=None,
         trials_save_file="",
         device_loop=False,
+        obs=None,
     ):
         from ._env import enable_persistent_compilation_cache
 
@@ -157,11 +146,16 @@ class FMinIter:
         # seed the suggesters' sticky id-bucket floor (rand.pad_ids_sticky)
         # from the queue depth: the first ramp-up batch then compiles the
         # steady-state kernel shape, and queue-drain tails reuse it instead
-        # of compiling a narrower copy of the same program
+        # of compiling a narrower copy of the same program.  Capped at 64:
+        # an async backend advertising a huge queue (SparkTrials-style
+        # parallelism) but asking in small batches would otherwise pad EVERY
+        # suggest call to full bucket width — pure wasted device work per
+        # call; past the cap, pad_ids_sticky grows the floor organically
+        # from observed batch sizes (ADVICE.md round 5).
         if max_queue_len != float("inf"):
             from .algos.rand import pad_ids_pow2
 
-            b = len(pad_ids_pow2([0], min_bucket=int(max_queue_len)))
+            b = len(pad_ids_pow2([0], min_bucket=min(int(max_queue_len), 64)))
             domain._ids_bucket = max(getattr(domain, "_ids_bucket", 1), b)
         # precedence: explicit argument > backend attribute > 1.0s default.
         # An async Trials backend may dictate its own polling cadence (the
@@ -189,6 +183,13 @@ class FMinIter:
         if not hasattr(trials, "phase_timings"):
             trials.phase_timings = PhaseTimings()
         self.phase_timings = trials.phase_timings
+        # the run-telemetry bundle (obs/): the tracer aggregates into
+        # phase_timings (back-compat view), and an armed config additionally
+        # streams spans/events/metrics as JSONL.  One flag arms everything,
+        # including the jax.profiler hook (HYPEROPT_TPU_OBS / obs= kwarg).
+        self.obs = obs_mod.RunObs.resolve(obs, totals=trials.phase_timings)
+        trials.obs_run_id = self.obs.run_id
+        trials.obs_metrics = self.obs.metrics  # direct post-run handle
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
@@ -206,8 +207,11 @@ class FMinIter:
                 continue
             trial["state"] = JOB_STATE_RUNNING
             trial["book_time"] = coarse_utcnow()
+            self.obs.trial_event(obs_mod.events_mod.TRIAL_CLAIMED,
+                                 trial["tid"], owner="serial")
             spec = spec_from_misc(trial["misc"])
             ctrl = Ctrl(self.trials, current_trial=trial)
+            t0 = time.perf_counter()
             try:
                 result = self.domain.evaluate(spec, ctrl)
             except Exception as e:
@@ -215,6 +219,10 @@ class FMinIter:
                 trial["state"] = JOB_STATE_ERROR
                 trial["misc"]["error"] = (str(type(e)), str(e))
                 trial["refresh_time"] = coarse_utcnow()
+                self.obs.trial_event(obs_mod.events_mod.TRIAL_FINISHED,
+                                     trial["tid"], status="error",
+                                     sec=time.perf_counter() - t0)
+                self.obs.counter("trials.errors").inc()
                 if not self.catch_eval_exceptions:
                     self.trials.refresh()
                     raise
@@ -222,6 +230,11 @@ class FMinIter:
                 trial["state"] = JOB_STATE_DONE
                 trial["result"] = result
                 trial["refresh_time"] = coarse_utcnow()
+                self.obs.trial_event(obs_mod.events_mod.TRIAL_FINISHED,
+                                     trial["tid"],
+                                     status=result.get("status", "ok"),
+                                     sec=time.perf_counter() - t0)
+                self.obs.counter("trials.completed").inc()
             N -= 1
             if N == 0:
                 break
@@ -265,35 +278,30 @@ class FMinIter:
             self.serial_evaluate()
 
     def _timed(self, phase):
-        """Context manager accumulating wall time into ``phase_timings``."""
-        timings = self.phase_timings
+        """A tracer span for one loop phase: accumulates wall time into
+        ``phase_timings`` (the historical contract) and, when the obs config
+        is armed, streams the span — with nesting and CPU time — to the
+        run's JSONL sink."""
+        return self.obs.span(phase)
 
-        @contextlib.contextmanager
-        def ctx():
-            t0 = time.perf_counter()
-            try:
-                yield
-            finally:
-                timings.add(phase, time.perf_counter() - t0)
-
-        return ctx()
-
-    @staticmethod
-    def _profiler_ctx():
-        """Optional ``jax.profiler`` trace over the whole loop: set
-        ``HYPEROPT_TPU_PROFILE=<dir>`` to capture a TensorBoard-viewable
-        device+host trace of every suggest kernel and readback."""
-        pdir = os.environ.get("HYPEROPT_TPU_PROFILE", "")
-        if not pdir:
-            return contextlib.nullcontext()
-        import jax
-
-        logger.info("profiling to %s (jax.profiler.trace)", pdir)
-        return jax.profiler.trace(pdir)
+    def _profiler_ctx(self):
+        """Optional ``jax.profiler`` trace over the whole loop, armed by the
+        obs config (``HYPEROPT_TPU_PROFILE=<dir>`` or
+        ``ObsConfig(profile_dir=...)``): a TensorBoard-viewable device+host
+        trace of every suggest kernel and readback."""
+        return self.obs.profiler_ctx()
 
     def run(self, N, block_until_done=True):
         with self._profiler_ctx():
-            self._run(N, block_until_done)
+            with self.obs.span("run", aggregate=False,
+                               N=N if N != float("inf") else "inf",
+                               device_loop=bool(self.device_loop)):
+                try:
+                    self._run(N, block_until_done)
+                finally:
+                    # flush a metrics snapshot record per run() so a killed
+                    # stream still ends with the latest full picture
+                    self.obs.finish()
 
     def _device_loop_plan(self):
         """Resolve ``device_loop`` eligibility.  Returns ``(plan, reasons)``
@@ -365,7 +373,8 @@ class FMinIter:
         cs = self.domain.cs
         L = len(cs.labels)
         cap = int(self.max_evals)
-        runner = DeviceLoopRunner(self.domain, cfg, n_startup, cap)
+        runner = DeviceLoopRunner(self.domain, cfg, n_startup, cap,
+                                  obs=self.obs)
         # incremental runs (iterator protocol / repeated run()) continue from
         # the device-side history this iter accumulated; _device_loop_plan
         # guarantees len(trials) == _device_n_done when we get here
@@ -408,6 +417,11 @@ class FMinIter:
                     doc["state"] = JOB_STATE_DONE
                     doc["book_time"] = now
                     doc["refresh_time"] = now
+                    self.obs.trial_event(
+                        obs_mod.events_mod.TRIAL_FINISHED, doc["tid"],
+                        status=doc["result"].get("status", "ok"),
+                        source="device_loop")
+                self.obs.counter("trials.completed").inc(len(docs))
                 trials.insert_trial_docs(docs)
                 with self._timed("refresh"):
                     trials.refresh()
@@ -484,11 +498,18 @@ class FMinIter:
                             else self.rstate.randint(2**31 - 1),
                         )
                     assert len(new_ids) >= len(new_trials)
+                    self.obs.counter("suggest.calls").inc()
                     if len(new_trials):
+                        for doc in new_trials:
+                            self.obs.trial_event(
+                                obs_mod.events_mod.TRIAL_NEW, doc["tid"])
+                        self.obs.counter("trials.suggested").inc(
+                            len(new_trials))
                         self.trials.insert_trial_docs(new_trials)
                         self.trials.refresh()
                         n_queued += len(new_trials)
                         qlen = get_queue_len()
+                        self.obs.gauge("queue_depth").set(qlen)
                     else:
                         stopped = True
                         break
@@ -597,6 +618,7 @@ def fmin(
     early_stop_fn=None,
     trials_save_file="",
     device_loop=False,
+    obs=None,
 ):
     """Minimize ``fn`` over ``space`` (hyperopt/fmin.py sym: fmin).
 
@@ -610,6 +632,12 @@ def fmin(
     per trial (the high-latency-link mitigation; see
     ``device_fmin.DeviceLoopRunner``).  ``"auto"`` silently falls back to
     the host loop when ineligible; ``True`` raises with the reasons.
+
+    ``obs`` (TPU extension): run-telemetry config — ``None`` reads the
+    environment (``HYPEROPT_TPU_OBS``/``HYPEROPT_TPU_PROFILE``), a path
+    streams spans + trial events + a metrics snapshot to that JSONL file
+    (render with ``python -m hyperopt_tpu.obs.report``), or pass an
+    :class:`hyperopt_tpu.obs.ObsConfig` directly.
     """
     if algo is None:
         try:
@@ -665,6 +693,7 @@ def fmin(
             early_stop_fn=early_stop_fn,
             trials_save_file=trials_save_file,
             device_loop=device_loop,
+            obs=obs,
         )
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
@@ -683,6 +712,7 @@ def fmin(
         early_stop_fn=early_stop_fn,
         trials_save_file=trials_save_file,
         device_loop=device_loop,
+        obs=obs,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()

@@ -1,0 +1,190 @@
+"""hyperopt_tpu.obs — unified run telemetry: spans, metrics, trial events.
+
+The paper's pitch is "as fast as the hardware allows"; this package is how
+a run *proves* where its time goes.  Three pillars, one config:
+
+* :mod:`~hyperopt_tpu.obs.trace` — nested spans (wall + CPU time,
+  structured attrs) streamed as JSONL; absorbs the old ``PhaseTimings``.
+* :mod:`~hyperopt_tpu.obs.metrics` — process-global, per-namespace
+  counters / gauges / bounded histograms with deterministic snapshots.
+* :mod:`~hyperopt_tpu.obs.events` — durable trial-lifecycle event log
+  (``FileStore`` persists it as an attachment for post-mortems).
+
+One flag arms everything: ``HYPEROPT_TPU_OBS=<run.jsonl>`` (or the ``obs=``
+kwarg on ``fmin``/``fmin_multihost``) turns on the JSONL stream, and the
+pre-existing ``HYPEROPT_TPU_PROFILE=<dir>`` ``jax.profiler`` hook now rides
+the same :class:`ObsConfig`.  Render a captured run with::
+
+    python -m hyperopt_tpu.obs.report run.jsonl
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import logging
+import os
+
+from . import events as events_mod
+from .events import EventLog
+from .metrics import MetricsRegistry, get_metrics, reset_metrics
+from .trace import JsonlSink, PhaseTimings, Tracer, read_jsonl
+
+__all__ = [
+    "ObsConfig",
+    "RunObs",
+    "Tracer",
+    "JsonlSink",
+    "PhaseTimings",
+    "EventLog",
+    "MetricsRegistry",
+    "get_metrics",
+    "reset_metrics",
+    "read_jsonl",
+]
+
+logger = logging.getLogger(__name__)
+
+_run_counter = itertools.count(1)
+
+
+@dataclasses.dataclass
+class ObsConfig:
+    """Everything that arms a run's telemetry, in one object.
+
+    ``level``:
+
+    * ``"off"``   — no aggregation at all (phase timings still accumulate:
+      they are load-bearing API, not telemetry).
+    * ``"basic"`` — the default: in-memory metrics + phase totals, no I/O.
+    * ``"trace"`` — additionally stream every span/event/metric snapshot to
+      ``jsonl_path``.
+
+    ``profile_dir`` routes the ``jax.profiler`` trace hook (previously the
+    free-floating ``HYPEROPT_TPU_PROFILE`` check in ``fmin``) through the
+    same object, so one config arms the whole stack.
+    """
+
+    level: str = "basic"
+    jsonl_path: str | None = None
+    profile_dir: str | None = None
+    run_id: str | None = None
+
+    @classmethod
+    def from_env(cls, env=None):
+        env = os.environ if env is None else env
+        raw = env.get("HYPEROPT_TPU_OBS", "").strip()
+        profile_dir = env.get("HYPEROPT_TPU_PROFILE", "") or None
+        if raw in ("", "1", "basic"):
+            level, jsonl_path = "basic", None
+        elif raw in ("0", "off"):
+            level, jsonl_path = "off", None
+        else:  # a path arms the full trace stream
+            level, jsonl_path = "trace", raw
+        return cls(level=level, jsonl_path=jsonl_path,
+                   profile_dir=profile_dir)
+
+    @classmethod
+    def resolve(cls, obs):
+        """Normalize the ``obs=`` kwarg every entry point accepts: None →
+        environment; a string → JSONL path at level "trace"; an ObsConfig →
+        itself."""
+        if obs is None:
+            return cls.from_env()
+        if isinstance(obs, cls):
+            return obs
+        if isinstance(obs, (str, os.PathLike)):
+            return cls(level="trace", jsonl_path=str(obs),
+                       profile_dir=os.environ.get("HYPEROPT_TPU_PROFILE")
+                       or None)
+        raise TypeError(f"obs must be None, a path, or ObsConfig; got {obs!r}")
+
+
+class RunObs:
+    """Per-run telemetry bundle: one tracer + one metrics namespace + one
+    event log, all honoring one :class:`ObsConfig`.
+
+    The registry namespace is ``run_id`` (process-global registry, per-run
+    namespace), so concurrent runs in one process never mix counters while
+    anything holding the run id can read the numbers back.
+    """
+
+    def __init__(self, config=None, totals=None, run_id=None):
+        self.config = config if config is not None else ObsConfig.from_env()
+        self.run_id = (run_id or self.config.run_id
+                       or f"run-{next(_run_counter)}")
+        armed = self.config.level == "trace" and self.config.jsonl_path
+        self.sink = JsonlSink(self.config.jsonl_path) if armed else None
+        self.tracer = Tracer(sink=self.sink, totals=totals,
+                             run_id=self.run_id)
+        self.metrics = get_metrics(self.run_id)
+        self.events = EventLog(sink=self.sink)
+        self._finished = False
+
+    @classmethod
+    def resolve(cls, obs, totals=None, run_id=None):
+        """``obs=`` kwarg → RunObs: passes an existing RunObs through (so
+        ``fmin`` can hand its bundle to the device runner), builds one from
+        a config/path/None otherwise."""
+        if isinstance(obs, cls):
+            return obs
+        return cls(ObsConfig.resolve(obs), totals=totals, run_id=run_id)
+
+    # -- sugar used by the instrumented call sites ------------------------
+
+    def span(self, name, **attrs):
+        return self.tracer.span(name, **attrs)
+
+    def event(self, name, **attrs):
+        self.tracer.event(name, **attrs)
+
+    def trial_event(self, event, tid, **attrs):
+        self.events.emit(event, tid, **attrs)
+
+    def counter(self, name):
+        return self.metrics.counter(name)
+
+    def gauge(self, name):
+        return self.metrics.gauge(name)
+
+    def histogram(self, name):
+        return self.metrics.histogram(name)
+
+    def profiler_ctx(self):
+        """``jax.profiler.trace`` over the whole loop when ``profile_dir``
+        is armed (the old ``HYPEROPT_TPU_PROFILE`` hook, now config-routed).
+        """
+        pdir = self.config.profile_dir
+        if not pdir:
+            return contextlib.nullcontext()
+        import jax
+
+        logger.info("profiling to %s (jax.profiler.trace)", pdir)
+        return jax.profiler.trace(pdir)
+
+    def snapshot(self, extra_namespaces=("device",)):
+        """This run's metrics snapshot plus the shared device namespace
+        (compile/execute split and run-cache hit rates live there because
+        the compiled-run cache itself is process-global)."""
+        snap = self.metrics.snapshot()
+        for ns in extra_namespaces:
+            if ns != self.run_id:
+                snap.setdefault("shared", {})[ns] = get_metrics(ns).snapshot()
+        if self.tracer.totals:
+            snap["phase_timings"] = self.tracer.totals.summary()
+        return snap
+
+    def finish(self):
+        """Flush the run: write the final metrics snapshot to the JSONL
+        stream, close the sink's handle (it reopens in append mode if the
+        run is re-entered — iterator-protocol fmin), and release this run's
+        namespace from the global registry table so a long-lived sweep
+        process doesn't grow it without bound.  ``self.metrics`` stays
+        alive for anyone holding the bundle; idempotent."""
+        if self.sink is not None:
+            self.sink.write({"kind": "metrics", "run_id": self.run_id,
+                             "snapshot": self.snapshot()})
+            self.sink.close()
+        reset_metrics(self.run_id)
+        self._finished = True

@@ -1,0 +1,219 @@
+"""Render a captured run's JSONL telemetry stream into a human report.
+
+Usage::
+
+    python -m hyperopt_tpu.obs.report run.jsonl [--top 5]
+
+Three sections, matching the three pillars:
+
+1. **Phase-time breakdown** — spans aggregated by name: where the run's
+   wall clock (and host CPU) actually went, with a share bar.
+2. **Trial-state waterfall** — lifecycle events rolled into per-trial
+   timelines: counts per transition, queue latency (new→claimed) and run
+   latency (claimed→finished) distributions.
+3. **Top-k slowest trials** — the individual post-mortem targets.
+
+Plus the final metrics snapshot(s) embedded in the stream (compile vs
+execute split, cache hit rates, queue gauges).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .events import (
+    TRIAL_CANCELLED,
+    TRIAL_CLAIMED,
+    TRIAL_FINISHED,
+    TRIAL_NEW,
+    TRIAL_RECLAIMED,
+)
+from .trace import read_jsonl
+
+__all__ = ["main", "render"]
+
+_BAR_W = 30
+
+
+def _bar(frac, width=_BAR_W):
+    n = int(round(frac * width))
+    return "#" * n + "." * (width - n)
+
+
+def _fmt_sec(s):
+    if s is None:
+        return "-"
+    if s < 1e-3:
+        return f"{s * 1e6:.0f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+def _phase_section(spans, out):
+    # shares are SELF time (wall minus direct children) so an umbrella span
+    # like fmin's "run" doesn't double-count its phases into the breakdown
+    child_wall = {}
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is not None:
+            child_wall[pid] = child_wall.get(pid, 0.0) + s.get("wall_sec", 0.0)
+    agg = {}
+    for s in spans:
+        e = agg.setdefault(s["name"],
+                           {"sec": 0.0, "self": 0.0, "cpu": 0.0, "count": 0})
+        wall = s.get("wall_sec", 0.0)
+        e["sec"] += wall
+        e["self"] += max(0.0, wall - child_wall.get(s.get("span_id"), 0.0))
+        e["cpu"] += s.get("cpu_sec", 0.0)
+        e["count"] += 1
+    if not agg:
+        out.append("  (no spans in stream)")
+        return
+    total = sum(e["self"] for e in agg.values()) or 1.0
+    width = max(len(n) for n in agg)
+    for name, e in sorted(agg.items(), key=lambda kv: -kv[1]["self"]):
+        frac = e["self"] / total
+        out.append(
+            f"  {name:<{width}}  {_bar(frac)} {frac * 100:5.1f}%  "
+            f"self {_fmt_sec(e['self']):>8}  wall {_fmt_sec(e['sec']):>8}  "
+            f"cpu {_fmt_sec(e['cpu']):>8}  x{e['count']}"
+        )
+
+
+def _trial_timelines(trial_events):
+    """Per-tid {event: first ts} plus terminal info."""
+    timelines = {}
+    for r in trial_events:
+        t = timelines.setdefault(r["tid"], {})
+        t.setdefault(r["event"], r["ts"])  # first occurrence wins
+        if r["event"] == TRIAL_FINISHED:
+            t["_status"] = r.get("status", "ok")
+    return timelines
+
+
+def _quantiles(xs):
+    if not xs:
+        return None
+    xs = sorted(xs)
+
+    def q(p):
+        return xs[min(len(xs) - 1, int(p * (len(xs) - 1) + 0.5))]
+
+    return {"p50": q(0.5), "p90": q(0.9), "max": xs[-1]}
+
+
+def _waterfall_section(trial_events, out):
+    if not trial_events:
+        out.append("  (no trial events in stream)")
+        return
+    counts = {}
+    for r in trial_events:
+        counts[r["event"]] = counts.get(r["event"], 0) + 1
+    out.append("  transitions: " + "  ".join(
+        f"{k}={v}" for k, v in sorted(counts.items())))
+    timelines = _trial_timelines(trial_events)
+    queue_lat = [
+        t[TRIAL_CLAIMED] - t[TRIAL_NEW]
+        for t in timelines.values()
+        if TRIAL_NEW in t and TRIAL_CLAIMED in t
+    ]
+    run_lat = [
+        t[TRIAL_FINISHED] - t[TRIAL_CLAIMED]
+        for t in timelines.values()
+        if TRIAL_CLAIMED in t and TRIAL_FINISHED in t
+    ]
+    for label, lat in (("queue (new->claimed)", queue_lat),
+                       ("run (claimed->finished)", run_lat)):
+        q = _quantiles(lat)
+        if q:
+            out.append(
+                f"  {label:<24} n={len(lat)}  p50 {_fmt_sec(q['p50'])}  "
+                f"p90 {_fmt_sec(q['p90'])}  max {_fmt_sec(q['max'])}")
+    n_reclaimed = counts.get(TRIAL_RECLAIMED, 0)
+    n_cancelled = counts.get(TRIAL_CANCELLED, 0)
+    if n_reclaimed or n_cancelled:
+        out.append(f"  anomalies: reclaimed={n_reclaimed} "
+                   f"cancelled={n_cancelled}")
+
+
+def _slowest_section(trial_events, out, top=5):
+    timelines = _trial_timelines(trial_events)
+    durations = []
+    for tid, t in timelines.items():
+        start = t.get(TRIAL_CLAIMED, t.get(TRIAL_NEW))
+        end = t.get(TRIAL_FINISHED, t.get(TRIAL_CANCELLED))
+        if start is not None and end is not None:
+            durations.append((end - start, tid, t.get("_status", "?")))
+    if not durations:
+        out.append("  (no completed trials in stream)")
+        return
+    durations.sort(reverse=True)
+    for sec, tid, status in durations[:top]:
+        out.append(f"  tid {tid:>6}  {_fmt_sec(sec):>9}  status={status}")
+
+
+def _metrics_section(metric_recs, out):
+    if not metric_recs:
+        out.append("  (no metrics snapshot in stream)")
+        return
+    for rec in metric_recs:
+        snap = rec.get("snapshot", {})
+        out.append(f"  run_id={rec.get('run_id', '?')}")
+        out.append("  " + json.dumps(snap, indent=2, sort_keys=True,
+                                     default=str).replace("\n", "\n  "))
+
+
+def render(records, top=5):
+    """Build the report text from parsed JSONL records."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    trial_events = [r for r in records if r.get("kind") == "trial_event"]
+    metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    events = [r for r in records if r.get("kind") == "event"]
+
+    out = []
+    out.append("== phase-time breakdown " + "=" * 40)
+    _phase_section(spans, out)
+    out.append("")
+    out.append("== trial-state waterfall " + "=" * 39)
+    _waterfall_section(trial_events, out)
+    out.append("")
+    out.append(f"== top-{top} slowest trials " + "=" * 38)
+    _slowest_section(trial_events, out, top=top)
+    out.append("")
+    out.append("== metrics snapshot " + "=" * 44)
+    _metrics_section(metric_recs, out)
+    if events:
+        out.append("")
+        out.append("== events " + "=" * 54)
+        for r in events:
+            attrs = r.get("attrs", {})
+            out.append(f"  {r['name']}  " + json.dumps(attrs, default=str))
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.obs.report",
+        description="Render a hyperopt_tpu obs JSONL stream.")
+    p.add_argument("jsonl", help="telemetry stream written by an armed run")
+    p.add_argument("--top", type=int, default=5,
+                   help="how many slowest trials to list")
+    args = p.parse_args(argv)
+    try:
+        records = read_jsonl(args.jsonl)
+    except OSError as e:
+        print(f"error: cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"error: {args.jsonl} holds no telemetry records",
+              file=sys.stderr)
+        return 1
+    sys.stdout.write(render(records, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""Span/event tracer: nested context-manager spans with wall + CPU time,
+structured attributes, and an optional JSONL sink.
+
+This is the first pillar of the run-telemetry layer (SURVEY.md §5 tracing
+row).  It absorbs and supersedes the ad-hoc ``PhaseTimings`` dict that used
+to live in ``fmin.py``: the tracer aggregates every span's wall clock into a
+:class:`PhaseTimings` (``totals``), so ``trials.phase_timings`` keeps its
+exact historical shape (plain picklable dict of ``{"sec", "count"}``) while
+armed runs additionally stream one JSON line per span.
+
+Design constraints:
+
+* **Dependency-free and cheap when disarmed** — with no sink, a span costs
+  two ``perf_counter`` calls, two ``process_time`` calls and one dict
+  update; the default ``fmin`` path must not regress (<2% on the bench
+  headline is the acceptance bar).
+* **Thread-correct nesting** — the open-span stack is thread-local, so
+  executor worker threads and the driver thread each get their own parent
+  chain while sharing one sink/aggregate.
+* **Post-mortem friendly** — records carry absolute timestamps (``ts``)
+  next to monotonic durations, so interleaved multi-source JSONL files sort
+  into one timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["PhaseTimings", "Tracer", "JsonlSink", "read_jsonl"]
+
+
+class PhaseTimings(dict):
+    """Per-phase wall-clock accounting for the ask→tell loop (SURVEY.md §5
+    tracing row).  Maps phase name → ``{"sec": total, "count": calls}``;
+    lives on the trials object (``trials.phase_timings``) so it survives
+    pickling/resume and is inspectable after ``fmin`` returns.
+
+    Since the obs layer landed this is the *aggregate view* the
+    :class:`Tracer` maintains — the tracer owns the measurement, this dict
+    owns the accumulated totals (and stays a plain dict so checkpoints
+    written before the tracer existed still load).
+    """
+
+    def add(self, phase, dt):
+        e = self.setdefault(phase, {"sec": 0.0, "count": 0})
+        e["sec"] += dt
+        e["count"] += 1
+
+    def summary(self):
+        total = sum(e["sec"] for e in self.values()) or 1.0
+        return {
+            k: {**e, "frac": e["sec"] / total}
+            for k, e in sorted(self.items(), key=lambda kv: -kv[1]["sec"])
+        }
+
+
+class JsonlSink:
+    """Append-only JSONL writer shared by tracer, metrics and event log.
+
+    Writes are serialized under a lock and flushed per record (a crashed
+    run's partial stream is still a valid prefix).  The file handle opens
+    lazily so constructing a sink for a run that never emits costs nothing.
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._f = None
+        self._lock = threading.Lock()
+
+    def write(self, record: dict):
+        line = json.dumps(record, default=_json_default)
+        with self._lock:
+            if self._f is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    # sinks ride on objects that cross pickle boundaries (Trials backends);
+    # only the path is identity — the handle reopens on next write
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._f = None
+        self._lock = threading.Lock()
+
+
+def _json_default(o):
+    # numpy scalars and anything else non-JSON: degrade to float/str, never
+    # let a telemetry write raise into the instrumented hot path
+    try:
+        return float(o)
+    except Exception:
+        return str(o)
+
+
+def read_jsonl(path):
+    """Parse a JSONL file into a list of records, skipping torn lines (a
+    killed process may leave a partial final line)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "attrs", "aggregate", "span_id",
+                 "parent_id", "depth", "ts", "_t0", "_c0")
+
+    def __init__(self, tracer, name, attrs, aggregate=True):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.aggregate = aggregate
+
+    def __enter__(self):
+        tr = self.tracer
+        if tr.sink is None:
+            # disarmed fast path: one clock read, no id/stack/CPU-clock
+            # bookkeeping — this is what the default fmin loop pays
+            self._t0 = time.perf_counter()
+            return self
+        stack = tr._stack()
+        self.span_id = tr._next_id()
+        self.parent_id = stack[-1].span_id if stack else None
+        self.depth = len(stack)
+        stack.append(self)
+        self.ts = time.time()
+        self._c0 = time.process_time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self._t0
+        tr = self.tracer
+        if tr.sink is None:
+            if self.aggregate and tr.totals is not None:
+                tr.totals.add(self.name, wall)
+            return False
+        cpu = time.process_time() - self._c0
+        stack = tr._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if self.aggregate and tr.totals is not None:
+            tr.totals.add(self.name, wall)
+        if tr.sink is not None:
+            rec = {
+                "kind": "span",
+                "name": self.name,
+                "ts": self.ts,
+                "wall_sec": wall,
+                "cpu_sec": cpu,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "depth": self.depth,
+            }
+            if tr.run_id is not None:
+                rec["run_id"] = tr.run_id
+            if self.attrs:
+                rec["attrs"] = self.attrs
+            if exc_type is not None:
+                rec["error"] = exc_type.__name__
+            tr.sink.write(rec)
+        return False
+
+
+class Tracer:
+    """Produces nested spans; aggregates per-name wall clock into
+    ``totals`` and (when armed) streams one record per span to ``sink``."""
+
+    def __init__(self, sink=None, totals=None, run_id=None):
+        self.sink = sink
+        self.totals = totals if totals is not None else PhaseTimings()
+        self.run_id = run_id
+        self._local = threading.local()
+        self._id_lock = threading.Lock()
+        self._id = 0
+
+    def _stack(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _next_id(self):
+        with self._id_lock:
+            self._id += 1
+            return self._id
+
+    def span(self, name, aggregate=True, **attrs):
+        """Context manager timing one phase; nests under any open span on
+        this thread.  ``aggregate=False`` keeps an umbrella span (e.g. the
+        whole ``run``) out of the per-phase totals, which would otherwise
+        double-count its children."""
+        return _Span(self, name, attrs, aggregate=aggregate)
+
+    def event(self, name, **attrs):
+        """Instantaneous structured record (divergence dumps, stop reasons);
+        a no-op without a sink."""
+        if self.sink is None:
+            return
+        rec = {"kind": "event", "name": name, "ts": time.time()}
+        if self.run_id is not None:
+            rec["run_id"] = self.run_id
+        if attrs:
+            rec["attrs"] = attrs
+        self.sink.write(rec)

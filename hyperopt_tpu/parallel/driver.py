@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 
 import numpy as np
 
@@ -46,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from ..exceptions import AllTrialsFailed
+from ..obs import RunObs
 from ..spaces import compile_space
 from ..algos import tpe
 
@@ -99,7 +101,8 @@ def _gen_seed(seed, gen):
 
 
 def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
-                   n_startup=None, checkpoint_file=None, _force_single=False):
+                   n_startup=None, checkpoint_file=None, obs=None,
+                   _force_single=False):
     """Minimize ``fn`` over ``space`` across every process of a
     ``jax.distributed`` runtime.  Call from ALL processes with identical
     arguments (SPMD); returns the same :class:`MultihostResult` everywhere.
@@ -119,7 +122,23 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     continues the exact trial sequence of an uninterrupted one: generation
     seeds depend only on ``(seed, generation)``, checkpoints land on
     generation boundaries, and the fold digest is replayed from the saved
-    rows (the post-resume checksum equals the uninterrupted run's)."""
+    rows (the post-resume checksum equals the uninterrupted run's).
+
+    .. warning:: **Pickle trust** — checkpoints are loaded with
+       ``pickle.load``, so resuming from a tampered ``checkpoint_file``
+       executes arbitrary code.  This matches the repo-wide
+       ``trials_save_file``/filestore pickle convention (and the
+       reference's), but ``checkpoint_file`` is *documented* to live on a
+       filesystem shared by every controller, which widens the writer set:
+       restrict write access on that path to the controller processes (see
+       docs/DESIGN.md "Observability & trust").
+
+    ``obs``: run-telemetry config (``None`` → environment, a path → JSONL
+    stream, or an ``ObsConfig``/``RunObs``).  Records per-generation spans,
+    allgather latency, checkpoint save/load timing, and — on
+    :class:`ControllerDivergence` — a full context dump of the disagreeing
+    checksums."""
+    obs = RunObs.resolve(obs)
     single = _force_single or jax.process_count() == 1
     if single:
         pid, P = 0, 1
@@ -142,8 +161,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         import pickle
 
         if os.path.exists(checkpoint_file):
+            # trust boundary: see the docstring's pickle-trust warning
+            t0 = time.perf_counter()
             with open(checkpoint_file, "rb") as f:
                 saved = pickle.load(f)
+            obs.histogram("checkpoint.load_sec").observe(
+                time.perf_counter() - t0)
     # a bitwise resume requires the identical run parameters: generation
     # seeds depend on (seed, gen), gen boundaries on batch, the
     # startup/posterior switch on n_startup, and the proposals on cfg
@@ -207,9 +230,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         arrays — the startup sampler — are already whole on every process
         and must NOT be allgathered: process_allgather concatenates local
         arrays.)"""
+        t0 = time.perf_counter()
         full = np.asarray(
             multihost_utils.process_allgather(mat, tiled=True)
         ).reshape(batch, len(labels))
+        obs.histogram("allgather.proposals_sec").observe(
+            time.perf_counter() - t0)
         return {l: full[:, j] for j, l in enumerate(labels)}
 
     digest = hashlib.sha256()
@@ -235,17 +261,26 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 + [np.asarray(saved["vals"][l], np.float32)[:, None]
                    for l in labels], axis=1)
             digest.update(np.ascontiguousarray(rows, np.float32).tobytes())
-    if not single:
+    if not single and checkpoint_file is not None:
         # resume agreement: only controller 0 writes the checkpoint, so a
         # per-host disk (or NFS lag) could hand each controller a different
         # resume point — mismatched generation counters mean mismatched
         # collective schedules, i.e. a silent deadlock.  Fail loudly
         # instead: every controller must have loaded identical state.
+        # Gated on checkpoint_file: without one, n_done is always 0 and the
+        # digest always fresh, so the collective could only ever agree —
+        # pure overhead per fmin_multihost call (ADVICE.md round 5).
+        obs.counter("resume_agreement_checks").inc()
+        t0 = time.perf_counter()
         state8 = np.frombuffer(digest.digest()[:8], np.uint64)[0]
         mine = jnp.asarray(np.asarray([n_done, state8], np.uint64))
         all_s = np.asarray(
             multihost_utils.process_allgather(mine)).reshape(P, 2)
+        obs.histogram("allgather.resume_sec").observe(
+            time.perf_counter() - t0)
         if not (all_s == all_s[0]).all():
+            obs.event("resume_disagreement", n_done=int(n_done),
+                      states=[[int(x) for x in row] for row in all_s])
             raise ValueError(
                 f"controllers disagree on the resume state {all_s.tolist()}"
                 " — checkpoint_file must live on a filesystem shared by"
@@ -270,24 +305,28 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             "vals": {l: hist["vals"][l][:n_done].copy() for l in labels},
             "active": {l: hist["active"][l][:n_done].copy() for l in labels},
         }
+        t0 = time.perf_counter()
         _atomic_write(checkpoint_file, pickle.dumps(state))
+        obs.histogram("checkpoint.save_sec").observe(
+            time.perf_counter() - t0)
 
     while n_done < max_evals:
         B = min(batch, max_evals - n_done)
         gseed = _gen_seed(seed, gen)
-        if n_done < n_startup:
-            # deterministic in (gseed, index): every process computes the
-            # whole startup batch locally, no exchange needed
-            out = sample_fn(local_keys(gseed))
-            flats = {l: np.asarray(out[l]) for l in labels}
-        elif single:
-            out = propose_fn(jax.tree.map(jnp.asarray, hist),
-                             local_keys(gseed))
-            flats = {l: np.asarray(out[l]) for l in labels}
-        else:
-            keys = multihost.global_key_batch(gseed, batch, mesh)
-            hist_dev = multihost.replicate_global(hist, mesh)
-            flats = gather_packed(propose_sharded(hist_dev, keys))
+        with obs.span("propose", gen=gen):
+            if n_done < n_startup:
+                # deterministic in (gseed, index): every process computes
+                # the whole startup batch locally, no exchange needed
+                out = sample_fn(local_keys(gseed))
+                flats = {l: np.asarray(out[l]) for l in labels}
+            elif single:
+                out = propose_fn(jax.tree.map(jnp.asarray, hist),
+                                 local_keys(gseed))
+                flats = {l: np.asarray(out[l]) for l in labels}
+            else:
+                keys = multihost.global_key_batch(gseed, batch, mesh)
+                hist_dev = multihost.replicate_global(hist, mesh)
+                flats = gather_packed(propose_sharded(hist_dev, keys))
 
         def flat_j(j):
             """Host-typed flat sample (int families come back exact off the
@@ -301,11 +340,14 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # evaluate MY shard (round-robin by global position in the batch)
         my_js = [j for j in range(B) if j % P == pid]
         my_losses = np.full(len(my_js), np.nan, np.float32)
-        for k, j in enumerate(my_js):
-            try:
-                my_losses[k] = float(fn(cs.assemble(flat_j(j))))
-            except Exception:
-                my_losses[k] = np.nan  # failed trial: no loss, stays typical
+        with obs.span("evaluate", gen=gen, n_local=len(my_js)):
+            for k, j in enumerate(my_js):
+                try:
+                    my_losses[k] = float(fn(cs.assemble(flat_j(j))))
+                except Exception:
+                    # failed trial: no loss, stays typical
+                    my_losses[k] = np.nan
+                    obs.counter("trials.failed").inc()
         if single:
             losses = my_losses
         else:
@@ -314,38 +356,58 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             width = (B + P - 1) // P
             padded = np.full(width, np.nan, np.float32)
             padded[: len(my_losses)] = my_losses
+            t0 = time.perf_counter()
             gathered = np.asarray(
                 multihost_utils.process_allgather(jnp.asarray(padded))
             ).reshape(P, width)
+            obs.histogram("allgather.losses_sec").observe(
+                time.perf_counter() - t0)
             losses = np.full(B, np.nan, np.float32)
             for p in range(P):
                 js = np.arange(p, B, P)
                 losses[js] = gathered[p, : len(js)]
 
         # deterministic fold, global trial-id order
-        for j in range(B):
-            i = n_done + j
-            ok = np.isfinite(losses[j])
-            hist["losses"][i] = losses[j] if ok else np.inf
-            hist["has_loss"][i] = ok
-            raw_losses[i] = losses[j]
-            for l in labels:
-                hist["vals"][l][i] = flats[l][j]
-            act = cs.active_flat(flat_j(j))
-            for l in labels:
-                hist["active"][l][i] = bool(act[l])
-            digest.update(np.float32(losses[j]).tobytes())
-            digest.update(
-                b"".join(np.float32(flats[l][j]).tobytes() for l in labels))
+        with obs.span("fold", gen=gen):
+            for j in range(B):
+                i = n_done + j
+                ok = np.isfinite(losses[j])
+                hist["losses"][i] = losses[j] if ok else np.inf
+                hist["has_loss"][i] = ok
+                raw_losses[i] = losses[j]
+                for l in labels:
+                    hist["vals"][l][i] = flats[l][j]
+                act = cs.active_flat(flat_j(j))
+                for l in labels:
+                    hist["active"][l][i] = bool(act[l])
+                digest.update(np.float32(losses[j]).tobytes())
+                digest.update(
+                    b"".join(np.float32(flats[l][j]).tobytes()
+                             for l in labels))
         n_done += B
         gen += 1
+        obs.counter("generations").inc()
         # divergence checksum: every controller must have folded the same
         # bytes in the same order
         if not single:
             h = int.from_bytes(digest.digest()[:8], "big")
+            t0 = time.perf_counter()
             all_h = np.asarray(multihost_utils.process_allgather(
                 jnp.asarray(np.uint64(h))))
+            obs.histogram("allgather.checksum_sec").observe(
+                time.perf_counter() - t0)
             if not np.all(all_h == all_h.reshape(-1)[0]):
+                # post-mortem context dump: everything a human needs to see
+                # WHICH controller diverged and on what data, persisted to
+                # the JSONL stream before the raise tears the process down
+                obs.event(
+                    "controller_divergence",
+                    pid=pid, n_done=int(n_done), gen=int(gen),
+                    checksums=[hex(int(x)) for x in all_h.reshape(-1)],
+                    last_gen_losses=[float(x) for x in losses],
+                    batch=int(B),
+                )
+                obs.counter("divergences").inc()
                 raise ControllerDivergence(
                     f"history checksums diverged after {n_done} trials: "
                     f"{[hex(int(x)) for x in all_h.reshape(-1)]}")
@@ -363,6 +425,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             if cs.params[l].is_int else float(hist["vals"][l][best_i]))
         for l in labels
     }
+    obs.finish()  # flush the metrics snapshot to an armed JSONL stream
     return MultihostResult(
         best=cs.assemble(best_flat),
         best_loss=float(losses_all[best_i]),

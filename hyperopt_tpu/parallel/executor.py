@@ -28,12 +28,21 @@ blob stored by ``FMinIter`` under ``attachments['FMinIter_Domain']``
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..obs import EventLog, MetricsRegistry
+from ..obs.events import (
+    TRIAL_CANCELLED,
+    TRIAL_CLAIMED,
+    TRIAL_FINISHED,
+    TRIAL_NEW,
+)
 from ..base import (
     JOB_STATE_CANCEL,
     JOB_STATE_DONE,
@@ -51,6 +60,10 @@ from ..base import (
 __all__ = ["ExecutorTrials"]
 
 logger = logging.getLogger(__name__)
+
+# each pool instance gets its own metrics namespace (executor-1, -2, ...) so
+# two concurrent backends in one process don't mix queue gauges
+_instance_ids = itertools.count(1)
 
 
 class ExecutorTrials(Trials):
@@ -81,7 +94,25 @@ class ExecutorTrials(Trials):
         self._domain_cache = None
         self._batch_eval_cache = None
         self._dispatched = set()  # tids already submitted to the pool
+        # obs: queue/utilization gauges + lifecycle events for this pool
+        # (in-memory ring; the durable analog lives in FileStore).  The
+        # registry is per-instance and deliberately NOT globally registered:
+        # readers reach it via `trials.metrics`, and registering every pool
+        # (plus every unpickle) would grow the process-global table forever
+        self.metrics = MetricsRegistry(f"executor-{next(_instance_ids)}")
+        self.metrics.gauge("n_workers").set(self.n_workers)
+        self.obs_events = EventLog()
+        self._busy = 0
         super().__init__(exp_key=exp_key, refresh=refresh)
+
+    # -- obs plumbing ------------------------------------------------------
+
+    def _worker_busy(self, delta):
+        """Track pool utilization: busy-worker gauge + cumulative busy
+        seconds (divide by wall x n_workers for utilization)."""
+        with self._lock:
+            self._busy += delta
+            self.metrics.gauge("busy_workers").set(self._busy)
 
     # -- pool / domain plumbing -------------------------------------------
 
@@ -116,11 +147,14 @@ class ExecutorTrials(Trials):
             trial["state"] = JOB_STATE_RUNNING
             trial["book_time"] = coarse_utcnow()
             trial["owner"] = threading.current_thread().name
-            return True
+        self.obs_events.emit(TRIAL_CLAIMED, trial["tid"],
+                             owner=trial["owner"])
+        return True
 
     def _finish(self, trial, result=None, error=None):
         with self._lock:
             if trial["state"] == JOB_STATE_CANCEL:
+                self.metrics.counter("results.discarded").inc()
                 return  # timed out meanwhile: the late result is discarded
             # write result BEFORE state: the driver thread reads docs without
             # this lock, and must never observe DONE with a stale result
@@ -131,6 +165,19 @@ class ExecutorTrials(Trials):
                 trial["result"] = result
                 trial["state"] = JOB_STATE_DONE
             trial["refresh_time"] = coarse_utcnow()
+        sec = None
+        if trial.get("book_time") is not None:
+            sec = (trial["refresh_time"] - trial["book_time"]).total_seconds()
+            self.metrics.histogram("trial_sec").observe(sec)
+        if error is not None:
+            self.metrics.counter("trials.errors").inc()
+            self.obs_events.emit(TRIAL_FINISHED, trial["tid"],
+                                 status="error", sec=sec)
+        else:
+            self.metrics.counter("trials.completed").inc()
+            self.obs_events.emit(TRIAL_FINISHED, trial["tid"],
+                                 status=(result or {}).get("status", "ok"),
+                                 sec=sec)
 
     def checkpoint_trial(self, doc):
         """Ctrl.checkpoint hook: stamp the partial result under the lock so
@@ -160,6 +207,9 @@ class ExecutorTrials(Trials):
                         f"trial exceeded per-trial timeout {self.timeout}s",
                     )
                     t["refresh_time"] = now
+                    self.metrics.counter("trials.timeouts").inc()
+                    self.obs_events.emit(TRIAL_CANCELLED, t["tid"],
+                                         reason="trial_timeout")
                     logger.warning("trial %s cancelled after %ss timeout",
                                    t["tid"], self.timeout)
 
@@ -174,12 +224,17 @@ class ExecutorTrials(Trials):
                     t["result"] = {**(t.get("result") or {}), "status": STATUS_FAIL}
                     t["misc"]["error"] = ("Cancelled", "fmin timeout")
                     t["refresh_time"] = coarse_utcnow()
+                    self.metrics.counter("trials.cancelled").inc()
+                    self.obs_events.emit(TRIAL_CANCELLED, t["tid"],
+                                         reason="fmin_timeout")
 
     def _run_one(self, trial):
         """Evaluate one claimed trial (MongoWorker.run_one analog)."""
         domain = self._get_domain()
         if domain is None or not self._claim(trial):
             return
+        self._worker_busy(+1)
+        t0 = time.perf_counter()
         try:
             spec = spec_from_misc(trial["misc"])
             result = domain.evaluate(spec, Ctrl(self, current_trial=trial))
@@ -188,6 +243,10 @@ class ExecutorTrials(Trials):
             self._finish(trial, error=e)
         else:
             self._finish(trial, result=result)
+        finally:
+            self.metrics.counter("worker_busy_sec").inc(
+                time.perf_counter() - t0)
+            self._worker_busy(-1)
 
     def _run_batch(self, trials_batch):
         """Evaluate a queue of trials as ONE vmapped device program."""
@@ -197,32 +256,40 @@ class ExecutorTrials(Trials):
         claimed = [t for t in trials_batch if self._claim(t)]
         if not claimed:
             return
+        self._worker_busy(+1)
+        t0 = time.perf_counter()
+        self.metrics.counter("batch_evals").inc()
         try:
-            import jax.numpy as jnp
+            try:
+                import jax.numpy as jnp
 
-            if self._batch_eval_cache is None:
-                self._batch_eval_cache = domain.make_batch_eval()
-            labels = domain.cs.labels
-            specs = [spec_from_misc(t["misc"]) for t in claimed]
-            flat_batch = {
-                l: jnp.asarray(
-                    np.array([s.get(l, 0.0) for s in specs], np.float32)
-                    if not domain.cs.params[l].is_int
-                    else np.array([int(s.get(l, 0)) for s in specs], np.int32)
-                )
-                for l in labels
-            }
-            losses = np.asarray(self._batch_eval_cache(flat_batch), np.float64)
-        except Exception as e:
-            logger.error("batched async eval exception: %s", e)
-            for t in claimed:
-                self._finish(t, error=e)
-            return
-        for t, loss in zip(claimed, losses):
-            if np.isfinite(loss):
-                self._finish(t, result={"loss": float(loss), "status": STATUS_OK})
-            else:
-                self._finish(t, error=ValueError(f"non-finite loss {loss}"))
+                if self._batch_eval_cache is None:
+                    self._batch_eval_cache = domain.make_batch_eval()
+                labels = domain.cs.labels
+                specs = [spec_from_misc(t["misc"]) for t in claimed]
+                flat_batch = {
+                    l: jnp.asarray(
+                        np.array([s.get(l, 0.0) for s in specs], np.float32)
+                        if not domain.cs.params[l].is_int
+                        else np.array([int(s.get(l, 0)) for s in specs], np.int32)
+                    )
+                    for l in labels
+                }
+                losses = np.asarray(self._batch_eval_cache(flat_batch), np.float64)
+            except Exception as e:
+                logger.error("batched async eval exception: %s", e)
+                for t in claimed:
+                    self._finish(t, error=e)
+                return
+            for t, loss in zip(claimed, losses):
+                if np.isfinite(loss):
+                    self._finish(t, result={"loss": float(loss), "status": STATUS_OK})
+                else:
+                    self._finish(t, error=ValueError(f"non-finite loss {loss}"))
+        finally:
+            self.metrics.counter("worker_busy_sec").inc(
+                time.perf_counter() - t0)
+            self._worker_busy(-1)
 
     # -- Trials overrides --------------------------------------------------
 
@@ -245,6 +312,7 @@ class ExecutorTrials(Trials):
             self._dispatched.update(d["tid"] for d in todo)
         if not todo:
             return
+        self.metrics.counter("dispatched").inc(len(todo))
         pool = self._get_pool()
         if self.traceable and len(todo) > 1:
             pool.submit(self._run_batch, todo)
@@ -256,6 +324,8 @@ class ExecutorTrials(Trials):
         with self._lock:
             tids = super().insert_trial_docs(docs)
             inserted = self._dynamic_trials[-len(docs):] if len(docs) else []
+        for d in inserted:
+            self.obs_events.emit(TRIAL_NEW, d["tid"])
         self._dispatch(inserted)
         return tids
 
@@ -268,6 +338,11 @@ class ExecutorTrials(Trials):
                 for d in self._dynamic_trials
                 if d["state"] == JOB_STATE_NEW and d["tid"] not in self._dispatched
             ]
+            n_queued = sum(
+                1 for d in self._dynamic_trials
+                if d["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING)
+            )
+        self.metrics.gauge("queue_depth").set(n_queued)
         self._dispatch(pending)
 
     def delete_all(self):
@@ -303,5 +378,9 @@ class ExecutorTrials(Trials):
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.RLock()
-        # checkpoints written by older versions predate this attribute
+        # checkpoints written by older versions predate these attributes
         self.__dict__.setdefault("_dispatched", set())
+        self.__dict__.setdefault(
+            "metrics", MetricsRegistry(f"executor-{next(_instance_ids)}"))
+        self.__dict__.setdefault("obs_events", EventLog())
+        self.__dict__.setdefault("_busy", 0)

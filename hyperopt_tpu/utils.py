@@ -31,27 +31,48 @@ __all__ = [
 ]
 
 
+_LRU_MISS = object()  # module-level so LRUCache instances pickle cleanly
+
+
 class LRUCache:
     """Bounded most-recently-used mapping for compiled-program caches (no
     reference analog — upstream has no compiled programs to cache).  Each
     entry pins an XLA executable and possibly a user closure, so the
     unbounded-dict alternative leaks memory across sweeps of spaces, configs,
-    or per-call lambdas."""
+    or per-call lambdas.
+
+    ``hits``/``misses`` count ``get`` outcomes for the obs metrics registry
+    (``device_fmin`` publishes its compiled-run cache's rates)."""
 
     def __init__(self, maxsize):
         self.maxsize = int(maxsize)
+        # maxsize < 1 would make put() evict from an empty dict
+        # (StopIteration from next(iter({}))) — fail at construction, not
+        # at the first insert (ADVICE.md round 5)
+        assert self.maxsize >= 1, f"LRUCache maxsize must be >= 1, got {maxsize}"
         self._d = {}
+        self.hits = 0
+        self.misses = 0
 
-    def get(self, key):
-        v = self._d.pop(key, None)
-        if v is not None:
-            self._d[key] = v  # re-insert: most-recently-used at the end
+    def get(self, key, default=None):
+        # sentinel, not None: a stored None value must register as a hit
+        v = self._d.pop(key, _LRU_MISS)
+        if v is _LRU_MISS:
+            self.misses += 1
+            return default
+        self.hits += 1
+        self._d[key] = v  # re-insert: most-recently-used at the end
         return v
 
     def put(self, key, value):
+        self._d.pop(key, None)  # overwrite must not evict an extra entry
         while len(self._d) >= self.maxsize:
             self._d.pop(next(iter(self._d)))  # evict least-recently-used
         self._d[key] = value
+
+    def stats(self):
+        return {"hits": self.hits, "misses": self.misses,
+                "size": len(self._d), "maxsize": self.maxsize}
 
     def __len__(self):
         return len(self._d)

@@ -1,0 +1,416 @@
+"""The run-telemetry subsystem (hyperopt_tpu/obs/): span tracer, metrics
+registry, trial-lifecycle event log, report renderer, and the
+instrumentation wired through all four execution paths.
+
+All tier-1 (CPU, fast): JSONL round-trips use tmp_path, the FileStore
+kill-and-reload test drops every live object before re-opening the store.
+"""
+
+import json
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.obs import (
+    EventLog,
+    JsonlSink,
+    ObsConfig,
+    PhaseTimings,
+    RunObs,
+    Tracer,
+    get_metrics,
+    read_jsonl,
+    reset_metrics,
+)
+from hyperopt_tpu.obs.events import (
+    TRIAL_CLAIMED,
+    TRIAL_FINISHED,
+    TRIAL_NEW,
+    TRIAL_RECLAIMED,
+    FileEventSink,
+    load_events,
+)
+from hyperopt_tpu.obs.metrics import MetricsRegistry
+from hyperopt_tpu.obs.report import main as report_main, render
+from hyperopt_tpu.utils import LRUCache
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2
+
+
+# ---------------------------------------------------------------------------
+# trace: span nesting + JSONL round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_jsonl_roundtrip(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(sink=JsonlSink(path), run_id="t1")
+    with tr.span("outer", gen=3):
+        with tr.span("inner_a"):
+            pass
+        with tr.span("inner_b"):
+            pass
+    recs = read_jsonl(path)
+    assert [r["name"] for r in recs] == ["inner_a", "inner_b", "outer"]
+    by_name = {r["name"]: r for r in recs}
+    outer = by_name["outer"]
+    assert outer["depth"] == 0 and outer["parent_id"] is None
+    assert outer["attrs"] == {"gen": 3}
+    for child in ("inner_a", "inner_b"):
+        assert by_name[child]["parent_id"] == outer["span_id"]
+        assert by_name[child]["depth"] == 1
+    # children closed before the parent: wall clocks nest
+    assert outer["wall_sec"] >= by_name["inner_a"]["wall_sec"]
+    assert all(r["wall_sec"] >= 0 and r["cpu_sec"] >= 0 for r in recs)
+    assert all(r["run_id"] == "t1" for r in recs)
+
+
+def test_span_aggregates_into_totals():
+    totals = PhaseTimings()
+    tr = Tracer(totals=totals)
+    for _ in range(3):
+        with tr.span("suggest"):
+            pass
+    with tr.span("run", aggregate=False):  # umbrella: excluded from totals
+        pass
+    assert totals["suggest"]["count"] == 3
+    assert "run" not in totals
+    fracs = sum(e["frac"] for e in totals.summary().values())
+    assert fracs == pytest.approx(1.0)
+
+
+def test_span_records_error_and_unwinds(tmp_path):
+    tr = Tracer(sink=JsonlSink(tmp_path / "err.jsonl"))
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    # stack unwound: the next span is top-level again
+    with tr.span("after") as s:
+        assert s.depth == 0
+    recs = read_jsonl(tmp_path / "err.jsonl")
+    assert {r["name"]: r.get("error") for r in recs} == {
+        "boom": "ValueError", "after": None}
+
+
+def test_jsonl_skips_torn_final_line(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "span", "name": "a"}) + "\n")
+        f.write('{"kind": "span", "name": "b", "wal')  # killed mid-write
+    recs = read_jsonl(path)
+    assert len(recs) == 1 and recs[0]["name"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# metrics: snapshot determinism
+# ---------------------------------------------------------------------------
+
+
+def _feed(reg):
+    reg.counter("jobs").inc(5)
+    reg.gauge("depth").set(3)
+    h = reg.histogram("lat")
+    for v in [0.1, 0.2, 0.3, 0.4, 0.5]:
+        h.observe(v)
+
+
+def test_metrics_snapshot_deterministic():
+    a, b = MetricsRegistry("ns"), MetricsRegistry("ns")
+    _feed(a)
+    _feed(b)
+    assert a.snapshot() == b.snapshot()
+    assert a.to_json() == b.to_json()
+    snap = a.snapshot()
+    assert snap["metrics"]["jobs"] == 5
+    assert snap["metrics"]["depth"] == 3
+    lat = snap["metrics"]["lat"]
+    assert lat["count"] == 5 and lat["min"] == 0.1 and lat["max"] == 0.5
+    assert lat["p50"] == pytest.approx(0.3)
+
+
+def test_histogram_bounded_memory():
+    h = MetricsRegistry("ns").histogram("x", maxlen=16)
+    for i in range(10_000):
+        h.observe(float(i))
+    s = h.snapshot()
+    assert s["count"] == 10_000  # running stats exact over the full stream
+    assert s["min"] == 0.0 and s["max"] == 9999.0
+    assert len(h._ring) == 16  # percentile buffer stays bounded
+
+
+def test_registry_process_global_per_namespace():
+    reset_metrics("t-global")
+    get_metrics("t-global").counter("c").inc()
+    assert get_metrics("t-global").counter("c").value == 1
+    reset_metrics("t-global")
+    assert get_metrics("t-global").counter("c").value == 0
+
+
+# ---------------------------------------------------------------------------
+# events: durable log persists through FileStore kill-and-reload
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_file_sink_roundtrip(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    log = EventLog(sink=FileEventSink(path))
+    log.emit(TRIAL_NEW, 7)
+    log.emit(TRIAL_FINISHED, 7, status="ok", sec=0.5)
+    recs = load_events(path)
+    assert [r["event"] for r in recs] == [TRIAL_NEW, TRIAL_FINISHED]
+    assert recs[1]["tid"] == 7 and recs[1]["status"] == "ok"
+
+
+def test_filestore_events_survive_kill_and_reload(tmp_path):
+    from hyperopt_tpu.filestore import FileStore
+
+    root = str(tmp_path / "store")
+    store = FileStore(root)
+    [tid] = store.new_trial_ids(1)
+    doc = {"state": 0, "tid": tid, "misc": {"tid": tid}, "result": {},
+           "owner": None, "book_time": None, "refresh_time": None,
+           "version": 0, "spec": None, "exp_key": None}
+    store.write_doc(doc)
+    claimed = store.reserve(owner="w1")
+    assert claimed["tid"] == tid
+    store.finish(claimed, result={"loss": 1.0, "status": "ok"})
+    del store, claimed  # the writing process "dies"
+
+    reopened = FileStore(root)
+    events = reopened.read_events()
+    seq = [r["event"] for r in events if r["tid"] == tid]
+    assert seq == [TRIAL_NEW, TRIAL_CLAIMED, TRIAL_FINISHED]
+    finished = [r for r in events if r["event"] == TRIAL_FINISHED][0]
+    assert finished["status"] == "ok" and finished["owner"] == "w1"
+    # the log rides the attachments namespace (a real FileStore attachment)
+    assert "obs_events.jsonl" in reopened.attachment_names()
+
+
+def test_filestore_reclaim_emits_event(tmp_path):
+    from hyperopt_tpu.filestore import FileStore
+
+    store = FileStore(str(tmp_path / "store"))
+    [tid] = store.new_trial_ids(1)
+    doc = {"state": 0, "tid": tid, "misc": {"tid": tid}, "result": {},
+           "owner": None, "book_time": None, "refresh_time": None,
+           "version": 0, "spec": None, "exp_key": None}
+    store.write_doc(doc)
+    store.reserve(owner="w1")
+    n = store.reclaim_stale(reserve_timeout=0.0)  # heartbeat instantly stale
+    assert n == 1
+    reclaims = [r for r in store.read_events()
+                if r["event"] == TRIAL_RECLAIMED]
+    assert len(reclaims) == 1 and reclaims[0]["tid"] == tid
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimings back-compat: trials.phase_timings through the tracer
+# ---------------------------------------------------------------------------
+
+
+def test_phase_timings_backcompat_and_pickle():
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=8, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    pt = t.phase_timings
+    assert isinstance(pt, PhaseTimings)
+    assert pt["suggest"]["count"] >= 1 and pt["evaluate"]["count"] >= 1
+    assert "run" not in pt  # the umbrella span stays out of phase totals
+    # historical import path still resolves (old pickles reference it)
+    from hyperopt_tpu.fmin import PhaseTimings as FminPhaseTimings
+
+    assert FminPhaseTimings is PhaseTimings
+    t2 = pickle.loads(pickle.dumps(t))
+    assert t2.phase_timings["suggest"]["count"] == pt["suggest"]["count"]
+    # a resumed fmin keeps accumulating into the unpickled dict
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=10, trials=t2,
+         rstate=np.random.default_rng(1), show_progressbar=False)
+    assert t2.phase_timings["suggest"]["count"] > pt["suggest"]["count"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: armed fmin -> JSONL stream -> report
+# ---------------------------------------------------------------------------
+
+
+def test_fmin_obs_stream_and_report(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    t = Trials()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=6, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    recs = read_jsonl(path)
+    kinds = {r["kind"] for r in recs}
+    assert {"span", "trial_event", "metrics"} <= kinds
+    spans = {r["name"] for r in recs if r["kind"] == "span"}
+    assert {"run", "suggest", "evaluate", "refresh"} <= spans
+    events = [r for r in recs if r["kind"] == "trial_event"]
+    assert sum(r["event"] == TRIAL_NEW for r in events) == 6
+    assert sum(r["event"] == TRIAL_FINISHED for r in events) == 6
+    snap = [r for r in recs if r["kind"] == "metrics"][-1]["snapshot"]
+    assert snap["metrics"]["trials.completed"] == 6
+    assert "suggest" in snap["phase_timings"]
+
+    assert report_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "phase-time breakdown" in out
+    assert "suggest" in out
+    assert "trial-state waterfall" in out
+    assert "trial_finished=6" in out
+
+
+def test_report_module_cli(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=3,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "hyperopt_tpu.obs.report", path, "--top", "2"],
+        capture_output=True, text=True,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "slowest trials" in proc.stdout
+
+
+def test_obs_env_flag_arms_stream(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("HYPEROPT_TPU_OBS", path)
+    cfg = ObsConfig.from_env()
+    assert cfg.level == "trace" and cfg.jsonl_path == path
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=3,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert any(r["kind"] == "span" for r in read_jsonl(path))
+
+
+def test_device_loop_obs_compile_execute_split(tmp_path):
+    # the device-stepped loop decomposes suggest into compile vs execute
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    path = str(tmp_path / "dev.jsonl")
+    from hyperopt_tpu.algos import tpe
+
+    t = Trials()
+    fmin(dom.objective, dom.space, algo=tpe.suggest, max_evals=12, trials=t,
+         device_loop=True, rstate=np.random.default_rng(0),
+         show_progressbar=False, obs=path)
+    dev = get_metrics("device").snapshot()["metrics"]
+    assert dev["chunk.execute_sec"]["count"] >= 1
+    assert "chunk.compile_sec" in dev or dev["run_cache.hits"] >= 1
+    assert {"run_cache.hits", "run_cache.misses"} <= set(dev)
+    snap = [r for r in read_jsonl(path) if r["kind"] == "metrics"][-1]
+    assert "device" in snap["snapshot"]["shared"]
+
+
+def test_executor_metrics_and_events():
+    from hyperopt_tpu.parallel.executor import ExecutorTrials
+
+    t = ExecutorTrials(n_workers=2)
+    try:
+        fmin(quad, SPACE, algo=rand.suggest, max_evals=6, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+    finally:
+        t.shutdown()
+    m = t.metrics.snapshot()["metrics"]
+    assert m["trials.completed"] == 6
+    assert m["dispatched"] == 6
+    assert m["n_workers"] == 2
+    assert m["trial_sec"]["count"] == 6
+    seq = [r["event"] for r in t.obs_events.records() if r["tid"] == 0]
+    assert seq[0] == TRIAL_NEW and TRIAL_FINISHED in seq
+
+
+def test_multihost_single_obs(tmp_path):
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    ck = str(tmp_path / "ck.pkl")
+    path = str(tmp_path / "mh.jsonl")
+    r = fmin_multihost(quad, SPACE, max_evals=8, batch=4, seed=0,
+                       checkpoint_file=ck, obs=path, _force_single=True)
+    assert r.n_evals == 8
+    recs = read_jsonl(path)
+    spans = {s["name"] for s in recs if s["kind"] == "span"}
+    assert {"propose", "evaluate", "fold"} <= spans
+    snap = [x for x in recs if x["kind"] == "metrics"][-1]["snapshot"]
+    assert snap["metrics"]["generations"] == 2
+    assert snap["metrics"]["checkpoint.save_sec"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# LRUCache hardening (ADVICE.md round 5)
+# ---------------------------------------------------------------------------
+
+
+def test_lru_cache_rejects_degenerate_maxsize():
+    with pytest.raises(AssertionError):
+        LRUCache(0)
+    with pytest.raises(AssertionError):
+        LRUCache(-3)
+
+
+def test_lru_cache_stored_none_is_a_hit():
+    c = LRUCache(2)
+    c.put("k", None)
+    sentinel = object()
+    assert c.get("k", default=sentinel) is None  # hit, not the default
+    assert c.get("absent", default=sentinel) is sentinel
+    assert c.hits == 1 and c.misses == 1
+    assert c.stats() == {"hits": 1, "misses": 1, "size": 1, "maxsize": 2}
+
+
+def test_lru_cache_eviction_and_overwrite():
+    c = LRUCache(2)
+    c.put("a", 1)
+    c.put("b", 2)
+    c.put("a", 10)  # overwrite must not evict "b"
+    assert c.get("b") == 2
+    c.put("c", 3)  # evicts the least-recently-used ("a")
+    assert c.get("a") is None
+    assert c.get("b") == 2 and c.get("c") == 3
+
+
+# ---------------------------------------------------------------------------
+# report renderer unit coverage
+# ---------------------------------------------------------------------------
+
+
+def test_render_handles_empty_sections():
+    text = render([{"kind": "span", "name": "solo", "ts": 0.0,
+                    "wall_sec": 1.0, "cpu_sec": 0.5, "span_id": 1,
+                    "parent_id": None, "depth": 0}])
+    assert "solo" in text
+    assert "no trial events" in text
+
+
+def test_render_waterfall_latencies():
+    recs = []
+    for tid, (t_new, t_claim, t_done) in enumerate(
+            [(0.0, 1.0, 3.0), (0.0, 2.0, 7.0)]):
+        recs.append({"kind": "trial_event", "event": TRIAL_NEW,
+                     "tid": tid, "ts": t_new})
+        recs.append({"kind": "trial_event", "event": TRIAL_CLAIMED,
+                     "tid": tid, "ts": t_claim})
+        recs.append({"kind": "trial_event", "event": TRIAL_FINISHED,
+                     "tid": tid, "ts": t_done, "status": "ok"})
+    text = render(recs, top=1)
+    assert "queue (new->claimed)" in text
+    assert "run (claimed->finished)" in text
+    assert "tid      1" in text  # the 5s trial is the slowest
+    assert "tid      0" not in text.split("slowest trials")[1].split("==")[0]
+
+
+def test_runobs_resolve_passthrough():
+    r = RunObs(ObsConfig(level="basic"))
+    assert RunObs.resolve(r) is r
+    r2 = RunObs.resolve(None)
+    assert isinstance(r2, RunObs)
+    with pytest.raises(TypeError):
+        ObsConfig.resolve(123)
