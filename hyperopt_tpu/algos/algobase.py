@@ -31,6 +31,10 @@ class SuggestAlgo:
     #: subclasses: number of observed trials below which we delegate to rand
     n_startup_jobs = 0
 
+    #: name used in telemetry records (health JSONL ``algo`` field, device
+    #: cost gauges); subclasses override for a human name (anneal does)
+    obs_name = None
+
     def __init__(self, **cfg):
         self.cfg = cfg
 
@@ -86,4 +90,17 @@ class SuggestAlgo:
         ids = np.asarray([int(i) & 0xFFFFFFFF for i in new_ids], np.uint32)
         mat = run(hist_arrays, seed_words, ids)
         flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
+        # armed obs runs: the cheap health subset (dup rate, spread) from
+        # the host values already fetched, plus a one-time FLOP/byte cost
+        # capture of the suggest program; strictly nothing when disarmed
+        health = getattr(trials, "obs_health", None)
+        if health is not None:
+            from ..obs import health as health_mod
+
+            name = self.obs_name or type(self).__name__.lower()
+            health_mod.capture_jit_cost(
+                run, (hist_arrays, seed_words, ids), f"algo.{name}")
+            if len(flats) >= 2:
+                health_mod.record_proposal_health(
+                    health, name, domain.cs.labels, flats)
         return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

@@ -66,6 +66,10 @@ def _shrink(T, shrink_coef):
 class AnnealSuggest(SuggestAlgo):
     """hyperopt/anneal.py sym: AnnealSuggest."""
 
+    # armed obs runs tag this suggester's health records / cost gauges
+    # "anneal" (the cheap dup-rate + spread subset; algobase.__call__)
+    obs_name = "anneal"
+
     def __init__(self, avg_best_idx=_default_avg_best_idx,
                  shrink_coef=_default_shrink_coef):
         super().__init__(avg_best_idx=float(avg_best_idx),

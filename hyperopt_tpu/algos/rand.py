@@ -159,13 +159,23 @@ def suggest(new_ids, domain, trials, seed):
     """Draw one prior sample per new id (hyperopt/rand.py sym: suggest).
 
     All ids are drawn by one vmapped device program (per-id ``fold_in``
-    keys, so the draws are identical whatever the batching)."""
+    keys, so the draws are identical whatever the batching).
+
+    Armed obs runs additionally record the cheap search-health subset
+    (per-label duplicate rate + proposal spread across the batch) from the
+    already-fetched host values — no extra device work, nothing at all
+    when disarmed (obs/health.py sym: record_proposal_health)."""
     if not len(new_ids):
         return []
     seed = int(seed)
     seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
     mat = _get_sample_jit(domain)(seed_words, pad_ids_sticky(domain, new_ids))
     flats = unpack_flats(domain.cs, mat, len(new_ids))
+    health = getattr(trials, "obs_health", None)
+    if health is not None and len(flats) >= 2:
+        from ..obs.health import record_proposal_health
+
+        record_proposal_health(health, "rand", domain.cs.labels, flats)
     return flat_to_new_trial_docs(domain, trials, new_ids, flats)
 
 

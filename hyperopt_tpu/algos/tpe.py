@@ -478,14 +478,63 @@ def _mix_prior(key, cfg, val, ei_sel, draw, score):
     contract lives HERE and only here: ``fold_in(key, 0x9B10B)`` feeds the
     draw and ``fold_in(key, 0xE9510)`` the take-gate, so the grouped and
     per-label kernels stay draw-for-draw identical (the agreement tests
-    depend on it).  ``draw(kp) -> scalar``; ``score(xs[1]) -> EI[1]``."""
+    depend on it).  ``draw(kp) -> scalar``; ``score(xs[1]) -> EI[1]``.
+
+    Returns ``(value, EI, take)`` — the bool ``take`` flag feeds the
+    health diagnostics (prior-fallback frequency); callers on the plain
+    path drop it and XLA dead-code-eliminates it."""
     eps = float(cfg.get("prior_eps", 0.0))
     if eps <= 0.0:
-        return val, ei_sel
+        return val, ei_sel, jnp.zeros((), bool)
     xp = draw(jax.random.fold_in(key, 0x9B10B))
     ei_p = score(xp[None])[0]
     take = jax.random.uniform(jax.random.fold_in(key, 0xE9510), ()) < eps
-    return jnp.where(take, xp, val), jnp.where(take, ei_p, ei_sel)
+    return jnp.where(take, xp, val), jnp.where(take, ei_p, ei_sel), take
+
+
+def _diag_stats(samples, ei, ei_sel, wb, below_mask, prior_mass, LF, take,
+                discrete=False):
+    """Per-label HEALTH_STATS vector (obs/health.py sym: HEALTH_STATS) —
+    EI quantiles, selected-candidate EI rank, duplicate-candidate rate,
+    posterior shape (effective component count, prior-mass fraction) and
+    the ε-prior take flag.
+
+    Pure post-processing of arrays the proposal already computed: consumes
+    NO RNG and leaves the selected value untouched, so the diagnostics
+    variant of a kernel proposes bit-identically to the plain one
+    (tests/test_health.py pins armed == disarmed trial sequences).
+
+    ``wb``: the below model's normalized component weights (mixture
+    components for numeric labels, posterior bucket probabilities for
+    discrete ones); ``prior_mass``: the prior's unnormalized pseudocount
+    mass (``prior_weight`` numeric, ``K * prior_weight`` discrete).
+    """
+    n = ei.shape[0]
+    s = jnp.sort(ei)
+
+    def q(p):
+        return s[min(n - 1, int(round(p * (n - 1))))]
+
+    sel_rank = jnp.sum(ei > ei_sel).astype(jnp.float32)
+    if n > 1:
+        sv = jnp.sort(samples.astype(jnp.float32))
+        gaps = sv[1:] - sv[:-1]
+        if discrete:
+            dup = jnp.sum(gaps == 0.0) / (n - 1)
+        else:
+            scale = jnp.maximum(sv[-1] - sv[0], EPS)
+            dup = jnp.sum(gaps <= 1e-6 * scale) / (n - 1)
+    else:
+        dup = jnp.float32(0.0)
+    eff = 1.0 / jnp.maximum(jnp.sum(wb * wb), EPS)
+    obs_mass = jnp.sum(linear_forgetting_weights(below_mask, LF))
+    pm = jnp.float32(prior_mass)
+    prior_frac = pm / jnp.maximum(obs_mass + pm, EPS)
+    return jnp.stack([
+        q(0.10), q(0.50), q(0.90), s[-1],
+        sel_rank, dup.astype(jnp.float32), eff.astype(jnp.float32),
+        prior_frac, take.astype(jnp.float32),
+    ])
 
 
 def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
@@ -507,11 +556,14 @@ def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
     return x
 
 
-def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
+def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg,
+                     diag=False):
     """Sample candidates from the below model, score EI = llik_below −
     llik_above, return ``(selected candidate, its EI)`` (tpe.py sym:
     broadcast_best; selection policy: ``_select_candidate``).  The EI score
-    is what cross-shard argmax reductions consume (parallel/sharding.py)."""
+    is what cross-shard argmax reductions consume (parallel/sharding.py).
+    ``diag=True`` appends the per-label health stats vector
+    (``_diag_stats``) — same proposal, one extra output."""
     prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(dist)
     obs = vals
     if log_space:
@@ -538,13 +590,18 @@ def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg):
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)  # -inf − -inf must never win
     val, ei_sel = _select_candidate(key, samples, ei, cfg)
     lpdf = lgmm1_lpdf if log_space else gmm1_lpdf
-    return _mix_prior(
+    out, ei_out, take = _mix_prior(
         key, cfg, val, ei_sel,
         lambda kp: _prior_draw_numeric(kp, prior_mu, prior_sigma, low, high,
                                        q, log_space),
         lambda xs: (lpdf(xs, wb, mb, sb, low, high, q)
                     - lpdf(xs, wa, ma, sa, low, high, q)),
     )
+    if not diag:
+        return out, ei_out
+    stats = _diag_stats(samples, ei, ei_sel, wb, below_mask,
+                        cfg["prior_weight"], cfg["LF"], take)
+    return out, ei_out, stats
 
 
 def _gmm1_sample_bounded(key, weights, mus, sigmas, low, high, n_samples):
@@ -668,7 +725,7 @@ def _q_lpdf_group(x, weights, mus, sigmas, lo, hi, q, islog, bounded,
 
 
 def _propose_numeric_group(keys, obs, below, above, statics, cfg,
-                           quantized, bounded, has_log=True):
+                           quantized, bounded, has_log=True, diag=False):
     """One vmapped proposal pipeline for a whole GROUP of numeric labels
     sharing a (quantized?, bounded?) shape.
 
@@ -742,10 +799,14 @@ def _propose_numeric_group(keys, obs, below, above, statics, cfg,
                 zp = pmu + psig * jax.random.normal(kp, ())
             return jnp.round(to_value(zp) / q) * q if quantized else zp
 
-        val, ei_sel = _mix_prior(key, cfg, val, ei_sel, draw, score)
+        val, ei_out, take = _mix_prior(key, cfg, val, ei_sel, draw, score)
         if not quantized:
             val = to_value(val)
-        return val, ei_sel
+        if not diag:
+            return val, ei_out
+        stats = _diag_stats(sel, ei, ei_sel, wb, b_l, cfg["prior_weight"],
+                            cfg["LF"], take)
+        return val, ei_out, stats
 
     return jax.vmap(one)(
         keys, obs, below, above,
@@ -754,7 +815,8 @@ def _propose_numeric_group(keys, obs, below, above, statics, cfg,
     )
 
 
-def _propose_discrete_group(keys, obs, below, above, prior_ps, offsets, cfg):
+def _propose_discrete_group(keys, obs, below, above, prior_ps, offsets, cfg,
+                            diag=False):
     """Vmapped ``_propose_discrete`` for a GROUP of discrete labels sharing
     one bucket count K (the static shape); prior probabilities and randint
     offsets ride the label axis as traced statics."""
@@ -779,7 +841,7 @@ def _propose_discrete_group(keys, obs, below, above, prior_ps, offsets, cfg):
         ei = logs[:, 0] - logs[:, 1]
         ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
         val, ei_sel = _select_candidate(key, samples, ei, cfg)
-        val, ei_sel = _mix_prior(
+        val, ei_out, take = _mix_prior(
             key, cfg, val, ei_sel,
             functools.partial(_prior_draw_discrete, prior_p=prior_p),
             lambda xs: ((xs[:, None] == jnp.arange(K)[None, :]).astype(
@@ -787,7 +849,12 @@ def _propose_discrete_group(keys, obs, below, above, prior_ps, offsets, cfg):
                 @ (jnp.log(jnp.maximum(pb, EPS))
                    - jnp.log(jnp.maximum(pa, EPS)))),
         )
-        return val + offset, ei_sel
+        if not diag:
+            return val + offset, ei_out
+        stats = _diag_stats(samples, ei, ei_sel, pb, b_l,
+                            K * cfg["prior_weight"], cfg["LF"], take,
+                            discrete=True)
+        return val + offset, ei_out, stats
 
     return jax.vmap(one)(keys, obs, below, above, prior_ps, offsets)
 
@@ -801,7 +868,8 @@ def _prior_draw_discrete(kp, prior_p):
     return jnp.minimum(jnp.sum(up > cdfp), K - 1)
 
 
-def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
+def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg,
+                      diag=False):
     prior_p = jnp.asarray(_prior_probs(dist))
     offset = 0
     if dist.family == "randint":
@@ -831,18 +899,32 @@ def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg):
     ei = logs[:, 0] - logs[:, 1]
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
     val, ei_sel = _select_candidate(key, samples, ei, cfg)
-    val, ei_sel = _mix_prior(
+    val, ei_out, take = _mix_prior(
         key, cfg, val, ei_sel,
         functools.partial(_prior_draw_discrete, prior_p=prior_p),
         lambda xs: ((xs[:, None] == jnp.arange(K)[None, :]).astype(jnp.float32)
                     @ (jnp.log(jnp.maximum(pb, EPS))
                        - jnp.log(jnp.maximum(pa, EPS)))),
     )
-    return val + offset, ei_sel
+    if not diag:
+        return val + offset, ei_out
+    stats = _diag_stats(samples, ei, ei_sel, pb, below_mask,
+                        prior_p.shape[0] * cfg["prior_weight"], cfg["LF"],
+                        take, discrete=True)
+    return val + offset, ei_out, stats
 
 
-def build_propose_with_scores(cs, cfg, group=True):
+def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
     """Compile one proposal step returning per-label ``(value, EI score)``.
+
+    ``diagnostics=True`` builds the health-instrumented variant:
+    ``propose(history, key) -> (out, diag)`` where ``diag`` carries the
+    per-label HEALTH_STATS vectors plus the below/above split sizes (see
+    ``_diag_stats`` / obs/health.py).  Proposals are bit-identical to the
+    plain variant — the diagnostics are pure post-processing, no extra RNG
+    — but the traced program differs, so armed and disarmed asks live
+    under separate jit cache keys and the disarmed hot path never pays
+    for the instrumentation.
 
     The EI scores feed cross-shard argmax reductions
     (``parallel/sharding.py``); ``build_propose`` below drops them for the
@@ -908,6 +990,7 @@ def build_propose_with_scores(cs, cfg, group=True):
         has_loss = jnp.asarray(history["has_loss"])
         below, above = split_below_above(losses, has_loss, cfg["gamma"], cfg["LF"])
         out = {}
+        stats = {}
 
         def stacked(ls):
             keys = jnp.stack([
@@ -918,15 +1001,20 @@ def build_propose_with_scores(cs, cfg, group=True):
             return keys, obs, below[None, :] & act, above[None, :] & act
 
         for ls, quantized, bounded, has_log, statics in numeric_groups:
-            vals_g, eis_g = _propose_numeric_group(
-                *stacked(ls), statics, cfg, quantized, bounded, has_log)
+            res = _propose_numeric_group(
+                *stacked(ls), statics, cfg, quantized, bounded, has_log,
+                diag=diagnostics)
             for i, l in enumerate(ls):
-                out[l] = (vals_g[i], eis_g[i])
+                out[l] = (res[0][i], res[1][i])
+                if diagnostics:
+                    stats[l] = res[2][i]
         for ls, prior_ps, offsets in disc_groups:
-            vals_g, eis_g = _propose_discrete_group(
-                *stacked(ls), prior_ps, offsets, cfg)
+            res = _propose_discrete_group(
+                *stacked(ls), prior_ps, offsets, cfg, diag=diagnostics)
             for i, l in enumerate(ls):
-                out[l] = (vals_g[i], eis_g[i])
+                out[l] = (res[0][i], res[1][i])
+                if diagnostics:
+                    stats[l] = res[2][i]
         for label in cs.labels:
             if label in grouped:
                 continue
@@ -937,9 +1025,18 @@ def build_propose_with_scores(cs, cfg, group=True):
             b = below & active
             a = above & active
             if info.dist.family in ("categorical", "randint"):
-                out[label] = _propose_discrete(k, info.dist, vals, b, a, cfg)
+                res = _propose_discrete(k, info.dist, vals, b, a, cfg,
+                                        diag=diagnostics)
             else:
-                out[label] = _propose_numeric(k, info.dist, vals, b, a, cfg)
+                res = _propose_numeric(k, info.dist, vals, b, a, cfg,
+                                       diag=diagnostics)
+            out[label] = res[:2] if diagnostics else res
+            if diagnostics:
+                stats[label] = res[2]
+        if diagnostics:
+            return out, {"stats": stats,
+                         "n_below": jnp.sum(below).astype(jnp.int32),
+                         "n_above": jnp.sum(above).astype(jnp.int32)}
         return out
 
     return propose
@@ -993,7 +1090,7 @@ def _apply_rows(labels, history, rows):
     }
 
 
-def _get_suggest_jit(domain, cfg_key, cfg):
+def _get_suggest_jit(domain, cfg_key, cfg, diag=False):
     """The fused tell+ask program:
     ``run(history, rows, seed_words[2], ids[B]) -> (history', packed[B, L])``.
 
@@ -1003,21 +1100,49 @@ def _get_suggest_jit(domain, cfg_key, cfg):
     ``PRNGKey``/``fold_in`` calls are each their own device dispatch, and on
     a tunneled accelerator every extra program costs tens of ms of
     completion latency (the round-2 interactive-loop bottleneck).
+
+    ``diag=True`` (an armed obs run) compiles the health-instrumented
+    variant under its OWN cache key, additionally returning the packed
+    per-label stats ``[B, L, |HEALTH_STATS|]`` and split sizes ``[B, 2]``.
+    The disarmed key and program are byte-identical to the plain build, so
+    arming a run never perturbs an unarmed run's cache or hot path.
     """
     cs = domain.cs
-    key = (cs.signature(), cfg_key)
+    key = ((cs.signature(), cfg_key, "health") if diag
+           else (cs.signature(), cfg_key))
     fn = _suggest_jit_cache.get(key)
     if fn is None:
-        propose = build_propose(cs, cfg)
+        if diag:
+            scored = build_propose_with_scores(cs, cfg, diagnostics=True)
 
-        def run(history, rows, seed_words, ids):
-            hist = _apply_rows(cs.labels, history, rows)
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(seed_words[0]), seed_words[1]
-            )
-            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
-            out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
-            return hist, rand.pack_labels(cs, out)
+            def propose_diag(history, k):
+                out, d = scored(history, k)
+                vals = {l: v for l, (v, _) in out.items()}
+                stats = jnp.stack([d["stats"][l] for l in cs.labels])
+                split = jnp.stack([d["n_below"], d["n_above"]])
+                return vals, stats, split
+
+            def run(history, rows, seed_words, ids):
+                hist = _apply_rows(cs.labels, history, rows)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed_words[0]), seed_words[1]
+                )
+                keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+                vals, stats, splits = jax.vmap(
+                    propose_diag, in_axes=(None, 0))(hist, keys)
+                return hist, rand.pack_labels(cs, vals), stats, splits
+
+        else:
+            propose = build_propose(cs, cfg)
+
+            def run(history, rows, seed_words, ids):
+                hist = _apply_rows(cs.labels, history, rows)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seed_words[0]), seed_words[1]
+                )
+                keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(ids)
+                out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
+                return hist, rand.pack_labels(cs, out)
 
         fn = jax.jit(run)
         _suggest_jit_cache.put(key, fn)
@@ -1087,8 +1212,27 @@ def suggest(
     # ids pad to a power-of-two bucket (extras discarded on host) so the
     # program shape — and hence the XLA compile — is stable across queue
     # ramp-up/drain batch sizes.
-    run = _get_suggest_jit(domain, cfg_key, cfg)
-    new_dev, mat = run(dev, rows, _seed_words(seed), rand.pad_ids_sticky(domain, new_ids))
+    #
+    # An armed obs run (FMinIter sets trials.obs_health when its sink is
+    # live) runs the health-instrumented variant instead: same proposals,
+    # plus a small diagnostics buffer fetched alongside the packed values.
+    # Disarmed runs take the plain branch — same cache key, same program,
+    # same single readback as before the health layer existed.
+    health = getattr(trials, "obs_health", None)
+    run = _get_suggest_jit(domain, cfg_key, cfg, diag=health is not None)
+    ids = rand.pad_ids_sticky(domain, new_ids)
+    if health is None:
+        new_dev, mat = run(dev, rows, _seed_words(seed), ids)
+    else:
+        from ..obs import health as _health_mod
+
+        _health_mod.capture_jit_cost(run, (dev, rows, _seed_words(seed), ids),
+                                     "suggest.tpe")
+        new_dev, mat, stats, splits = run(dev, rows, _seed_words(seed), ids)
+        _health_mod.record_tpe_health(
+            health, domain.cs.labels,
+            np.asarray(stats)[: len(new_ids)],
+            np.asarray(splits)[: len(new_ids)])
     ph.commit_device(new_dev)
     flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
     return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)

@@ -705,6 +705,10 @@ class Trials:
         state["_history"] = None
         state["_history_synced"] = 0
         state["_history_pending"] = []
+        # the live obs bundle FMinIter hands the suggesters (tracer locks,
+        # open sink) is a per-run handle, not run state: drop it from
+        # checkpoints; fmin re-installs one on resume
+        state.pop("obs_health", None)
         attachments = dict(state.get("attachments", {}))
         dom = attachments.get("FMinIter_Domain")
         if dom is not None and not isinstance(dom, (bytes, bytearray)):

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from .algos import tpe
 from .base import trials_from_flat_history
 from .obs import get_metrics
+from .obs.health import record_program_cost
 from .utils import LRUCache
 from .spaces import compile_space, draw_dist, label_hash
 
@@ -56,9 +57,13 @@ def _record_cache_stats():
 
 def _aot_compile(holder, args, hist_name, obs=None):
     """Fill ``holder["compiled"]`` with the AOT executable for ``args``,
-    recording compile wall time under ``hist_name``.  Falls back to the
-    jitted callable (compile time then folds into the first execute) on
-    backends where AOT lowering is unavailable."""
+    recording compile wall time under ``hist_name`` and the program's
+    static FLOP/byte cost under ``<stage>.flops`` / ``<stage>.bytes``
+    (obs/health.py joins those with the execute spans into achieved-FLOP/s
+    and busy fraction — reading ``cost_analysis()`` is free XLA metadata,
+    no device sync).  Falls back to the jitted callable (compile time then
+    folds into the first execute) on backends where AOT lowering is
+    unavailable."""
     span = (obs.span("device.compile", aggregate=False)
             if obs is not None else None)
     t0 = time.perf_counter()
@@ -71,6 +76,8 @@ def _aot_compile(holder, args, hist_name, obs=None):
     except Exception:  # pragma: no cover - backend-dependent AOT support
         _METRICS.counter("aot_fallbacks").inc()
         compiled = holder["jit"]
+    else:
+        record_program_cost(hist_name.split(".")[0], compiled, _METRICS)
     _METRICS.histogram(hist_name).observe(time.perf_counter() - t0)
     holder["compiled"] = compiled
     return compiled

@@ -190,6 +190,13 @@ class FMinIter:
         self.obs = obs_mod.RunObs.resolve(obs, totals=trials.phase_timings)
         trials.obs_run_id = self.obs.run_id
         trials.obs_metrics = self.obs.metrics  # direct post-run handle
+        # armed runs hand the bundle to the suggesters through the trials
+        # object (the suggest plugin signature has no obs channel): tpe
+        # switches to its health-instrumented kernel, rand/anneal record
+        # the cheap dup-rate/spread subset.  None when disarmed — the hot
+        # path then pays exactly one getattr per suggest call.  Dropped on
+        # pickle (base.Trials.__getstate__); re-set here on every resume.
+        trials.obs_health = self.obs if self.obs.sink is not None else None
 
         if self.asynchronous:
             if "FMinIter_Domain" not in trials.attachments:
@@ -292,6 +299,9 @@ class FMinIter:
         return self.obs.profiler_ctx()
 
     def run(self, N, block_until_done=True):
+        # iterator-protocol re-entry after a finish(): re-adopt this run's
+        # metrics namespace so resumed runs don't drop their counters
+        self.obs.rearm()
         with self._profiler_ctx():
             with self.obs.span("run", aggregate=False,
                                N=N if N != float("inf") else "inf",
@@ -436,7 +446,8 @@ class FMinIter:
                         logger.info("Early stop triggered")
                         stopped = True
                 if np.isfinite(best_loss):
-                    progress_ctx.postfix = f"best loss: {best_loss:.6g}"
+                    progress_ctx.postfix = progress_mod.format_postfix(
+                        best_loss, self.obs)
                 progress_ctx.update(k)
                 if (self.timeout is not None
                         and time.time() - self.start_time >= self.timeout):
@@ -546,7 +557,10 @@ class FMinIter:
                     new_best = min(ok_losses)
                     if new_best < best_loss:
                         best_loss = new_best
-                    progress_ctx.postfix = f"best loss: {best_loss:.6g}"
+                    # armed runs append live search health (EI p50, dup
+                    # rate) next to the best loss
+                    progress_ctx.postfix = progress_mod.format_postfix(
+                        best_loss, self.obs)
                 n_done_now = get_n_done()
                 progress_ctx.update(n_done_now - n_reported)
                 n_reported = n_done_now

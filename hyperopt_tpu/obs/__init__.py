@@ -28,7 +28,7 @@ import os
 
 from . import events as events_mod
 from .events import EventLog
-from .metrics import MetricsRegistry, get_metrics, reset_metrics
+from .metrics import MetricsRegistry, adopt_metrics, get_metrics, reset_metrics
 from .trace import JsonlSink, PhaseTimings, Tracer, read_jsonl
 
 __all__ = [
@@ -41,6 +41,7 @@ __all__ = [
     "MetricsRegistry",
     "get_metrics",
     "reset_metrics",
+    "adopt_metrics",
     "read_jsonl",
 ]
 
@@ -181,10 +182,27 @@ class RunObs:
         run is re-entered — iterator-protocol fmin), and release this run's
         namespace from the global registry table so a long-lived sweep
         process doesn't grow it without bound.  ``self.metrics`` stays
-        alive for anyone holding the bundle; idempotent."""
+        alive for anyone holding the bundle; idempotent.  A run re-entered
+        after a finish (``for trials in FMinIter(...)``) must :meth:`rearm`
+        first, or anything resolving the namespace by run id would get a
+        fresh empty registry while the bundle keeps counting into this
+        one."""
         if self.sink is not None:
             self.sink.write({"kind": "metrics", "run_id": self.run_id,
                              "snapshot": self.snapshot()})
             self.sink.close()
         reset_metrics(self.run_id)
         self._finished = True
+
+    def rearm(self):
+        """Re-enter a finished run: re-register this bundle's OWN metrics
+        registry — accumulated counters and all — under the run id
+        (``finish()`` released the namespace; without the explicit re-adopt
+        a resumed iterator-protocol ``FMinIter`` would silently split its
+        counters between this object and a fresh registry created by the
+        next ``get_metrics(run_id)`` caller).  The JSONL sink needs no
+        re-arm: it reopens in append mode on the next write.  No-op while
+        the run is live; ``FMinIter.run()`` calls this at every entry."""
+        if self._finished:
+            adopt_metrics(self.run_id, self.metrics)
+            self._finished = False

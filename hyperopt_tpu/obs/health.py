@@ -1,0 +1,334 @@
+"""Search-health diagnostics and device-utilization accounting.
+
+Fourth pillar of the run-telemetry layer, answering the two questions the
+span/metric/event pillars cannot: *is the optimizer actually searching
+well*, and *how hard is the hardware actually working*.
+
+**Search health.**  A TPE run that degenerates into prior sampling or
+duplicate candidates looks identical to a healthy one until the final
+loss.  When a run is armed (``fmin(..., obs="run.jsonl")``), the TPE
+suggest kernel returns a small auxiliary diagnostics buffer per ask —
+EI-score quantiles, the selected candidate's EI rank, duplicate-candidate
+rate, below/above split sizes, per-param posterior shape (effective
+mixture-component count, prior-mass fraction) and the ε-prior fallback
+flag (``tpe._mix_prior``) — which :func:`record_tpe_health` folds into the
+run's metrics namespace and JSONL stream.  ``rand``/``anneal`` proposals
+get the cheap subset (duplicate rate + proposal spread across the batch)
+via :func:`record_proposal_health`, computed host-side from values already
+fetched — zero extra device work.  Disarmed runs pay exactly one
+``getattr`` per suggest call: the diagnostics variant of the kernel is a
+*separate* jit cache entry, so the hot path neither recompiles nor fetches
+an extra buffer (tests/test_health.py pins this).
+
+What "healthy" looks like (docs/DESIGN.md §9 for the full reading guide):
+
+* ``ei_p50`` drifting *upward* over asks — the below-model keeps finding
+  regions the above-model considers unlikely.  A flat ~0 trend means the
+  two models agree everywhere: the posterior has collapsed to the prior.
+* ``dup_rate`` near 0 — candidates are distinct.  A rising dup rate means
+  the below-model has concentrated into near-point masses (or a quantized
+  param has saturated its grid) and extra candidates buy nothing.
+* ``sel_rank`` 0 under argmax selection; small-but-nonzero under softmax
+  (that is the batch-diversity mechanism working, not a bug).
+* ``prior_mass_frac`` decaying toward ``1/(n_below+1)`` as evidence
+  accumulates; pinned near 1 means the split has too few points to matter.
+* ``prior_takes`` tracking ``prior_eps`` × proposals — much higher means
+  EI is being out-competed by its own exploration floor.
+
+**Device utilization.**  :func:`record_program_cost` captures a compiled
+program's static FLOP/byte cost (``Compiled.cost_analysis()``) into the
+process-global ``"device"`` metrics namespace at AOT-compile time
+(``device_fmin._aot_compile``; :func:`capture_jit_cost` does the same for
+jit-only call sites, armed runs only — it pays one extra lowering).
+:func:`utilization_snapshot` joins those costs with the measured execute
+spans into achieved FLOP/s, arithmetic intensity and device-busy fraction;
+``bench.py`` attaches the result to stage results and the headline JSON
+line.
+
+**Multi-controller merge.**  :func:`controller_stream_path` names the
+per-controller JSONL streams ``fmin_multihost`` writes (one per process,
+run_id tagged ``-p<index>``); ``python -m hyperopt_tpu.obs.report --merge
+a.jsonl b.jsonl`` renders them as one cross-controller view (allgather
+skew, per-controller phase breakdown, divergence-context correlation).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .metrics import get_metrics
+
+__all__ = [
+    "HEALTH_STATS",
+    "record_tpe_health",
+    "record_proposal_health",
+    "live_health_postfix",
+    "cost_analysis_summary",
+    "record_program_cost",
+    "capture_jit_cost",
+    "utilization_snapshot",
+    "controller_stream_path",
+]
+
+#: order of the per-label stat vector the TPE diagnostics kernel packs
+#: (algos/tpe.py sym: _diag_stats) — the contract between device and host.
+HEALTH_STATS = (
+    "ei_p10",
+    "ei_p50",
+    "ei_p90",
+    "ei_max",
+    "sel_rank",
+    "dup_rate",
+    "eff_components",
+    "prior_mass_frac",
+    "prior_take",
+)
+
+_IDX = {name: i for i, name in enumerate(HEALTH_STATS)}
+
+# summary stats carried per-label in the JSONL health record (the full
+# 9-vector per label per ask would bloat the stream for wide spaces)
+_LABEL_STATS = ("ei_p50", "dup_rate", "eff_components", "prior_mass_frac")
+
+
+def _finite_mean(a, axis=None):
+    """Mean over finite entries (EI quantiles can be -inf when every
+    candidate fell outside one model's support); 0.0 when none are."""
+    a = np.asarray(a, np.float64)
+    mask = np.isfinite(a)
+    n = mask.sum(axis=axis)
+    s = np.where(mask, a, 0.0).sum(axis=axis)
+    return np.where(n > 0, s / np.maximum(n, 1), 0.0)
+
+
+def record_tpe_health(obs, labels, stats, splits, algo="tpe"):
+    """Fold one armed TPE ask's diagnostics into metrics + JSONL.
+
+    ``stats``: ``[B, L, len(HEALTH_STATS)]`` host array (B proposals in the
+    ask, L labels); ``splits``: ``[B, 2]`` (n_below, n_above — identical
+    across the batch, every proposal saw the same history).
+    """
+    stats = np.asarray(stats, np.float64)
+    if stats.ndim != 3 or not stats.size:
+        return
+    B, L = stats.shape[0], stats.shape[1]
+    splits = np.asarray(splits).reshape(B, 2)
+    n_below, n_above = int(splits[0, 0]), int(splits[0, 1])
+
+    agg = _finite_mean(stats.reshape(-1, stats.shape[-1]), axis=0)  # [S]
+    lab = _finite_mean(stats, axis=0)                               # [L, S]
+    takes = int(np.nansum(stats[:, :, _IDX["prior_take"]]))
+
+    m = obs.metrics
+    m.counter("health.asks").inc()
+    m.counter("health.proposals").inc(B)
+    m.counter("health.prior_fallbacks").inc(takes)
+    for name in ("ei_p50", "sel_rank", "dup_rate", "eff_components",
+                 "prior_mass_frac"):
+        m.histogram(f"health.{name}").observe(float(agg[_IDX[name]]))
+    m.gauge("health.last_ei_p50").set(float(agg[_IDX["ei_p50"]]))
+    m.gauge("health.last_dup_rate").set(float(agg[_IDX["dup_rate"]]))
+    m.gauge("health.n_below").set(n_below)
+    m.gauge("health.n_above").set(n_above)
+
+    if obs.sink is None:
+        return
+    rec = {"kind": "health", "algo": algo, "ts": time.time(),
+           "run_id": obs.run_id, "n": B, "n_label_proposals": B * L,
+           "n_below": n_below, "n_above": n_above,
+           "prior_takes": takes}
+    for name in HEALTH_STATS:
+        if name != "prior_take":
+            rec[name] = float(agg[_IDX[name]])
+    rec["labels"] = {
+        l: {name: float(lab[j, _IDX[name]]) for name in _LABEL_STATS}
+        for j, l in enumerate(labels)
+    }
+    obs.sink.write(rec)
+
+
+def record_proposal_health(obs, algo, labels, flats):
+    """The cheap health subset for non-TPE suggesters (``rand``,
+    ``anneal``, any :class:`~hyperopt_tpu.algos.algobase.SuggestAlgo`):
+    per-label duplicate rate and proposal spread across one ask's batch.
+    Computed from the host-side flat samples the suggester already fetched
+    — no extra device work.  Callers skip batches of < 2 (both stats are
+    degenerate at width 1)."""
+    B = len(flats)
+    if B < 2:
+        return
+    per = {}
+    dups, spreads = [], []
+    for l in labels:
+        v = np.sort(np.asarray([f[l] for f in flats], np.float64))
+        scale = max(float(v[-1] - v[0]), 1e-12)
+        dup = float(np.mean(np.diff(v) <= 1e-6 * scale))
+        spread = float(np.std(v))
+        per[l] = {"dup_rate": dup, "spread": spread}
+        dups.append(dup)
+        spreads.append(spread)
+    dup_mean = float(np.mean(dups))
+    spread_mean = float(np.mean(spreads))
+
+    m = obs.metrics
+    m.counter("health.asks").inc()
+    m.counter("health.proposals").inc(B)
+    m.histogram("health.dup_rate").observe(dup_mean)
+    m.gauge("health.last_dup_rate").set(dup_mean)
+    if obs.sink is not None:
+        obs.sink.write({"kind": "health", "algo": algo, "ts": time.time(),
+                        "run_id": obs.run_id, "n": B,
+                        "dup_rate": dup_mean, "spread": spread_mean,
+                        "labels": per})
+
+
+def live_health_postfix(obs):
+    """Compact live-progress string ("EI p50 0.42  dup 3%") from the run's
+    latest health gauges, or None before the first armed ask."""
+    if obs is None:
+        return None
+    metrics = getattr(obs, "metrics", None)
+    if metrics is None:
+        return None
+    reg = metrics._metrics
+    asks = reg.get("health.asks")
+    if asks is None or not asks.value:
+        return None
+    parts = []
+    g = reg.get("health.last_ei_p50")
+    if g is not None:
+        parts.append(f"EI p50 {g.value:.3g}")
+    d = reg.get("health.last_dup_rate")
+    if d is not None:
+        parts.append(f"dup {d.value * 100:.0f}%")
+    return "  ".join(parts) or None
+
+
+# ---------------------------------------------------------------------------
+# device-utilization accounting (cost_analysis × execute spans)
+# ---------------------------------------------------------------------------
+
+
+def cost_analysis_summary(compiled):
+    """``{"flops", "bytes"}`` per dispatch from a compiled program's
+    ``cost_analysis()``, or None when the backend doesn't report one.
+    Static XLA metadata — reading it never syncs the device."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and nbytes <= 0.0:
+        return None
+    return {"flops": flops, "bytes": nbytes}
+
+
+def record_program_cost(name, compiled, metrics=None):
+    """Record a compiled program's per-dispatch FLOPs/bytes as
+    ``<name>.flops`` / ``<name>.bytes`` gauges (default: the process-global
+    ``"device"`` namespace, next to the ``<name>.execute_sec`` histograms
+    they join against)."""
+    cost = cost_analysis_summary(compiled)
+    if cost is None:
+        return None
+    reg = metrics if metrics is not None else get_metrics("device")
+    reg.gauge(f"{name}.flops").set(cost["flops"])
+    reg.gauge(f"{name}.bytes").set(cost["bytes"])
+    return cost
+
+
+# (id(jitted fn), name) pairs already captured: capture pays one extra
+# lowering+compile, so it must run once per program per process
+_cost_captured = set()
+
+
+def capture_jit_cost(fn, args, name, metrics=None):
+    """``record_program_cost`` for a plain ``jax.jit`` call site: lower +
+    AOT-compile once to read the cost table.  Armed runs only (the extra
+    compile is the cost of the measurement); no-op on repeat calls and on
+    backends without AOT support."""
+    key = (id(fn), name)
+    if key in _cost_captured:
+        return None
+    _cost_captured.add(key)
+    try:
+        compiled = fn.lower(*args).compile()
+    except Exception:
+        return None
+    return record_program_cost(name, compiled, metrics)
+
+
+def utilization_snapshot(wall_sec=None, stages=("chunk", "whole_run"),
+                         metrics=None):
+    """Join captured program costs with measured execute spans into
+    achieved FLOP/s, arithmetic intensity and (given the enclosing wall
+    clock) device-busy fraction.
+
+    ``execute_sec`` spans are wall clock around dispatch→readback, so
+    "busy fraction" is an *upper bound proxy*: the share of ``wall_sec``
+    spent inside device-program round trips (host dispatch overhead
+    included).  Honest enough to answer "was the run device-bound or
+    host-bound" from the artifacts alone.  Caveat: the ``"device"``
+    namespace is process-cumulative — in a process running several stages,
+    the execute totals cover every stage so far, and the clip keeps the
+    fraction sane rather than exact."""
+    reg = metrics if metrics is not None else get_metrics("device")
+    dev = reg.snapshot()["metrics"]
+    out = {}
+    busy_total = 0.0
+    for st in stages:
+        fl = dev.get(f"{st}.flops")
+        ex = dev.get(f"{st}.execute_sec")
+        if fl is None or not isinstance(ex, dict) or not ex.get("count"):
+            continue
+        by = dev.get(f"{st}.bytes") or 0.0
+        sec, n = float(ex["sum"]), int(ex["count"])
+        busy_total += sec
+        entry = {
+            "flops_per_dispatch": fl,
+            "bytes_per_dispatch": by,
+            "dispatches": n,
+            "execute_sec_total": sec,
+            "achieved_flops_per_sec": (fl * n / sec) if sec > 0 else 0.0,
+            "arithmetic_intensity": (fl / by) if by else None,
+        }
+        if wall_sec:
+            entry["busy_fraction"] = min(1.0, sec / wall_sec)
+        out[st] = entry
+    if out and wall_sec:
+        out["device_busy_fraction"] = min(1.0, busy_total / wall_sec)
+    # programs with a captured cost but no execute-span pair (the armed
+    # suggest kernels — their execute time lives in phase_timings, not the
+    # device namespace): report the static costs so every captured gauge
+    # has a reader
+    costs = {}
+    for name, v in dev.items():
+        if name.endswith(".flops"):
+            st = name[: -len(".flops")]
+            if st not in out:
+                costs[st] = {"flops_per_dispatch": v,
+                             "bytes_per_dispatch": dev.get(f"{st}.bytes", 0.0)}
+    if costs:
+        out["program_costs"] = costs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# multi-controller streams
+# ---------------------------------------------------------------------------
+
+
+def controller_stream_path(path, process_index):
+    """Per-controller JSONL path for a multi-process run: ``run.jsonl`` →
+    ``run.p<i>.jsonl`` (every controller writes its own stream; merge them
+    with ``python -m hyperopt_tpu.obs.report --merge run.p0.jsonl ...``)."""
+    root, ext = os.path.splitext(str(path))
+    return f"{root}.p{int(process_index)}{ext or '.jsonl'}"

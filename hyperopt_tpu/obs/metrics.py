@@ -26,6 +26,7 @@ __all__ = [
     "MetricsRegistry",
     "get_metrics",
     "reset_metrics",
+    "adopt_metrics",
     "all_namespaces",
 ]
 
@@ -167,6 +168,19 @@ def reset_metrics(namespace=None):
             _REGISTRIES.clear()
         else:
             _REGISTRIES.pop(namespace, None)
+
+
+def adopt_metrics(namespace, registry):
+    """(Re-)install ``registry`` as the process-global registry for
+    ``namespace``, replacing any registry created in the meantime.  This is
+    how ``RunObs.rearm()`` re-enters a finished run: ``finish()`` released
+    the namespace from the table, but the run's own registry object — with
+    its accumulated counters — stays alive on the bundle, and a resumed run
+    must keep counting into IT, not into a fresh empty namespace that
+    happens to share the run id."""
+    with _REG_LOCK:
+        _REGISTRIES[namespace] = registry
+    return registry
 
 
 def all_namespaces():

@@ -3,24 +3,36 @@
 Usage::
 
     python -m hyperopt_tpu.obs.report run.jsonl [--top 5]
+    python -m hyperopt_tpu.obs.report --merge run.p0.jsonl run.p1.jsonl ...
 
-Three sections, matching the three pillars:
+Single-stream sections, matching the telemetry pillars:
 
 1. **Phase-time breakdown** — spans aggregated by name: where the run's
    wall clock (and host CPU) actually went, with a share bar.
-2. **Trial-state waterfall** — lifecycle events rolled into per-trial
+2. **Search health** — the optimizer's own vitals from the armed TPE /
+   rand / anneal suggest paths (obs/health.py): EI-quantile and dup-rate
+   trends, prior-fallback sparkline, below/above split, per-param
+   posterior shape.
+3. **Trial-state waterfall** — lifecycle events rolled into per-trial
    timelines: counts per transition, queue latency (new→claimed) and run
    latency (claimed→finished) distributions.
-3. **Top-k slowest trials** — the individual post-mortem targets.
+4. **Top-k slowest trials** — the individual post-mortem targets.
 
 Plus the final metrics snapshot(s) embedded in the stream (compile vs
-execute split, cache hit rates, queue gauges).
+execute split, cache hit rates, queue gauges, device FLOP/byte costs).
+
+``--merge`` treats the inputs as the per-controller streams one
+``fmin_multihost`` run wrote (``parallel/driver.py`` names them
+``<path>.p<i>.jsonl``) and renders the cross-controller view instead:
+per-controller summary + phase breakdown, allgather-latency skew, and
+correlated divergence context.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from .events import (
@@ -32,7 +44,7 @@ from .events import (
 )
 from .trace import read_jsonl
 
-__all__ = ["main", "render"]
+__all__ = ["main", "render", "render_merged"]
 
 _BAR_W = 30
 
@@ -50,6 +62,27 @@ def _fmt_sec(s):
     if s < 1.0:
         return f"{s * 1e3:.1f}ms"
     return f"{s:.2f}s"
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width=24):
+    """ASCII-art trend line; downsamples evenly to ``width`` points."""
+    import math
+
+    vals = [v for v in values if v is not None and math.isfinite(v)]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = (len(vals) - 1) / (width - 1)
+        vals = [vals[int(round(i * step))] for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    rng = (hi - lo) or 1.0
+    return "".join(
+        _SPARK_BLOCKS[int((v - lo) / rng * (len(_SPARK_BLOCKS) - 1) + 0.5)]
+        for v in vals
+    )
 
 
 def _phase_section(spans, out):
@@ -155,6 +188,53 @@ def _slowest_section(trial_events, out, top=5):
         out.append(f"  tid {tid:>6}  {_fmt_sec(sec):>9}  status={status}")
 
 
+def _health_section(health_recs, out):
+    """Search-health vitals (obs/health.py record schema): trends over the
+    run's asks, last-ask posterior shape per param."""
+    if not health_recs:
+        out.append("  (no health records — arm the run with obs=<path> and "
+                   "a tpe/rand/anneal suggester)")
+        return
+    by_algo = {}
+    for r in health_recs:
+        by_algo[r.get("algo", "?")] = by_algo.get(r.get("algo", "?"), 0) + 1
+    out.append("  asks: " + "  ".join(
+        f"{a}={n}" for a, n in sorted(by_algo.items())))
+    tpe = [r for r in health_recs if "ei_p50" in r]
+    if tpe:
+        ei = [r["ei_p50"] for r in tpe]
+        out.append(f"  EI p50        first {ei[0]:+.3g}  last {ei[-1]:+.3g}"
+                   f"  {_spark(ei)}")
+        sel = [r.get("sel_rank", 0.0) for r in tpe]
+        out.append(f"  EI sel rank   mean {sum(sel) / len(sel):.2f}"
+                   "  (0 = pure argmax)")
+    dups = [r["dup_rate"] for r in health_recs if "dup_rate" in r]
+    if dups:
+        out.append(f"  dup rate      first {dups[0]:.1%}  last {dups[-1]:.1%}"
+                   f"  {_spark(dups)}")
+    spreads = [r["spread"] for r in health_recs if "spread" in r]
+    if spreads:
+        out.append(f"  spread        last {spreads[-1]:.3g}  {_spark(spreads)}"
+                   "  (rand/anneal proposal std)")
+    if tpe:
+        takes = [r.get("prior_takes", 0) for r in tpe]
+        total = sum(r.get("n_label_proposals", 0) for r in tpe)
+        out.append(f"  prior fallback  {sum(takes)}/{total} label-proposals"
+                   f"  {_spark(takes)}")
+        last = tpe[-1]
+        out.append(f"  below/above split (last ask): "
+                   f"{last.get('n_below', '?')}/{last.get('n_above', '?')}")
+        labels = last.get("labels") or {}
+        if labels:
+            w = max(len(l) for l in labels)
+            out.append("  per-param (last ask):")
+            for l, st in sorted(labels.items()):
+                out.append(
+                    f"    {l:<{w}}  eff_comp {st.get('eff_components', 0):.1f}"
+                    f"  prior_mass {st.get('prior_mass_frac', 0):.2f}"
+                    f"  dup {st.get('dup_rate', 0):.1%}")
+
+
 def _metrics_section(metric_recs, out):
     if not metric_recs:
         out.append("  (no metrics snapshot in stream)")
@@ -171,11 +251,15 @@ def render(records, top=5):
     spans = [r for r in records if r.get("kind") == "span"]
     trial_events = [r for r in records if r.get("kind") == "trial_event"]
     metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    health_recs = [r for r in records if r.get("kind") == "health"]
     events = [r for r in records if r.get("kind") == "event"]
 
     out = []
     out.append("== phase-time breakdown " + "=" * 40)
     _phase_section(spans, out)
+    out.append("")
+    out.append("== search health " + "=" * 47)
+    _health_section(health_recs, out)
     out.append("")
     out.append("== trial-state waterfall " + "=" * 39)
     _waterfall_section(trial_events, out)
@@ -194,24 +278,150 @@ def render(records, top=5):
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# cross-controller merge view (fmin_multihost per-process streams)
+# ---------------------------------------------------------------------------
+
+# the driver's allgather latency histograms, in schedule order — the merge
+# view's skew table compares their per-controller means
+_ALLGATHER_METRICS = (
+    "allgather.resume_sec",
+    "allgather.proposals_sec",
+    "allgather.losses_sec",
+    "allgather.checksum_sec",
+)
+
+_DIVERGENCE_EVENTS = ("controller_divergence", "resume_disagreement")
+
+
+def _last_snapshot_metrics(records):
+    metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    if not metric_recs:
+        return {}
+    return (metric_recs[-1].get("snapshot") or {}).get("metrics", {})
+
+
+def _controller_summary(name, records):
+    spans = [r for r in records if r.get("kind") == "span"]
+    metrics = _last_snapshot_metrics(records)
+    run_ids = sorted({r["run_id"] for r in records if r.get("run_id")})
+    ts = [r["ts"] for r in records if "ts" in r]
+    return {
+        "name": name,
+        "run_ids": run_ids,
+        "spans": spans,
+        "metrics": metrics,
+        "generations": metrics.get("generations"),
+        "t0": min(ts) if ts else None,
+        "t1": max(ts) if ts else None,
+        "events": [r for r in records if r.get("kind") == "event"
+                   and r.get("name") in _DIVERGENCE_EVENTS],
+    }
+
+
+def render_merged(streams):
+    """Cross-controller view over per-controller JSONL streams from one
+    ``fmin_multihost`` run: summary + allgather skew + per-controller
+    phase breakdown + correlated divergence context.  ``streams`` is a
+    list of ``(name, records)``."""
+    ctrls = [_controller_summary(name, recs) for name, recs in streams]
+    out = []
+
+    out.append("== controllers " + "=" * 49)
+    w = max(len(c["name"]) for c in ctrls)
+    for c in ctrls:
+        gens = c["generations"]
+        wall = (c["t1"] - c["t0"]) if c["t0"] is not None else None
+        out.append(
+            f"  {c['name']:<{w}}  run_id={','.join(c['run_ids']) or '?'}"
+            f"  gens={gens if gens is not None else '?'}"
+            f"  spans={len(c['spans'])}  wall={_fmt_sec(wall)}")
+
+    out.append("")
+    out.append("== allgather skew " + "=" * 46)
+    any_row = False
+    for metric in _ALLGATHER_METRICS:
+        means = {}
+        for c in ctrls:
+            h = c["metrics"].get(metric)
+            if isinstance(h, dict) and h.get("count"):
+                means[c["name"]] = h["mean"]
+        if not means:
+            continue
+        any_row = True
+        vals = list(means.values())
+        skew = max(vals) - min(vals)
+        ratio = (max(vals) / min(vals)) if min(vals) > 0 else float("inf")
+        per = "  ".join(f"{n} {_fmt_sec(m)}" for n, m in sorted(means.items()))
+        out.append(f"  {metric:<26} {per}  skew {_fmt_sec(skew)}"
+                   f" ({ratio:.1f}x)")
+    if not any_row:
+        out.append("  (no allgather metrics in the streams — single-process"
+                   " run, or metrics snapshots missing)")
+
+    out.append("")
+    out.append("== per-controller phase breakdown " + "=" * 30)
+    for c in ctrls:
+        out.append(f"  -- {c['name']}")
+        _phase_section(c["spans"], out)
+
+    out.append("")
+    out.append("== divergence context " + "=" * 42)
+    dumps = [(c["name"], e) for c in ctrls for e in c["events"]]
+    if not dumps:
+        out.append("  (no divergence events — every generation's fold"
+                   " checksummed identically)")
+    else:
+        for name, e in sorted(dumps, key=lambda ne: ne[1].get("ts", 0)):
+            attrs = e.get("attrs", {})
+            out.append(f"  {name}: {e['name']}  "
+                       + json.dumps(attrs, sort_keys=True, default=str))
+        # correlate: which (gen, n_done) points diverged, seen by whom
+        keyed = {}
+        for name, e in dumps:
+            a = e.get("attrs", {})
+            keyed.setdefault((a.get("gen"), a.get("n_done")),
+                             []).append(name)
+        for (gen, n_done), names in sorted(keyed.items(),
+                                           key=lambda kv: str(kv[0])):
+            out.append(f"  gen={gen} n_done={n_done}: reported by "
+                       + ", ".join(sorted(names)))
+    return "\n".join(out) + "\n"
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.obs.report",
         description="Render a hyperopt_tpu obs JSONL stream.")
-    p.add_argument("jsonl", help="telemetry stream written by an armed run")
+    p.add_argument("jsonl", nargs="+",
+                   help="telemetry stream(s) written by an armed run")
     p.add_argument("--top", type=int, default=5,
-                   help="how many slowest trials to list")
+                   help="how many slowest trials to list (single-stream "
+                        "report only)")
+    p.add_argument("--merge", action="store_true",
+                   help="treat the inputs as per-controller streams from "
+                        "one fmin_multihost run and render the "
+                        "cross-controller view")
     args = p.parse_args(argv)
-    try:
-        records = read_jsonl(args.jsonl)
-    except OSError as e:
-        print(f"error: cannot read {args.jsonl}: {e}", file=sys.stderr)
+    if len(args.jsonl) > 1 and not args.merge:
+        print("error: multiple streams require --merge", file=sys.stderr)
         return 2
-    if not records:
-        print(f"error: {args.jsonl} holds no telemetry records",
-              file=sys.stderr)
+    streams = []
+    for path in args.jsonl:
+        try:
+            records = read_jsonl(path)
+        except OSError as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        streams.append((os.path.basename(path), records))
+    if not any(recs for _, recs in streams):
+        print("error: no telemetry records in "
+              + ", ".join(args.jsonl), file=sys.stderr)
         return 1
-    sys.stdout.write(render(records, top=args.top))
+    if args.merge:
+        sys.stdout.write(render_merged(streams))
+    else:
+        sys.stdout.write(render(streams[0][1], top=args.top))
     return 0
 
 

@@ -25,11 +25,14 @@ Design constraints:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 
 __all__ = ["PhaseTimings", "Tracer", "JsonlSink", "read_jsonl"]
+
+logger = logging.getLogger(__name__)
 
 
 class PhaseTimings(dict):
@@ -108,18 +111,22 @@ def _json_default(o):
 
 
 def read_jsonl(path):
-    """Parse a JSONL file into a list of records, skipping torn lines (a
-    killed process may leave a partial final line)."""
+    """Parse a JSONL file into a list of records, skipping unparseable
+    lines with a warning instead of raising: a process killed mid-write
+    leaves a torn final line, and one partial record must never make the
+    whole post-mortem unreadable (``obs.report`` reads through here)."""
     out = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
             except ValueError:
-                continue
+                logger.warning(
+                    "%s:%d: skipping unparseable JSONL record "
+                    "(torn write from a killed process?)", path, lineno)
     return out
 
 

@@ -47,7 +47,8 @@ import jax
 import jax.numpy as jnp
 
 from ..exceptions import AllTrialsFailed
-from ..obs import RunObs
+from ..obs import ObsConfig, RunObs
+from ..obs.health import controller_stream_path
 from ..spaces import compile_space
 from ..algos import tpe
 
@@ -137,14 +138,47 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     stream, or an ``ObsConfig``/``RunObs``).  Records per-generation spans,
     allgather latency, checkpoint save/load timing, and — on
     :class:`ControllerDivergence` — a full context dump of the disagreeing
-    checksums."""
-    obs = RunObs.resolve(obs)
+    checksums.  In a multi-process runtime each controller writes its OWN
+    stream (``run.jsonl`` → ``run.p<i>.jsonl``, run_id tagged ``-p<i>``);
+    render them as one cross-controller view (allgather skew, per-controller
+    phase breakdown, divergence correlation) with::
+
+        python -m hyperopt_tpu.obs.report --merge run.p0.jsonl run.p1.jsonl
+    """
     single = _force_single or jax.process_count() == 1
     if single:
         pid, P = 0, 1
     else:
         pid, P = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
+    if isinstance(obs, RunObs) and P > 1 and obs.config.jsonl_path:
+        # a pre-built bundle must ALSO split per controller — N processes
+        # appending to its one stream would interleave records under one
+        # untagged run_id, exactly what the merge view cannot attribute.
+        # Rebuild from its config with the tagged path/run_id instead.
+        obs = RunObs(
+            dataclasses.replace(
+                obs.config,
+                jsonl_path=controller_stream_path(obs.config.jsonl_path,
+                                                  pid)),
+            run_id=f"{obs.run_id}-p{pid}")
+    elif not isinstance(obs, RunObs):
+        config = ObsConfig.resolve(obs)
+        if P > 1 and config.jsonl_path:
+            # one stream PER CONTROLLER (run.jsonl -> run.p<i>.jsonl),
+            # run_id tagged with the process index: concurrent writers on
+            # one shared file would interleave, and the merged post-mortem
+            # needs to attribute every record to its controller anyway.
+            # Render them as one timeline with
+            #   python -m hyperopt_tpu.obs.report --merge run.p0.jsonl ...
+            config = dataclasses.replace(
+                config,
+                jsonl_path=controller_stream_path(config.jsonl_path, pid))
+        run_id = f"{config.run_id or 'mh'}-p{pid}" if P > 1 else None
+        obs = RunObs(config, run_id=run_id)
+    if P > 1:
+        # no-op without a sink; identifies this stream in the merge view
+        obs.event("controller", pid=pid, n_processes=P)
 
     cs = compile_space(space)
     labels = cs.labels

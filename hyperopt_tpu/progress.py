@@ -5,7 +5,23 @@ from __future__ import annotations
 
 import contextlib
 
-__all__ = ["tqdm_progress_callback", "no_progress_callback", "get_progress_callback"]
+__all__ = ["tqdm_progress_callback", "no_progress_callback",
+           "get_progress_callback", "format_postfix"]
+
+
+def format_postfix(best_loss, obs=None):
+    """The live progress-bar postfix: best loss, plus the run's latest
+    search-health gauges ("EI p50 …  dup …") when an armed obs bundle has
+    recorded at least one health ask.  Disarmed runs render exactly the
+    historical ``best loss: <x>`` string."""
+    s = f"best loss: {best_loss:.6g}"
+    if obs is not None and getattr(obs, "sink", None) is not None:
+        from .obs.health import live_health_postfix
+
+        extra = live_health_postfix(obs)
+        if extra:
+            s += "  " + extra
+    return s
 
 
 class _NullProgress:

@@ -2,4 +2,13 @@
 # Hermetic test run: force CPU JAX and bypass the ambient axon TPU hook
 # (PALLAS_AXON_POOL_IPS triggers a remote-TPU claim in sitecustomize at every
 # interpreter start; tests must not contend for the single chip).
-exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
+# Opt-in perf gate: BENCH_GATE=1 additionally compares the two newest
+# BENCH_r*.json artifacts (scripts/bench_gate.py) and fails on a
+# regression; with fewer than two rounds recorded it passes.
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
+rc=$?
+[ "$rc" -ne 0 ] && exit "$rc"
+if [ "${BENCH_GATE:-0}" = "1" ]; then
+    python scripts/bench_gate.py || exit 1
+fi
+exit 0

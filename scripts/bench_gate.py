@@ -1,0 +1,168 @@
+"""Perf-regression gate over the repo's bench trajectory.
+
+Compares the newest ``BENCH_r*.json`` against the previous one with
+per-metric relative thresholds and exits non-zero on a regression, so a PR
+that quietly slows the hot path fails loudly instead of shipping.  Opt in
+from the test runner with ``BENCH_GATE=1 ./run_tests.sh``.
+
+What gets compared (all higher-is-better throughputs):
+
+* the headline ``parsed`` record — ``value`` (candidates/sec) and
+  ``vs_baseline`` — always, when both rounds carry one;
+* stage-level throughput sequences (``trials_per_sec``,
+  ``candidates_per_sec``, ``cv_fits_per_sec``) regex-mined from the
+  recorded output tail, compared positionally ONLY when both rounds report
+  the same number of occurrences (a round that adds or drops a stage would
+  otherwise misalign the comparison — those names are skipped with a note
+  instead of guessed at).
+
+The no-baseline case (fewer than two ``BENCH_r*.json`` — a fresh repo with
+an empty bench trajectory) records what the newest round reports and
+passes: the gate's job is to compare rounds, not to manufacture one.
+
+Shared-hardware noise note: these benches run on a tunneled, contended
+chip; the default 20% threshold (35% for ``vs_baseline``, whose numpy
+denominator is itself noisy) is deliberately loose.  Override per run with
+``--threshold``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# metric-name → allowed relative drop (new >= prev * (1 - threshold))
+DEFAULT_THRESHOLDS = {
+    "headline.value": 0.20,
+    "headline.vs_baseline": 0.35,
+    "trials_per_sec": 0.20,
+    "candidates_per_sec": 0.20,
+    "cv_fits_per_sec": 0.20,
+}
+
+_TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec")
+
+
+def bench_files(root):
+    """BENCH_r*.json in round order (numeric suffix)."""
+
+    def round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                  key=round_no)
+
+
+def extract_metrics(path):
+    """``{metric name: value}`` for the headline record plus
+    ``{name: [occurrences]}`` sequences mined from the output tail."""
+    with open(path) as f:
+        rec = json.load(f)
+    scalars = {}
+    parsed = rec.get("parsed") or {}
+    if isinstance(parsed.get("value"), (int, float)):
+        scalars["headline.value"] = float(parsed["value"])
+    if isinstance(parsed.get("vs_baseline"), (int, float)):
+        scalars["headline.vs_baseline"] = float(parsed["vs_baseline"])
+    tail = rec.get("tail", "") or ""
+    sequences = {}
+    for name in _TAIL_METRICS:
+        vals = re.findall(rf'"{name}":\s*(-?[0-9][0-9.eE+-]*)', tail)
+        if vals:
+            sequences[name] = [float(v) for v in vals]
+    return scalars, sequences
+
+
+def compare(prev, new, thresholds):
+    """Returns ``(regressions, notes)`` — regressions is a list of
+    human-readable failure lines."""
+    regressions, notes = [], []
+    p_scalars, p_seqs = prev
+    n_scalars, n_seqs = new
+
+    def check(name, pv, nv):
+        thr = thresholds.get(name.split("[")[0],
+                             thresholds.get("default", 0.20))
+        floor = pv * (1.0 - thr)
+        if nv < floor:
+            regressions.append(
+                f"{name}: {nv:.6g} < {pv:.6g} * (1 - {thr:.0%}) = {floor:.6g}")
+        else:
+            notes.append(f"{name}: {pv:.6g} -> {nv:.6g}  ok")
+
+    for name in sorted(set(p_scalars) & set(n_scalars)):
+        check(name, p_scalars[name], n_scalars[name])
+    for name in sorted(set(p_seqs) & set(n_seqs)):
+        pv, nv = p_seqs[name], n_seqs[name]
+        if len(pv) != len(nv):
+            notes.append(f"{name}: occurrence count changed "
+                         f"({len(pv)} -> {len(nv)}), skipping positional "
+                         "comparison")
+            continue
+        for i, (a, b) in enumerate(zip(pv, nv)):
+            check(f"{name}[{i}]", a, b)
+    return regressions, notes
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python scripts/bench_gate.py",
+        description="Fail on a perf regression between the two newest "
+                    "BENCH_r*.json rounds.")
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="override every per-metric relative threshold")
+    args = p.parse_args(argv)
+
+    thresholds = dict(DEFAULT_THRESHOLDS)
+    if args.threshold is not None:
+        thresholds = {k: args.threshold for k in thresholds}
+        thresholds["default"] = args.threshold
+
+    files = bench_files(args.dir)
+    if len(files) < 2:
+        if files:
+            scalars, seqs = extract_metrics(files[0])
+            print(f"bench gate: no baseline ({len(files)} round recorded); "
+                  "recording and passing")
+            for k, v in sorted(scalars.items()):
+                print(f"  {k} = {v:.6g}")
+            for k, v in sorted(seqs.items()):
+                print(f"  {k}: {len(v)} occurrence(s)")
+        else:
+            print("bench gate: bench trajectory is empty; passing")
+        return 0
+
+    prev_path, new_path = files[-2], files[-1]
+    try:
+        prev = extract_metrics(prev_path)
+        new = extract_metrics(new_path)
+    except (OSError, ValueError) as e:
+        print(f"bench gate: cannot parse bench artifacts: {e}",
+              file=sys.stderr)
+        return 2
+    regressions, notes = compare(prev, new, thresholds)
+    print(f"bench gate: {os.path.basename(prev_path)} -> "
+          f"{os.path.basename(new_path)}")
+    for line in notes:
+        print("  " + line)
+    if regressions:
+        print("bench gate: REGRESSION", file=sys.stderr)
+        for line in regressions:
+            print("  " + line, file=sys.stderr)
+        return 1
+    if not notes:
+        print("  (no comparable metrics between the two rounds)")
+    print("bench gate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

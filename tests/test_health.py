@@ -1,0 +1,446 @@
+"""Search-health diagnostics, device-utilization accounting, and the
+multi-controller telemetry merge (hyperopt_tpu/obs/health.py + the armed
+suggest paths).
+
+All tier-1 (CPU, fast).  The two load-bearing invariants pinned here:
+
+* disarmed runs are untouched — the TPE hot path compiles the same
+  program under the same jit cache key and fetches no extra buffers;
+* armed and disarmed runs propose IDENTICAL trials — the diagnostics are
+  pure post-processing, no extra RNG.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import anneal, rand, tpe
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.fmin import FMinIter
+from hyperopt_tpu.obs import ObsConfig, get_metrics, read_jsonl, reset_metrics
+from hyperopt_tpu.obs.health import (
+    HEALTH_STATS,
+    controller_stream_path,
+    live_health_postfix,
+    utilization_snapshot,
+)
+from hyperopt_tpu.obs.report import main as report_main, render, render_merged
+from hyperopt_tpu.progress import format_postfix
+
+SPACE = {"hx": hp.uniform("hx", -5, 5), "hy": hp.uniform("hy", 0, 10),
+         "hc": hp.choice("hc", [0.0, 1.0, 2.0])}
+
+
+def objective(d):
+    return (d["hx"] - 1.0) ** 2 + (d["hy"] - 3.0) ** 2 + d["hc"]
+
+
+TPE = functools.partial(tpe.suggest, n_startup_jobs=4, prior_eps=0.3)
+
+
+def _run(obs=None, seed=0, max_evals=10, algo=TPE, **kw):
+    t = Trials()
+    fmin(objective, SPACE, algo=algo, max_evals=max_evals, trials=t,
+         rstate=np.random.default_rng(seed), show_progressbar=False,
+         obs=obs, **kw)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# the disarmed hot path is untouched
+# ---------------------------------------------------------------------------
+
+
+def test_tpe_disarmed_hot_path_no_extra_compile(tmp_path):
+    cache = tpe._suggest_jit_cache._d
+    before = set(cache)
+    _run(obs=None, seed=1)
+    disarmed_keys = set(cache) - before
+    # exactly one new fused program, under the historical 2-tuple key —
+    # no health marker, hence no diagnostics outputs in its signature
+    assert len(disarmed_keys) == 1
+    (key,) = disarmed_keys
+    assert len(key) == 2 and "health" not in key
+    # a second disarmed run reuses it (no recompile)
+    _run(obs=None, seed=2)
+    assert set(cache) - before == disarmed_keys
+    # arming compiles the diagnostics variant under its OWN key and leaves
+    # the disarmed entry alone
+    _run(obs=str(tmp_path / "armed.jsonl"), seed=3)
+    armed_keys = set(cache) - before - disarmed_keys
+    assert len(armed_keys) == 1
+    (akey,) = armed_keys
+    assert akey[-1] == "health" and akey[:2] == key
+
+
+def test_tpe_armed_matches_disarmed_proposals(tmp_path):
+    t_plain = _run(obs=None, seed=7)
+    t_armed = _run(obs=str(tmp_path / "run.jsonl"), seed=7)
+    assert t_plain.losses() == t_armed.losses()
+    for a, b in zip(t_plain.trials, t_armed.trials):
+        assert a["misc"]["vals"] == b["misc"]["vals"]
+
+
+# ---------------------------------------------------------------------------
+# armed TPE: health records + metrics + report section
+# ---------------------------------------------------------------------------
+
+
+def test_tpe_health_stream_metrics_and_report(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    t = _run(obs=path, seed=0, max_evals=12)
+    recs = read_jsonl(path)
+    health = [r for r in recs if r["kind"] == "health"]
+    assert health, "armed TPE run emitted no health records"
+    tpe_recs = [r for r in health if r["algo"] == "tpe"]
+    assert len(tpe_recs) == 12 - 4  # one per post-startup ask (queue 1)
+    r = tpe_recs[0]
+    for name in HEALTH_STATS:
+        if name != "prior_take":
+            assert name in r, name
+    assert r["n_below"] >= 1 and r["n_below"] + r["n_above"] >= 4
+    assert set(r["labels"]) == {"hx", "hy", "hc"}
+    assert 0.0 <= r["dup_rate"] <= 1.0
+    assert r["labels"]["hx"]["eff_components"] >= 1.0
+    assert 0.0 < r["labels"]["hx"]["prior_mass_frac"] <= 1.0
+
+    # metrics namespace carries the aggregates (snapshot embedded in stream)
+    snap = [x for x in recs if x["kind"] == "metrics"][-1]["snapshot"]
+    m = snap["metrics"]
+    assert m["health.asks"] == len(tpe_recs)
+    assert m["health.ei_p50"]["count"] == len(tpe_recs)
+    assert "health.prior_fallbacks" in m
+    assert m["health.n_below"] >= 1
+
+    # report renders the search-health section
+    assert report_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "search health" in out
+    assert "EI p50" in out and "dup rate" in out
+    assert "prior fallback" in out
+    assert "below/above split" in out
+    assert t.losses()  # the run itself behaved
+
+
+def test_tpe_health_deterministic_across_seeded_runs(tmp_path):
+    paths = [str(tmp_path / f"run{i}.jsonl") for i in (1, 2)]
+    for p in paths:
+        _run(obs=p, seed=42, max_evals=10)
+
+    def health_of(p):
+        out = []
+        for r in read_jsonl(p):
+            if r["kind"] == "health":
+                r = dict(r)
+                r.pop("ts")       # wall clock differs
+                r.pop("run_id")   # process-global counter differs
+                out.append(r)
+        return out
+
+    a, b = health_of(paths[0]), health_of(paths[1])
+    assert a and a == b
+
+
+# ---------------------------------------------------------------------------
+# rand / anneal: the cheap subset
+# ---------------------------------------------------------------------------
+
+
+def test_rand_health_cheap_subset(tmp_path):
+    path = str(tmp_path / "rand.jsonl")
+    _run(obs=path, algo=rand.suggest, max_evals=8, max_queue_len=4)
+    health = [r for r in read_jsonl(path) if r["kind"] == "health"]
+    assert health and all(r["algo"] == "rand" for r in health)
+    r = health[0]
+    assert r["n"] >= 2
+    assert set(r["labels"]) == {"hx", "hy", "hc"}
+    assert 0.0 <= r["dup_rate"] <= 1.0 and r["spread"] >= 0.0
+    # prior draws over a continuous space should not collapse
+    assert r["labels"]["hx"]["spread"] > 0.0
+
+
+def test_anneal_health_cheap_subset(tmp_path):
+    path = str(tmp_path / "anneal.jsonl")
+    _run(obs=path, algo=anneal.suggest, max_evals=8, max_queue_len=4)
+    health = [r for r in read_jsonl(path) if r["kind"] == "health"]
+    assert health and all(r["algo"] == "anneal" for r in health)
+
+
+def test_rand_queue1_records_no_degenerate_health(tmp_path):
+    # a width-1 batch has no dup/spread to speak of: nothing is recorded
+    path = str(tmp_path / "rand1.jsonl")
+    _run(obs=path, algo=rand.suggest, max_evals=4, max_queue_len=1)
+    assert [r for r in read_jsonl(path) if r["kind"] == "health"] == []
+
+
+# ---------------------------------------------------------------------------
+# report golden renders
+# ---------------------------------------------------------------------------
+
+
+def _health_rec(**over):
+    rec = {"kind": "health", "algo": "tpe", "ts": 1.0, "run_id": "r",
+           "n": 1, "n_label_proposals": 2, "n_below": 2, "n_above": 6,
+           "prior_takes": 0, "ei_p10": -1.0, "ei_p50": 0.5, "ei_p90": 1.0,
+           "ei_max": 1.5, "sel_rank": 0.0, "dup_rate": 0.0,
+           "eff_components": 3.0, "prior_mass_frac": 0.5,
+           "labels": {
+               "x": {"ei_p50": 0.5, "dup_rate": 0.0,
+                     "eff_components": 3.0, "prior_mass_frac": 0.5},
+               "y": {"ei_p50": 0.5, "dup_rate": 0.0,
+                     "eff_components": 3.0, "prior_mass_frac": 0.5},
+           }}
+    rec.update(over)
+    return rec
+
+
+def test_report_health_section_golden():
+    recs = [
+        _health_rec(),
+        _health_rec(ts=2.0, ei_p50=0.9, dup_rate=0.25, prior_takes=1,
+                    n_below=3, n_above=5,
+                    labels={"x": {"ei_p50": 0.9, "dup_rate": 0.25,
+                                  "eff_components": 4.0,
+                                  "prior_mass_frac": 0.33},
+                            "y": {"ei_p50": 0.9, "dup_rate": 0.25,
+                                  "eff_components": 4.0,
+                                  "prior_mass_frac": 0.33}}),
+    ]
+    lines = render(recs).splitlines()
+    health = lines[lines.index("== search health " + "=" * 47):]
+    assert health[1] == "  asks: tpe=2"
+    assert health[2] == "  EI p50        first +0.5  last +0.9  ▁█"
+    assert health[3] == "  EI sel rank   mean 0.00  (0 = pure argmax)"
+    assert health[4] == "  dup rate      first 0.0%  last 25.0%  ▁█"
+    assert health[5] == "  prior fallback  1/4 label-proposals  ▁█"
+    assert health[6] == "  below/above split (last ask): 3/5"
+    assert health[7] == "  per-param (last ask):"
+    assert health[8] == "    x  eff_comp 4.0  prior_mass 0.33  dup 25.0%"
+    assert health[9] == "    y  eff_comp 4.0  prior_mass 0.33  dup 25.0%"
+
+
+def _controller_stream(path, pid, ag_mean):
+    rid = f"mh-p{pid}"
+    with open(path, "w") as f:
+        def w(r):
+            f.write(json.dumps(r) + "\n")
+
+        w({"kind": "event", "name": "controller", "ts": 1.0, "run_id": rid,
+           "attrs": {"pid": pid, "n_processes": 2}})
+        for gen in range(2):
+            for j, (name, wall) in enumerate(
+                    [("propose", 0.1), ("evaluate", 0.2 + pid * 0.1),
+                     ("fold", 0.01)]):
+                w({"kind": "span", "name": name, "ts": 1.0 + gen + j * 0.1,
+                   "wall_sec": wall, "cpu_sec": wall / 2,
+                   "span_id": gen * 3 + j + 1, "parent_id": None,
+                   "depth": 0, "run_id": rid})
+        w({"kind": "event", "name": "controller_divergence", "ts": 3.0,
+           "run_id": rid,
+           "attrs": {"pid": pid, "gen": 2, "n_done": 8,
+                     "checksums": ["0xa", "0xb"]}})
+        h = {"count": 2, "sum": ag_mean * 2, "mean": ag_mean, "min": ag_mean,
+             "max": ag_mean, "p50": ag_mean, "p90": ag_mean, "p99": ag_mean}
+        w({"kind": "metrics", "run_id": rid,
+           "snapshot": {"metrics": {"generations": 2,
+                                    "allgather.losses_sec": h}}})
+
+
+def test_report_merge_golden(tmp_path, capsys):
+    p0 = str(tmp_path / "mh.p0.jsonl")
+    p1 = str(tmp_path / "mh.p1.jsonl")
+    _controller_stream(p0, 0, 0.010)
+    _controller_stream(p1, 1, 0.025)
+    assert report_main(["--merge", p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert "== controllers" in out
+    assert "mh.p0.jsonl  run_id=mh-p0  gens=2  spans=6" in out
+    assert ("  allgather.losses_sec       mh.p0.jsonl 10.0ms  "
+            "mh.p1.jsonl 25.0ms  skew 15.0ms (2.5x)") in out
+    assert "== per-controller phase breakdown" in out
+    assert "gen=2 n_done=8: reported by mh.p0.jsonl, mh.p1.jsonl" in out
+
+
+def test_report_merge_of_real_streams(tmp_path, capsys):
+    # two real (single-controller) fmin_multihost streams merge cleanly
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    def quad(d):
+        return (d["hx"] - 1.0) ** 2
+
+    paths = []
+    for i in (0, 1):
+        p = str(tmp_path / f"run.p{i}.jsonl")
+        fmin_multihost(quad, {"hx": hp.uniform("hx", -5, 5)}, max_evals=8,
+                       batch=4, seed=i, obs=p, _force_single=True)
+        paths.append(p)
+    assert report_main(["--merge"] + paths) == 0
+    out = capsys.readouterr().out
+    assert "run.p0.jsonl" in out and "run.p1.jsonl" in out
+    assert "gens=2" in out
+    assert "propose" in out and "fold" in out
+    assert "no divergence events" in out
+
+
+def test_report_multiple_streams_require_merge_flag(tmp_path, capsys):
+    p = str(tmp_path / "a.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "event", "name": "x", "ts": 0.0}) + "\n")
+    assert report_main([p, p]) == 2
+    assert "--merge" in capsys.readouterr().err
+
+
+def test_report_tolerates_truncated_final_line(tmp_path, capsys, caplog):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "span", "name": "suggest", "ts": 1.0,
+                            "wall_sec": 0.5, "cpu_sec": 0.2, "span_id": 1,
+                            "parent_id": None, "depth": 0}) + "\n")
+        f.write('{"kind": "metrics", "run_id": "r", "snap')  # killed mid-write
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="hyperopt_tpu.obs.trace"):
+        assert report_main([path]) == 0
+    assert "suggest" in capsys.readouterr().out
+    assert any("skipping unparseable JSONL record" in r.message
+               for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# RunObs re-entry (iterator-protocol FMinIter)
+# ---------------------------------------------------------------------------
+
+
+def test_runobs_rearm_keeps_counters_across_reentry():
+    domain = Domain(objective, SPACE)
+    t = Trials()
+    it = FMinIter(rand.suggest, domain, t,
+                  rstate=np.random.default_rng(0), max_evals=6,
+                  show_progressbar=False, obs=ObsConfig(level="basic"))
+    rid = it.obs.run_id
+    try:
+        next(it)  # run(1) -> finish() releases the namespace
+        assert it.obs.metrics.counter("trials.completed").value == 1
+        # between runs the namespace is released; a by-id lookup would get
+        # a fresh empty registry...
+        assert get_metrics(rid) is not it.obs.metrics
+        # ...and rearm (run() calls it at every entry) re-adopts the
+        # bundle's own registry, displacing the imposter
+        it.obs.rearm()
+        assert get_metrics(rid) is it.obs.metrics
+        next(it)  # full re-entry: counters keep accumulating, not dropped
+        assert it.obs.metrics.counter("trials.completed").value == 2
+    finally:
+        reset_metrics(rid)
+
+
+# ---------------------------------------------------------------------------
+# device-utilization accounting
+# ---------------------------------------------------------------------------
+
+
+def test_utilization_snapshot_joins_cost_and_execute():
+    from hyperopt_tpu.device_fmin import fmin_device
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    fmin_device(dom.objective, dom.space, max_evals=16, seed=0)
+    dev = get_metrics("device").snapshot()["metrics"]
+    if "whole_run.flops" not in dev:
+        pytest.skip("backend reports no cost_analysis")
+    util = utilization_snapshot(wall_sec=1e9)
+    assert "whole_run" in util
+    wr = util["whole_run"]
+    assert wr["flops_per_dispatch"] > 0
+    assert wr["achieved_flops_per_sec"] > 0
+    assert 0.0 <= wr["busy_fraction"] <= 1.0
+    assert util["device_busy_fraction"] <= 1.0
+
+
+def test_live_postfix_and_format(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    t = _run(obs=path, seed=0, max_evals=8)
+    obs = t.obs_health
+    s = live_health_postfix(obs)
+    assert s is not None and "EI p50" in s and "dup" in s
+    full = format_postfix(1.25, obs)
+    assert full.startswith("best loss: 1.25") and "EI p50" in full
+    # disarmed: exactly the historical string
+    assert format_postfix(1.25, None) == "best loss: 1.25"
+
+
+def test_trials_pickle_drops_obs_health(tmp_path):
+    import pickle
+
+    t = _run(obs=str(tmp_path / "run.jsonl"), seed=0, max_evals=6)
+    assert t.obs_health is not None
+    t2 = pickle.loads(pickle.dumps(t))
+    assert not hasattr(t2, "obs_health")
+    assert len(t2.trials) == len(t.trials)
+
+
+# ---------------------------------------------------------------------------
+# multi-controller stream naming
+# ---------------------------------------------------------------------------
+
+
+def test_controller_stream_path():
+    assert controller_stream_path("run.jsonl", 0) == "run.p0.jsonl"
+    assert controller_stream_path("/a/b/run.jsonl", 3) == "/a/b/run.p3.jsonl"
+    assert controller_stream_path("run", 1) == "run.p1.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# bench gate
+# ---------------------------------------------------------------------------
+
+
+def _write_bench(dirpath, n, value, vs_baseline, tails=(100.0, 200.0)):
+    rec = {"n": n, "parsed": {"metric": "tpe_candidate_proposal_throughput",
+                              "value": value, "unit": "candidates/sec",
+                              "vs_baseline": vs_baseline},
+           "tail": "".join(f'"trials_per_sec": {t},\n' for t in tails)}
+    with open(os.path.join(dirpath, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(rec, f)
+
+
+def _gate(tmp_path, *args):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), os.pardir,
+                                      "scripts", "bench_gate.py"),
+         "--dir", str(tmp_path), *args],
+        capture_output=True, text=True)
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def test_bench_gate_no_baseline_passes(tmp_path):
+    rc, out = _gate(tmp_path)
+    assert rc == 0 and "empty" in out
+    _write_bench(tmp_path, 1, 1e8, 1000.0)
+    rc, out = _gate(tmp_path)
+    assert rc == 0 and "no baseline" in out
+
+
+def test_bench_gate_detects_regression(tmp_path):
+    _write_bench(tmp_path, 1, 1e8, 1000.0, tails=(100.0, 200.0))
+    _write_bench(tmp_path, 2, 0.5e8, 990.0, tails=(99.0, 198.0))
+    rc, out = _gate(tmp_path)
+    assert rc == 1
+    assert "REGRESSION" in out and "headline.value" in out
+    # within-threshold round passes (stage metrics 1% down, headline equal)
+    _write_bench(tmp_path, 3, 0.5e8, 990.0, tails=(98.0, 196.0))
+    rc, out = _gate(tmp_path)
+    assert rc == 0 and "ok" in out
+
+
+def test_bench_gate_skips_misaligned_stage_sequences(tmp_path):
+    _write_bench(tmp_path, 1, 1e8, 1000.0, tails=(100.0,))
+    _write_bench(tmp_path, 2, 1e8, 1000.0, tails=(1.0, 1.0))  # new stage
+    rc, out = _gate(tmp_path)
+    assert rc == 0 and "skipping positional comparison" in out
