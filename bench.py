@@ -389,6 +389,54 @@ def bench_branin_fmin(max_evals=100, seed=0, queues=(1, 4)):
     return out
 
 
+def bench_flight_overhead(max_evals=60, repeats=3, seed=0):
+    """Forensics acceptance bar (ISSUE 3): the always-on flight recorder
+    must keep the DISARMED host ``fmin`` loop inside the established <2%
+    overhead bar.  Runs the same warm TPE fmin the ``branin_fmin_tpe``
+    headline measures — once with the ring disabled, once enabled — and
+    attaches the before/after delta to the bench artifacts, so the bar is
+    re-measured (not asserted) every round.  A rand-suggest variant rides
+    along as the adversarial worst case: its per-trial work is minimal, so
+    it puts the tightest honest bound on the absolute per-trial cost."""
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand, tpe
+    from hyperopt_tpu.obs.flight import get_flight
+
+    space = {"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)}
+
+    def once(algo):
+        t0 = time.perf_counter()
+        fmin(_host_branin, space, algo=algo, max_evals=max_evals,
+             trials=Trials(), rstate=np.random.default_rng(seed),
+             show_progressbar=False)
+        return time.perf_counter() - t0
+
+    fr = get_flight()
+    was_enabled = fr.enabled
+    out = {"max_evals": max_evals, "repeats": repeats,
+           "bar": "<2% disarmed fmin overhead (tpe loop)"}
+    try:
+        for name, algo in (("tpe", tpe.suggest), ("rand", rand.suggest)):
+            once(algo)  # warm: jit/space compile shared by both sides
+            stage = {}
+            for label, enabled in (("flight_off", False),
+                                   ("flight_on", True)):
+                fr.enabled = enabled
+                stage[f"{label}_sec"] = min(
+                    once(algo) for _ in range(repeats))
+            stage["overhead_frac"] = (
+                (stage["flight_on_sec"] - stage["flight_off_sec"])
+                / max(stage["flight_off_sec"], 1e-9))
+            out[name] = stage
+    finally:
+        fr.enabled = was_enabled
+    # the headline delta is the representative loop's
+    out["flight_off_sec"] = out["tpe"]["flight_off_sec"]
+    out["flight_on_sec"] = out["tpe"]["flight_on_sec"]
+    out["overhead_frac"] = out["tpe"]["overhead_frac"]
+    return out
+
+
 def bench_hr_conditional(max_evals=100, seed=0):
     """BASELINE config #3: Hartmann6 + 20-D Rosenbrock mixed conditional
     space under TPE (28 hyperparameters, nested hp.choice)."""
@@ -719,6 +767,8 @@ _JAX_STAGES = (
     ("jax_batched_1024", lambda: bench_jax(n_cand=8192, batch=1024, repeats=5)),
     ("branin_device_1000", bench_branin_device),
     ("branin_fmin_tpe", bench_branin_fmin),
+    # forensics overhead bar: flight ring on vs off on the disarmed loop
+    ("flight_overhead", bench_flight_overhead),
     ("hr_conditional_tpe", bench_hr_conditional),
     ("parallel_trials_10k", bench_parallel_trials),
     ("parallel_trials_10k_tpe", bench_parallel_trials_tpe),
@@ -882,6 +932,13 @@ def main():
         rec = stages.get(stage_name)
         if rec and rec.get("ok") and rec["result"].get("obs"):
             obs_summary[stage_name] = rec["result"]["obs"]
+    # the flight-recorder before/after delta rides the headline line: the
+    # "<2% disarmed overhead" acceptance bar stays visible round over round
+    rec = stages.get("flight_overhead")
+    if rec and rec.get("ok"):
+        obs_summary["flight_overhead"] = {
+            k: rec["result"].get(k)
+            for k in ("flight_off_sec", "flight_on_sec", "overhead_frac")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact

@@ -29,6 +29,7 @@ from .algos import tpe
 from .base import trials_from_flat_history
 from .obs import get_metrics
 from .obs.health import record_program_cost
+from .obs.watchdog import beat as _wd_beat
 from .utils import LRUCache
 from .spaces import compile_space, draw_dist, label_hash
 
@@ -66,6 +67,9 @@ def _aot_compile(holder, args, hist_name, obs=None):
     unavailable."""
     span = (obs.span("device.compile", aggregate=False)
             if obs is not None else None)
+    # compile boundary beat: a stall here is XLA (or the tunnel), not the
+    # search loop — the watchdog report will show this as the last mark
+    _wd_beat("device.compile", stage=hist_name.split(".")[0], mark="pre")
     t0 = time.perf_counter()
     try:
         if span is not None:
@@ -79,6 +83,7 @@ def _aot_compile(holder, args, hist_name, obs=None):
     else:
         record_program_cost(hist_name.split(".")[0], compiled, _METRICS)
     _METRICS.histogram(hist_name).observe(time.perf_counter() - t0)
+    _wd_beat("device.compile", stage=hist_name.split(".")[0], mark="post")
     holder["compiled"] = compiled
     return compiled
 
@@ -308,12 +313,18 @@ class DeviceLoopRunner:
         if fn is None:
             fn = _aot_compile(self._holder, args, "chunk.compile_sec",
                               obs=self._obs)
+        # execute boundary beats: a quiet period opening after "pre" and
+        # never reaching "post" is a hung device program / dead readback
+        _wd_beat("device.execute", stage="chunk", start=int(start),
+                 mark="pre")
         t0 = time.perf_counter()
         state, rows = fn(*args)
         rows = np.asarray(rows)[: limit - start]  # the blocking readback
         _METRICS.histogram("chunk.execute_sec").observe(
             time.perf_counter() - t0)
         _METRICS.counter("chunk.dispatches").inc()
+        _wd_beat("device.execute", stage="chunk", start=int(start),
+                 mark="post")
         return state, rows
 
 
@@ -379,11 +390,13 @@ def fmin_device(
     if run is None:
         run = _aot_compile(holder, (key,), "whole_run.compile_sec")
         holder["compiled_sig"] = sig
+    _wd_beat("device.execute", stage="whole_run", mark="pre")
     t0 = time.perf_counter()
     out = run(key)
     jax.block_until_ready(out)  # strict completion: execute_sec is honest
     _METRICS.histogram("whole_run.execute_sec").observe(
         time.perf_counter() - t0)
+    _wd_beat("device.execute", stage="whole_run", mark="post")
     vals, active, losses, has_loss, trace = out
 
     vals = {l: np.asarray(v) for l, v in vals.items()}

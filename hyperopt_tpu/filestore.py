@@ -134,6 +134,11 @@ def _claim_suffix():
 # it shares the store's durability story and is readable as an attachment
 _EVENTS_ATTACHMENT = "obs_events.jsonl"
 
+# flight-recorder crash dumps ride the same namespace: one per dying
+# process (driver or worker), named flight.<owner>.jsonl — a worker killed
+# mid-trial leaves its last moments inside the store it was serving
+_FLIGHT_PREFIX = "flight."
+
 
 class FileStore:
     """Low-level durable job store (hyperopt/mongoexp.py sym: MongoJobs).
@@ -161,6 +166,41 @@ class FileStore:
         this store ever emitted (the post-mortem entry point)."""
         return load_events(
             os.path.join(self.root, "attachments", _EVENTS_ATTACHMENT))
+
+    # -- flight-recorder dumps (obs/flight.py) ----------------------------
+
+    def flight_dump_path(self, owner):
+        """Attachment path for ``owner``'s crash dump (``:`` is swapped out
+        so the hostname:pid owner string stays one path component)."""
+        safe = str(owner).replace(":", "-").replace(os.sep, "-")
+        return os.path.join(self.root, "attachments",
+                            f"{_FLIGHT_PREFIX}{safe}.jsonl")
+
+    def arm_flight(self, owner):
+        """Arm the process-global flight recorder to dump into this store's
+        attachments when THIS process dies (worker processes call this at
+        startup — the store then holds the forensics for every process
+        that ever served it).  Returns the dump path."""
+        from .obs.flight import get_flight
+
+        path = self.flight_dump_path(owner)
+        get_flight().install(path)
+        return path
+
+    def read_flight_dumps(self):
+        """``{owner: records}`` for every flight dump any process left in
+        the store (render one with ``obs.report --postmortem <path>``)."""
+        from .obs.trace import read_jsonl
+
+        d = os.path.join(self.root, "attachments")
+        out = {}
+        for fname in sorted(os.listdir(d)):
+            if (not fname.startswith(_FLIGHT_PREFIX)
+                    or not fname.endswith(".jsonl")):
+                continue
+            owner = fname[len(_FLIGHT_PREFIX):-len(".jsonl")]
+            out[owner] = read_jsonl(os.path.join(d, fname))
+        return out
 
     # -- tid allocation (counter-doc analog) ------------------------------
 

@@ -216,6 +216,9 @@ class FMinIter:
             trial["book_time"] = coarse_utcnow()
             self.obs.trial_event(obs_mod.events_mod.TRIAL_CLAIMED,
                                  trial["tid"], owner="serial")
+            # a hang past this beat is the objective itself: the watchdog's
+            # stall report names the trial that wedged the loop
+            self.obs.heartbeat("fmin.evaluate", tid=trial["tid"])
             spec = spec_from_misc(trial["misc"])
             ctrl = Ctrl(self.trials, current_trial=trial)
             t0 = time.perf_counter()
@@ -276,6 +279,7 @@ class FMinIter:
                 if not already_printed and self.verbose:
                     logger.info("Waiting for %d jobs to finish ...", qlen)
                     already_printed = True
+                self.obs.heartbeat("fmin.drain", qlen=qlen)
                 time.sleep(self.poll_interval_secs)
                 if timed_out() and cancel is not None:
                     cancel()
@@ -399,6 +403,7 @@ class FMinIter:
             initial=n_done, total=self.max_evals
         ) as progress_ctx:
             while n_done < target and not stopped:
+                self.obs.heartbeat("fmin.device_chunk", n_done=n_done)
                 limit = min(n_done + runner.CHUNK, target)
                 seed = (self.rstate.integers(2**31 - 1)
                         if hasattr(self.rstate, "integers")
@@ -492,6 +497,9 @@ class FMinIter:
             all_trials_complete = False
             best_loss = float("inf")
             while n_queued < N or (block_until_done and not all_trials_complete):
+                # one beat per ask→tell tick: the stall watchdog's quiet
+                # period measures from here when the host loop wedges
+                self.obs.heartbeat("fmin.tick", n_queued=n_queued)
                 qlen = get_queue_len()
                 while (
                     qlen < self.max_queue_len and n_queued < N and not self.is_cancelled

@@ -10,6 +10,18 @@ a run *proves* where its time goes.  Three pillars, one config:
 * :mod:`~hyperopt_tpu.obs.events` — durable trial-lifecycle event log
   (``FileStore`` persists it as an attachment for post-mortems).
 
+Plus the crash/stall forensics layer that works even when nothing above is
+armed:
+
+* :mod:`~hyperopt_tpu.obs.flight` — always-on bounded ring of recent
+  records, dumped to ``<run>.flight.jsonl`` on fatal signals, unhandled
+  exceptions and atexit (render with ``obs.report --postmortem``).
+* :mod:`~hyperopt_tpu.obs.watchdog` — stall detector over heartbeats from
+  all four execution paths; emits ``kind="stall"`` records with thread
+  stacks (``HYPEROPT_TPU_WATCHDOG=<quiet seconds>``).
+* :mod:`~hyperopt_tpu.obs.export` — Chrome/Perfetto trace-event export
+  (``obs.report --export-trace out.json run.jsonl``).
+
 One flag arms everything: ``HYPEROPT_TPU_OBS=<run.jsonl>`` (or the ``obs=``
 kwarg on ``fmin``/``fmin_multihost``) turns on the JSONL stream, and the
 pre-existing ``HYPEROPT_TPU_PROFILE=<dir>`` ``jax.profiler`` hook now rides
@@ -27,9 +39,13 @@ import logging
 import os
 
 from . import events as events_mod
+from . import flight as flight_mod
+from . import watchdog as watchdog_mod
 from .events import EventLog
+from .flight import FlightRecorder, flight_path_for, get_flight
 from .metrics import MetricsRegistry, adopt_metrics, get_metrics, reset_metrics
-from .trace import JsonlSink, PhaseTimings, Tracer, read_jsonl
+from .trace import JsonlSink, PhaseTimings, Tracer, iter_jsonl, read_jsonl
+from .watchdog import Watchdog, get_watchdog
 
 __all__ = [
     "ObsConfig",
@@ -39,9 +55,15 @@ __all__ = [
     "PhaseTimings",
     "EventLog",
     "MetricsRegistry",
+    "FlightRecorder",
+    "Watchdog",
+    "get_flight",
+    "get_watchdog",
+    "flight_path_for",
     "get_metrics",
     "reset_metrics",
     "adopt_metrics",
+    "iter_jsonl",
     "read_jsonl",
 ]
 
@@ -65,18 +87,31 @@ class ObsConfig:
     ``profile_dir`` routes the ``jax.profiler`` trace hook (previously the
     free-floating ``HYPEROPT_TPU_PROFILE`` check in ``fmin``) through the
     same object, so one config arms the whole stack.
+
+    ``flight_path`` pins the flight-recorder crash-dump path explicitly
+    (``HYPEROPT_TPU_FLIGHT=<path>``); left None it derives from
+    ``jsonl_path`` (``run.jsonl`` → ``run.flight.jsonl``) or, for fully
+    disarmed runs, falls back to the recorder's cwd default on abnormal
+    death only.  The ring itself is always on regardless of ``level``
+    (disable the whole recorder with ``HYPEROPT_TPU_FLIGHT=0``).
     """
 
     level: str = "basic"
     jsonl_path: str | None = None
     profile_dir: str | None = None
     run_id: str | None = None
+    flight_path: str | None = None
 
     @classmethod
     def from_env(cls, env=None):
         env = os.environ if env is None else env
         raw = env.get("HYPEROPT_TPU_OBS", "").strip()
         profile_dir = env.get("HYPEROPT_TPU_PROFILE", "") or None
+        raw_flight = env.get("HYPEROPT_TPU_FLIGHT", "").strip()
+        # "0"/"off" (handled by flight.get_flight) and bare "1" are not
+        # paths; anything else names the dump file
+        flight_path = (raw_flight
+                       if raw_flight not in ("", "0", "1", "off") else None)
         if raw in ("", "1", "basic"):
             level, jsonl_path = "basic", None
         elif raw in ("0", "off"):
@@ -84,7 +119,7 @@ class ObsConfig:
         else:  # a path arms the full trace stream
             level, jsonl_path = "trace", raw
         return cls(level=level, jsonl_path=jsonl_path,
-                   profile_dir=profile_dir)
+                   profile_dir=profile_dir, flight_path=flight_path)
 
     @classmethod
     def resolve(cls, obs):
@@ -96,9 +131,10 @@ class ObsConfig:
         if isinstance(obs, cls):
             return obs
         if isinstance(obs, (str, os.PathLike)):
+            env_cfg = cls.from_env()
             return cls(level="trace", jsonl_path=str(obs),
-                       profile_dir=os.environ.get("HYPEROPT_TPU_PROFILE")
-                       or None)
+                       profile_dir=env_cfg.profile_dir,
+                       flight_path=env_cfg.flight_path)
         raise TypeError(f"obs must be None, a path, or ObsConfig; got {obs!r}")
 
 
@@ -122,6 +158,27 @@ class RunObs:
         self.metrics = get_metrics(self.run_id)
         self.events = EventLog(sink=self.sink)
         self._finished = False
+        # forensics: always-on flight ring + crash handlers (installed once
+        # per process, at the first run).  The dump path is explicit
+        # (HYPEROPT_TPU_FLIGHT=<path>), derived from the armed stream, or —
+        # for fully disarmed runs — the recorder's abnormal-death default.
+        fpath = self.config.flight_path
+        if fpath is None and self.config.jsonl_path:
+            fpath = flight_path_for(self.config.jsonl_path)
+        # a derived target is per-run: finish() removes it so clean exits
+        # don't litter; an explicit HYPEROPT_TPU_FLIGHT path is persistent
+        self._flight_target = (fpath if self.config.flight_path is None
+                               else None)
+        self.flight = get_flight().install(fpath)
+        self.watchdog = get_watchdog()
+        if self.watchdog is not None:
+            # stall detection is scoped to live runs: retained here,
+            # released by finish() — a process that outlives its runs must
+            # not report its own idleness as a stall forever
+            self.watchdog.retain()
+            if self.sink is not None:
+                # armed runs stream stall records next to their spans
+                self.watchdog.attach_sink(self.sink)
 
     @classmethod
     def resolve(cls, obs, totals=None, run_id=None):
@@ -139,6 +196,13 @@ class RunObs:
 
     def event(self, name, **attrs):
         self.tracer.event(name, **attrs)
+
+    def heartbeat(self, component, **detail):
+        """Feed the stall watchdog (no-op when it is disabled): the four
+        execution paths call this at every liveness-proving boundary so a
+        quiet period means a real hang, not a slow phase."""
+        if self.watchdog is not None:
+            self.watchdog.beat(component, **detail)
 
     def trial_event(self, event, tid, **attrs):
         self.events.emit(event, tid, **attrs)
@@ -190,7 +254,15 @@ class RunObs:
         if self.sink is not None:
             self.sink.write({"kind": "metrics", "run_id": self.run_id,
                              "snapshot": self.snapshot()})
+            if self.watchdog is not None:
+                self.watchdog.detach_sink(self.sink)
             self.sink.close()
+        if self.watchdog is not None and not self._finished:
+            self.watchdog.release()
+        if self._flight_target is not None:
+            # the run survived: drop its derived dump target so a clean
+            # process exit doesn't litter; the ring keeps recording
+            self.flight.remove_target(self._flight_target)
         reset_metrics(self.run_id)
         self._finished = True
 
@@ -205,4 +277,10 @@ class RunObs:
         the run is live; ``FMinIter.run()`` calls this at every entry."""
         if self._finished:
             adopt_metrics(self.run_id, self.metrics)
+            if self._flight_target is not None:
+                self.flight.add_target(self._flight_target)
+            if self.watchdog is not None:
+                self.watchdog.retain()
+                if self.sink is not None:
+                    self.watchdog.attach_sink(self.sink)
             self._finished = False

@@ -26,6 +26,8 @@ import os
 import time
 from collections import deque
 
+from .flight import get_flight
+
 __all__ = [
     "TRIAL_NEW",
     "TRIAL_CLAIMED",
@@ -84,6 +86,9 @@ class EventLog:
         if attrs:
             rec.update(attrs)
         self._ring.append(rec)
+        # the flight ring too: a crash dump reconstructs in-flight trials
+        # (claimed-but-never-finished) from exactly these records
+        get_flight().record(rec)
         if self.sink is not None:
             try:
                 self.sink.write(rec)

@@ -1,0 +1,175 @@
+"""Chrome / Perfetto trace-event export for obs JSONL streams.
+
+The ASCII report (``obs.report``) answers "where did the time go" in
+aggregate; a trace viewer answers it *visually*, span by span, across
+threads and controllers.  This module converts any obs JSONL stream —
+including the merged per-controller streams one ``fmin_multihost`` run
+writes — into the Trace Event Format every Chrome-lineage viewer loads
+(``chrome://tracing``, https://ui.perfetto.dev)::
+
+    python -m hyperopt_tpu.obs.report --export-trace run.trace.json run.jsonl
+    python -m hyperopt_tpu.obs.report --export-trace mh.trace.json \
+        run.p0.jsonl run.p1.jsonl        # controllers as track groups
+
+Mapping (one ``pid`` per input stream — Perfetto renders each as its own
+process track group, named after the stream):
+
+* ``kind="span"``   → complete ``X`` events (start ``ts``, ``dur``), one
+  ``tid`` track per recording thread (span records carry ``thread``);
+  depth/nesting is recovered by the viewer from containment.
+* ``kind="event"``  → instant ``i`` events on the emitting track.
+* ``kind="trial_event"`` → instant events on a dedicated ``trials`` track
+  (the lifecycle waterfall as a timeline).
+* ``kind="stall"`` / ``"flight_dump"`` / ``"open_span"`` → instant events
+  on a ``forensics`` track, stacks and heartbeats in ``args``.
+* ``kind="health"`` → ``C`` counter tracks (``ei_p50``, ``dup_rate``) so
+  search health plots right under the span timeline.
+* metric snapshots are skipped (they are end-of-run aggregates, not
+  timeline points).
+
+Events are emitted sorted by ``(pid, tid, ts)`` with metadata (``M``)
+records first — the invariant ``scripts/validate_trace.py`` checks.
+
+All ``ts``/``dur`` are microseconds (the trace-event unit); absolute epoch
+timestamps are kept, which viewers handle fine and which lets merged
+controller streams align on real time.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["to_trace_events", "export_trace", "write_trace"]
+
+# reserved per-stream tids; real recording threads allocate upward from 10
+_TID_MAIN = 0
+_TID_TRIALS = 1
+_TID_FORENSICS = 2
+_TID_COUNTERS = 3
+
+_COUNTER_STATS = ("ei_p50", "dup_rate")
+
+
+def _us(ts):
+    return float(ts) * 1e6
+
+
+class _Tids:
+    """Stable thread-name → tid allocation for one stream."""
+
+    def __init__(self):
+        self._by_name = {"MainThread": _TID_MAIN}
+        self._next = 10
+
+    def get(self, name):
+        tid = self._by_name.get(name)
+        if tid is None:
+            tid = self._by_name[name] = self._next
+            self._next += 1
+        return tid
+
+    def items(self):
+        return sorted(self._by_name.items(), key=lambda kv: kv[1])
+
+
+def to_trace_events(records, pid=0, name=None):
+    """Convert one stream's records into trace events (unsorted; callers
+    go through :func:`export_trace`, which sorts and adds nothing else)."""
+    tids = _Tids()
+    events = []
+    used_tracks = set()
+
+    def instant(tid, ev_name, ts, cat, args=None):
+        e = {"name": ev_name, "ph": "i", "ts": _us(ts), "pid": pid,
+             "tid": tid, "cat": cat, "s": "t"}
+        if args:
+            e["args"] = args
+        used_tracks.add(tid)
+        events.append(e)
+
+    for r in records:
+        kind = r.get("kind")
+        ts = r.get("ts")
+        if ts is None:
+            continue
+        if kind == "span":
+            tid = tids.get(r.get("thread", "MainThread"))
+            used_tracks.add(tid)
+            args = dict(r.get("attrs") or {})
+            for k in ("cpu_sec", "span_id", "parent_id", "run_id", "error"):
+                if r.get(k) is not None:
+                    args[k] = r[k]
+            events.append({
+                "name": r.get("name", "?"), "ph": "X", "ts": _us(ts),
+                "dur": max(0.0, float(r.get("wall_sec", 0.0))) * 1e6,
+                "pid": pid, "tid": tid, "cat": "span",
+                "args": args,
+            })
+        elif kind == "event":
+            instant(tids.get(r.get("thread", "MainThread")),
+                    r.get("name", "event"), ts, "event",
+                    r.get("attrs") or None)
+        elif kind == "trial_event":
+            instant(_TID_TRIALS,
+                    f"{r.get('event', '?')} tid={r.get('tid')}", ts, "trial",
+                    {k: v for k, v in r.items()
+                     if k not in ("kind", "ts")} or None)
+        elif kind == "stall":
+            instant(_TID_FORENSICS, "stall", ts, "forensics",
+                    {"quiet_for_sec": r.get("quiet_for_sec"),
+                     "last_heartbeats": r.get("last_heartbeats"),
+                     "stacks": r.get("stacks")})
+        elif kind == "flight_dump":
+            instant(_TID_FORENSICS, f"flight_dump:{r.get('reason', '?')}",
+                    ts, "forensics", {"pid": r.get("pid"),
+                                      "n_records": r.get("n_records")})
+        elif kind == "open_span":
+            instant(_TID_FORENSICS, f"open:{r.get('name', '?')}", ts,
+                    "forensics", {"age_sec": r.get("age_sec"),
+                                  "thread": r.get("thread")})
+        elif kind == "health":
+            for stat in _COUNTER_STATS:
+                v = r.get(stat)
+                if v is not None:
+                    used_tracks.add(_TID_COUNTERS)
+                    events.append({
+                        "name": stat, "ph": "C", "ts": _us(ts), "pid": pid,
+                        "tid": _TID_COUNTERS, "cat": "health",
+                        "args": {stat: float(v)},
+                    })
+
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": name or f"stream-{pid}"}}]
+    reserved = {_TID_TRIALS: "trials", _TID_FORENSICS: "forensics",
+                _TID_COUNTERS: "health"}
+    for tname, tid in tids.items():
+        if tid in used_tracks:
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": tname}})
+    for tid, tname in reserved.items():
+        if tid in used_tracks:
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": tname}})
+    return meta + events
+
+
+def export_trace(streams):
+    """``[(name, records-iterable)]`` → a trace-event JSON object.  Each
+    stream becomes its own ``pid`` track group (the multi-controller merge
+    view); events are sorted ``(pid, tid, ts)``, metadata first — the
+    layout ``scripts/validate_trace.py`` pins."""
+    meta, events = [], []
+    for pid, (name, records) in enumerate(streams):
+        for e in to_trace_events(records, pid=pid, name=name):
+            (meta if e["ph"] == "M" else events).append(e)
+    events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"]))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def write_trace(path, streams):
+    """Export ``streams`` and write the trace JSON to ``path``; returns the
+    event count."""
+    trace = export_trace(streams)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])

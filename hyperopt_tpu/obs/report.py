@@ -4,6 +4,8 @@ Usage::
 
     python -m hyperopt_tpu.obs.report run.jsonl [--top 5]
     python -m hyperopt_tpu.obs.report --merge run.p0.jsonl run.p1.jsonl ...
+    python -m hyperopt_tpu.obs.report --postmortem run.flight.jsonl
+    python -m hyperopt_tpu.obs.report --export-trace out.json run.jsonl ...
 
 Single-stream sections, matching the telemetry pillars:
 
@@ -26,6 +28,16 @@ execute split, cache hit rates, queue gauges, device FLOP/byte costs).
 ``<path>.p<i>.jsonl``) and renders the cross-controller view instead:
 per-controller summary + phase breakdown, allgather-latency skew, and
 correlated divergence context.
+
+``--postmortem`` renders a flight-recorder dump (``<run>.flight.jsonl``,
+written when a process dies — ``obs/flight.py``) as a last-moments
+narrative: why/when the process died, the spans still open at death, the
+last heartbeat per component (which collective each controller reached),
+stall reports, in-flight trials, and the tail of the record ring.
+
+``--export-trace OUT`` converts the input stream(s) to Chrome/Perfetto
+trace-event JSON (``obs/export.py``; one process track group per stream)
+instead of rendering ASCII — load OUT in https://ui.perfetto.dev.
 """
 
 from __future__ import annotations
@@ -42,9 +54,9 @@ from .events import (
     TRIAL_NEW,
     TRIAL_RECLAIMED,
 )
-from .trace import read_jsonl
+from .trace import iter_jsonl, read_jsonl  # noqa: F401  (read_jsonl re-export)
 
-__all__ = ["main", "render", "render_merged"]
+__all__ = ["main", "render", "render_merged", "render_postmortem"]
 
 _BAR_W = 30
 
@@ -389,12 +401,136 @@ def render_merged(streams):
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# post-mortem view (flight-recorder dumps — obs/flight.py)
+# ---------------------------------------------------------------------------
+
+
+def _last_moments(records, death_ts, out, tail=12):
+    """The ring's final records, as a T-minus timeline."""
+    shown = [r for r in records
+             if r.get("kind") in ("span", "event", "trial_event", "stall",
+                                  "health") and "ts" in r][-tail:]
+    if not shown:
+        out.append("  (empty ring)")
+        return
+    for r in shown:
+        dt = death_ts - r["ts"]
+        kind = r.get("kind")
+        if kind == "span":
+            # a span's ts is its START; the ring appended it at its END —
+            # show when it finished so the timeline reads in ring order
+            dt = death_ts - (r["ts"] + (r.get("wall_sec") or 0.0))
+            what = (f"span {r.get('name', '?')} "
+                    f"({_fmt_sec(r.get('wall_sec'))})")
+            if r.get("error"):
+                what += f"  error={r['error']}"
+        elif kind == "trial_event":
+            what = f"{r.get('event', '?')} tid={r.get('tid')}"
+        elif kind == "stall":
+            what = (f"STALL  quiet {_fmt_sec(r.get('quiet_for_sec'))}  "
+                    f"(#{r.get('stall_count', '?')})")
+        elif kind == "health":
+            what = f"health ask ({r.get('algo', '?')})"
+        else:
+            what = f"event {r.get('name', '?')}"
+        out.append(f"  T-{dt:8.2f}s  {what}")
+
+
+def render_postmortem(records, name=None):
+    """A flight dump (or any obs stream) as a last-moments narrative:
+    death reason, open spans at death, last heartbeat per component,
+    stall reports, in-flight trials, tail of the ring."""
+    recs = list(records)
+    dumps = [r for r in recs if r.get("kind") == "flight_dump"]
+    open_spans = [r for r in recs if r.get("kind") == "open_span"]
+    beat_recs = [r for r in recs if r.get("kind") == "last_heartbeats"]
+    stalls = [r for r in recs if r.get("kind") == "stall"]
+    trial_events = [r for r in recs if r.get("kind") == "trial_event"]
+    ts_all = [r["ts"] for r in recs if "ts" in r]
+    death_ts = dumps[-1]["ts"] if dumps else (max(ts_all) if ts_all else 0.0)
+
+    out = []
+    out.append("== flight dump " + "=" * 49)
+    if dumps:
+        d = dumps[-1]
+        out.append(f"  reason={d.get('reason', '?')}  pid={d.get('pid', '?')}"
+                   f"  records={d.get('n_records', '?')}"
+                   + (f"  stream={name}" if name else ""))
+    else:
+        out.append("  (no flight_dump header — rendering a live stream as a "
+                   "post-mortem)")
+
+    out.append("")
+    out.append("== open spans at death " + "=" * 41)
+    if open_spans:
+        w = max(len(r.get("name", "?")) for r in open_spans)
+        for r in sorted(open_spans, key=lambda r: -r.get("age_sec", 0.0)):
+            out.append(f"  {r.get('name', '?'):<{w}}  open for "
+                       f"{_fmt_sec(r.get('age_sec')):>9}  "
+                       f"thread {r.get('thread', '?')}")
+    else:
+        out.append("  (none — the process died between spans)")
+
+    out.append("")
+    out.append("== last heartbeats " + "=" * 45)
+    beats = (beat_recs[-1].get("beats") or {}) if beat_recs else {}
+    if beats:
+        w = max(len(c) for c in beats)
+        for comp, b in sorted(beats.items(),
+                              key=lambda kv: kv[1].get("age_sec", 0.0)):
+            line = (f"  {comp:<{w}}  {_fmt_sec(b.get('age_sec')):>9} before "
+                    f"death")
+            detail = b.get("detail")
+            if detail:
+                line += "  " + json.dumps(detail, sort_keys=True, default=str)
+            out.append(line)
+    else:
+        out.append("  (no heartbeat record — watchdog disabled or never fed)")
+
+    out.append("")
+    out.append("== stalls " + "=" * 54)
+    if stalls:
+        s = stalls[-1]
+        out.append(f"  {len(stalls)} stall record(s); last: quiet for "
+                   f"{_fmt_sec(s.get('quiet_for_sec'))} "
+                   f"(threshold {_fmt_sec(s.get('quiet_sec'))})")
+        for tname, frames in sorted((s.get("stacks") or {}).items()):
+            out.append(f"  thread {tname}:")
+            for fr in frames[-4:]:
+                out.append(f"    {fr}")
+    else:
+        out.append("  (no stall records — the run was heartbeating until "
+                   "death)")
+
+    out.append("")
+    out.append("== in-flight trials " + "=" * 44)
+    timelines = _trial_timelines(trial_events)
+    inflight = []
+    for tid, t in sorted(timelines.items()):
+        if TRIAL_FINISHED in t or TRIAL_CANCELLED in t:
+            continue
+        start = t.get(TRIAL_CLAIMED, t.get(TRIAL_NEW))
+        state = "claimed" if TRIAL_CLAIMED in t else "queued"
+        age = (death_ts - start) if start is not None else None
+        inflight.append(f"  tid {tid:>6}  {state} "
+                        f"{_fmt_sec(age):>9} before death")
+    out.extend(inflight if inflight
+               else ["  (none — no trial was mid-evaluation)"])
+
+    out.append("")
+    out.append("== last records " + "=" * 48)
+    _last_moments(recs, death_ts, out)
+    return "\n".join(out) + "\n"
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.obs.report",
         description="Render a hyperopt_tpu obs JSONL stream.")
     p.add_argument("jsonl", nargs="+",
-                   help="telemetry stream(s) written by an armed run")
+                   help="telemetry stream(s) written by an armed run, or "
+                        "flight dump(s) with --postmortem")
     p.add_argument("--top", type=int, default=5,
                    help="how many slowest trials to list (single-stream "
                         "report only)")
@@ -402,8 +538,32 @@ def main(argv=None):
                    help="treat the inputs as per-controller streams from "
                         "one fmin_multihost run and render the "
                         "cross-controller view")
+    p.add_argument("--postmortem", action="store_true",
+                   help="render flight-recorder dump(s) as a last-moments "
+                        "narrative")
+    p.add_argument("--export-trace", metavar="OUT",
+                   help="write Chrome/Perfetto trace-event JSON to OUT "
+                        "instead of rendering (each input stream becomes "
+                        "its own process track group)")
     args = p.parse_args(argv)
-    if len(args.jsonl) > 1 and not args.merge:
+    for path in args.jsonl:
+        if not os.path.exists(path):
+            print(f"error: cannot read {path}: no such file",
+                  file=sys.stderr)
+            return 2
+    if args.export_trace:
+        from .export import write_trace
+
+        # iter_jsonl avoids holding the raw JSONL in memory; the converted
+        # trace events themselves still accumulate for the final sort, so
+        # peak memory is one event dict per record
+        n = write_trace(args.export_trace,
+                        [(os.path.basename(path), iter_jsonl(path))
+                         for path in args.jsonl])
+        print(f"wrote {n} trace events to {args.export_trace} "
+              "(load in https://ui.perfetto.dev)")
+        return 0
+    if len(args.jsonl) > 1 and not (args.merge or args.postmortem):
         print("error: multiple streams require --merge", file=sys.stderr)
         return 2
     streams = []
@@ -418,7 +578,10 @@ def main(argv=None):
         print("error: no telemetry records in "
               + ", ".join(args.jsonl), file=sys.stderr)
         return 1
-    if args.merge:
+    if args.postmortem:
+        for name, recs in streams:
+            sys.stdout.write(render_postmortem(recs, name=name))
+    elif args.merge:
         sys.stdout.write(render_merged(streams))
     else:
         sys.stdout.write(render(streams[0][1], top=args.top))

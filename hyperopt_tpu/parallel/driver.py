@@ -151,16 +151,35 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     else:
         pid, P = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
-    if isinstance(obs, RunObs) and P > 1 and obs.config.jsonl_path:
+    if isinstance(obs, RunObs) and P > 1 and (obs.config.jsonl_path
+                                              or obs.config.flight_path):
         # a pre-built bundle must ALSO split per controller — N processes
         # appending to its one stream would interleave records under one
-        # untagged run_id, exactly what the merge view cannot attribute.
-        # Rebuild from its config with the tagged path/run_id instead.
+        # untagged run_id, exactly what the merge view cannot attribute,
+        # and N processes' crash dumps would clobber one flight file.
+        # Rebuild from its config with the tagged paths/run_id instead —
+        # and disarm the parent bundle's process-global hooks first, or
+        # its un-split flight target / stall sink would still collect
+        # every controller's output into the one shared file
+        if obs._flight_target is not None:
+            obs.flight.remove_target(obs._flight_target)
+        elif obs.config.flight_path:
+            # explicit flight paths are persistent targets (not tracked in
+            # _flight_target) — still unsplit at this point, so drop the
+            # shared one before the per-controller rebuild re-arms
+            obs.flight.remove_target(obs.config.flight_path)
+        if obs.watchdog is not None:
+            obs.watchdog.detach_sink(obs.sink)
+            obs.watchdog.release()
         obs = RunObs(
             dataclasses.replace(
                 obs.config,
-                jsonl_path=controller_stream_path(obs.config.jsonl_path,
-                                                  pid)),
+                jsonl_path=(controller_stream_path(obs.config.jsonl_path,
+                                                   pid)
+                            if obs.config.jsonl_path else None),
+                flight_path=(controller_stream_path(obs.config.flight_path,
+                                                    pid)
+                             if obs.config.flight_path else None)),
             run_id=f"{obs.run_id}-p{pid}")
     elif not isinstance(obs, RunObs):
         config = ObsConfig.resolve(obs)
@@ -174,6 +193,11 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             config = dataclasses.replace(
                 config,
                 jsonl_path=controller_stream_path(config.jsonl_path, pid))
+        if P > 1 and config.flight_path:
+            # same per-controller split for crash dumps (see above)
+            config = dataclasses.replace(
+                config,
+                flight_path=controller_stream_path(config.flight_path, pid))
         run_id = f"{config.run_id or 'mh'}-p{pid}" if P > 1 else None
         obs = RunObs(config, run_id=run_id)
     if P > 1:
@@ -264,12 +288,17 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         arrays — the startup sampler — are already whole on every process
         and must NOT be allgathered: process_allgather concatenates local
         arrays.)"""
+        # pre/post marks around the collective: a stall whose last driver
+        # heartbeat is {"point": "proposals", "mark": "pre"} IS a hung
+        # allgather — the post-mortem names the blocked collective
+        obs.heartbeat("driver.allgather", point="proposals", mark="pre")
         t0 = time.perf_counter()
         full = np.asarray(
             multihost_utils.process_allgather(mat, tiled=True)
         ).reshape(batch, len(labels))
         obs.histogram("allgather.proposals_sec").observe(
             time.perf_counter() - t0)
+        obs.heartbeat("driver.allgather", point="proposals", mark="post")
         return {l: full[:, j] for j, l in enumerate(labels)}
 
     digest = hashlib.sha256()
@@ -305,6 +334,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # digest always fresh, so the collective could only ever agree —
         # pure overhead per fmin_multihost call (ADVICE.md round 5).
         obs.counter("resume_agreement_checks").inc()
+        obs.heartbeat("driver.allgather", point="resume", mark="pre")
         t0 = time.perf_counter()
         state8 = np.frombuffer(digest.digest()[:8], np.uint64)[0]
         mine = jnp.asarray(np.asarray([n_done, state8], np.uint64))
@@ -312,6 +342,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             multihost_utils.process_allgather(mine)).reshape(P, 2)
         obs.histogram("allgather.resume_sec").observe(
             time.perf_counter() - t0)
+        obs.heartbeat("driver.allgather", point="resume", mark="post")
         if not (all_s == all_s[0]).all():
             obs.event("resume_disagreement", n_done=int(n_done),
                       states=[[int(x) for x in row] for row in all_s])
@@ -345,6 +376,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             time.perf_counter() - t0)
 
     while n_done < max_evals:
+        obs.heartbeat("driver.gen", gen=gen, n_done=n_done)
         B = min(batch, max_evals - n_done)
         gseed = _gen_seed(seed, gen)
         with obs.span("propose", gen=gen):
@@ -390,12 +422,16 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             width = (B + P - 1) // P
             padded = np.full(width, np.nan, np.float32)
             padded[: len(my_losses)] = my_losses
+            obs.heartbeat("driver.allgather", point="losses", mark="pre",
+                          gen=gen)
             t0 = time.perf_counter()
             gathered = np.asarray(
                 multihost_utils.process_allgather(jnp.asarray(padded))
             ).reshape(P, width)
             obs.histogram("allgather.losses_sec").observe(
                 time.perf_counter() - t0)
+            obs.heartbeat("driver.allgather", point="losses", mark="post",
+                          gen=gen)
             losses = np.full(B, np.nan, np.float32)
             for p in range(P):
                 js = np.arange(p, B, P)
@@ -425,11 +461,15 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # bytes in the same order
         if not single:
             h = int.from_bytes(digest.digest()[:8], "big")
+            obs.heartbeat("driver.allgather", point="checksum", mark="pre",
+                          gen=gen)
             t0 = time.perf_counter()
             all_h = np.asarray(multihost_utils.process_allgather(
                 jnp.asarray(np.uint64(h))))
             obs.histogram("allgather.checksum_sec").observe(
                 time.perf_counter() - t0)
+            obs.heartbeat("driver.allgather", point="checksum", mark="post",
+                          gen=gen)
             if not np.all(all_h == all_h.reshape(-1)[0]):
                 # post-mortem context dump: everything a human needs to see
                 # WHICH controller diverged and on what data, persisted to

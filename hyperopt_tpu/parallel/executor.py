@@ -37,6 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..obs import EventLog, MetricsRegistry
+from ..obs.watchdog import beat as _wd_beat
 from ..obs.events import (
     TRIAL_CANCELLED,
     TRIAL_CLAIMED,
@@ -234,6 +235,10 @@ class ExecutorTrials(Trials):
         if domain is None or not self._claim(trial):
             return
         self._worker_busy(+1)
+        # per-trial progress beats feed the stall watchdog: an objective
+        # hung past "start" with no "finish" shows up by name in the
+        # stall report's last-heartbeat table
+        _wd_beat("executor.trial", tid=trial["tid"], mark="start")
         t0 = time.perf_counter()
         try:
             spec = spec_from_misc(trial["misc"])
@@ -247,6 +252,7 @@ class ExecutorTrials(Trials):
             self.metrics.counter("worker_busy_sec").inc(
                 time.perf_counter() - t0)
             self._worker_busy(-1)
+            _wd_beat("executor.trial", tid=trial["tid"], mark="finish")
 
     def _run_batch(self, trials_batch):
         """Evaluate a queue of trials as ONE vmapped device program."""
@@ -257,6 +263,7 @@ class ExecutorTrials(Trials):
         if not claimed:
             return
         self._worker_busy(+1)
+        _wd_beat("executor.batch", n=len(claimed), mark="start")
         t0 = time.perf_counter()
         self.metrics.counter("batch_evals").inc()
         try:
@@ -290,6 +297,7 @@ class ExecutorTrials(Trials):
             self.metrics.counter("worker_busy_sec").inc(
                 time.perf_counter() - t0)
             self._worker_busy(-1)
+            _wd_beat("executor.batch", n=len(claimed), mark="finish")
 
     # -- Trials overrides --------------------------------------------------
 

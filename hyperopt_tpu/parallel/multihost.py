@@ -108,13 +108,20 @@ def replicate_global(tree, mesh):
     single-process ``device_put``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..obs.watchdog import beat as _wd_beat
+
     rep = NamedSharding(mesh, P())
 
     def put(x):
         x = np.asarray(x)
         return jax.make_array_from_callback(x.shape, rep, lambda idx: x[idx])
 
-    return jax.tree.map(put, tree)
+    # liveness mark before handing the history to the runtime: device_put
+    # onto a multi-process mesh can block on a peer that never arrives
+    _wd_beat("multihost.replicate", mark="pre")
+    out = jax.tree.map(put, tree)
+    _wd_beat("multihost.replicate", mark="post")
+    return out
 
 
 def global_key_batch(seed, batch, mesh, axis=None):

@@ -24,6 +24,7 @@ import time
 
 from .base import Ctrl, JOB_STATE_NEW, JOB_STATE_RUNNING, spec_from_misc
 from .filestore import FileStore, FileTrials, ReserveTimeout
+from .obs.watchdog import beat as _wd_beat, get_watchdog
 
 __all__ = ["FileWorker", "main"]
 
@@ -43,6 +44,16 @@ class FileWorker:
         self.workdir = workdir
         self.owner = f"{socket.gethostname()}:{os.getpid()}"
         self._domain = None
+        # forensics: a SIGTERM'd/crashed worker dumps its flight ring into
+        # the store's attachments (flight.<owner>.jsonl) — the driver can
+        # post-mortem every worker that ever died on this store
+        self.flight_dump = self.store.arm_flight(self.owner)
+        # a worker IS a live run for its whole process lifetime: without
+        # the retain, the run-scoped watchdog would never consider this
+        # process active and stall detection would silently no-op here
+        wd = get_watchdog()
+        if wd is not None:
+            wd.retain()
 
     def _get_domain(self):
         if self._domain is None:
@@ -59,6 +70,7 @@ class FileWorker:
         Raises ReserveTimeout if nothing could be claimed in time."""
         deadline = None if reserve_timeout is None else time.time() + reserve_timeout
         while True:
+            _wd_beat("worker.poll", owner=self.owner)
             self.store.reclaim_stale(self.stale_after)
             doc = self.store.reserve(self.owner)
             if doc is not None:
@@ -86,6 +98,9 @@ class FileWorker:
         def beat():
             while not stop.wait(self.heartbeat_interval):
                 self.store.heartbeat(doc)
+                # the store heartbeat proves the THREAD is alive; this one
+                # tells the stall watchdog which trial the worker is inside
+                _wd_beat("worker.trial", tid=doc["tid"], owner=self.owner)
 
         hb = threading.Thread(target=beat, daemon=True)
         hb.start()

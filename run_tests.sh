@@ -5,10 +5,16 @@
 # Opt-in perf gate: BENCH_GATE=1 additionally compares the two newest
 # BENCH_r*.json artifacts (scripts/bench_gate.py) and fails on a
 # regression; with fewer than two rounds recorded it passes.
+# Opt-in trace gate: TRACE_GATE=1 additionally runs a tiny armed
+# two-controller run end-to-end, exports it via obs.report --export-trace
+# and validates the trace-event invariants (scripts/validate_trace.py).
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 if [ "${BENCH_GATE:-0}" = "1" ]; then
     python scripts/bench_gate.py || exit 1
+fi
+if [ "${TRACE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --self-test || exit 1
 fi
 exit 0

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Validate Chrome/Perfetto trace-event JSON emitted by ``obs.report
+--export-trace`` (hyperopt_tpu/obs/export.py).
+
+Checked invariants — the contract a trace viewer actually relies on:
+
+* top level is ``{"traceEvents": [...]}`` (object form) or a bare event
+  array;
+* every event is an object with a known ``ph`` (``X i B E M C``);
+* non-metadata events carry numeric ``ts`` >= 0 and integer ``pid``/``tid``;
+* complete (``X``) events have ``dur`` >= 0;
+* duration ``B``/``E`` events are matched per ``(pid, tid)`` track (no
+  dangling begin, no end-without-begin);
+* per ``(pid, tid)`` track, non-metadata events appear in non-decreasing
+  ``ts`` file order (the exporter sorts; a violation means a broken merge);
+* metadata (``M``) events precede all others (the exporter's layout).
+
+Exit 0 when every input validates, 1 otherwise, 2 on unreadable input.
+
+``--self-test`` runs the whole pipeline end to end on CPU: a tiny armed
+two-controller run (the ``fmin_multihost`` per-controller stream naming),
+``obs.report --export-trace`` over the merged streams, then validation —
+the opt-in CI gate ``TRACE_GATE=1 ./run_tests.sh`` wires this in next to
+``bench_gate.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_KNOWN_PH = {"X", "i", "I", "B", "E", "M", "C"}
+
+
+def validate_events(events):
+    """Return a list of human-readable violations (empty = valid)."""
+    errors = []
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    last_ts = {}  # (pid, tid) -> last seen ts
+    begin_stack = {}  # (pid, tid) -> [names]
+    seen_non_meta = False
+    for i, e in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if ph not in _KNOWN_PH:
+            errors.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            if seen_non_meta:
+                errors.append(f"{where}: metadata after timeline events")
+            continue
+        seen_non_meta = True
+        pid, tid, ts = e.get("pid"), e.get("tid"), e.get("ts")
+        if not isinstance(pid, int) or not isinstance(tid, int):
+            errors.append(f"{where}: non-integer pid/tid ({pid!r}/{tid!r})")
+            continue
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"{where}: bad ts {ts!r}")
+            continue
+        track = (pid, tid)
+        prev = last_ts.get(track)
+        if prev is not None and ts < prev:
+            errors.append(
+                f"{where}: ts goes backwards on track pid={pid} tid={tid} "
+                f"({ts} < {prev})")
+        last_ts[track] = ts
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"{where}: X event with bad dur {dur!r}")
+        elif ph == "B":
+            begin_stack.setdefault(track, []).append(e.get("name"))
+        elif ph == "E":
+            stack = begin_stack.get(track)
+            if not stack:
+                errors.append(
+                    f"{where}: E without matching B on track pid={pid} "
+                    f"tid={tid}")
+            else:
+                stack.pop()
+    for (pid, tid), stack in begin_stack.items():
+        for name in stack:
+            errors.append(
+                f"unclosed B event {name!r} on track pid={pid} tid={tid}")
+    return errors
+
+
+def validate_file(path):
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"cannot load {path}: {e}"]
+    events = data.get("traceEvents") if isinstance(data, dict) else data
+    if events is None:
+        return [f"{path}: no traceEvents key"]
+    return validate_events(events)
+
+
+def _self_test():
+    """End-to-end: armed two-controller run → merged export → validate."""
+    import os
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runnable from anywhere: the repo root is this script's parent
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.obs import ObsConfig, RunObs
+    from hyperopt_tpu.obs import report
+    from hyperopt_tpu.obs.health import controller_stream_path
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    space = {"x": hp.uniform("x", -5, 5)}
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "run.jsonl")
+        streams = []
+        # two controllers' streams, exactly as a 2-process fmin_multihost
+        # names them (run.p0.jsonl / run.p1.jsonl, run_id tagged -p<i>)
+        for pidx in range(2):
+            path = controller_stream_path(base, pidx)
+            obs = RunObs(ObsConfig(level="trace", jsonl_path=path),
+                         run_id=f"mh-p{pidx}")
+            fmin_multihost(lambda s: (s["x"] - 1.0) ** 2, space,
+                           max_evals=4, batch=2, seed=0, obs=obs,
+                           _force_single=True)
+            streams.append(path)
+        out = os.path.join(d, "trace.json")
+        rc = report.main(["--export-trace", out] + streams)
+        if rc != 0:
+            print("self-test: --export-trace failed", file=sys.stderr)
+            return 1
+        errors = validate_file(out)
+        if errors:
+            print("self-test: exported trace is INVALID:", file=sys.stderr)
+            for e in errors:
+                print("  " + e, file=sys.stderr)
+            return 1
+        with open(out) as f:
+            events = json.load(f)["traceEvents"]
+        n_groups = len({e.get("pid") for e in events})
+        if n_groups != len(streams):
+            print(f"self-test: expected {len(streams)} process track "
+                  f"groups, got {n_groups}", file=sys.stderr)
+            return 1
+        print(f"self-test OK: {len(events)} events across {n_groups} "
+              "controller track groups validate")
+        return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python scripts/validate_trace.py",
+        description="Validate Chrome/Perfetto trace-event JSON.")
+    p.add_argument("traces", nargs="*", help="trace JSON file(s) to check")
+    p.add_argument("--self-test", action="store_true",
+                   help="generate a merged two-controller run end-to-end "
+                        "and validate its export (the CI gate)")
+    args = p.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.traces:
+        p.error("give trace file(s) or --self-test")
+    rc = 0
+    for path in args.traces:
+        errors = validate_file(path)
+        if errors:
+            rc = 1
+            print(f"{path}: INVALID")
+            for e in errors:
+                print("  " + e)
+        else:
+            print(f"{path}: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Child process for the signal-path forensics test (tests/test_flight.py).
+
+Runs a host-loop ``fmin`` whose objective signals readiness after a few
+trials and then blocks; the parent SIGTERMs the process mid-``evaluate``
+and asserts the flight recorder dumped a parseable ``*.flight.jsonl``
+(armed purely via ``HYPEROPT_TPU_FLIGHT`` — the obs stream itself stays
+disarmed, which is exactly the "disarmed run leaves forensics anyway"
+property the tentpole exists for).
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+
+def main():
+    ready_path = sys.argv[1]
+    n_before_hang = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    state = {"n": 0}
+
+    def objective(d):
+        state["n"] += 1
+        if state["n"] >= n_before_hang:
+            with open(ready_path, "w") as f:
+                f.write("ready")
+            time.sleep(300)  # the parent SIGTERMs us inside this evaluate
+        return (d["x"] - 1.0) ** 2
+
+    fmin(objective, {"x": hp.uniform("x", -5, 5)}, algo=rand.suggest,
+         max_evals=50, trials=Trials(), rstate=np.random.default_rng(0),
+         show_progressbar=False)
+
+
+if __name__ == "__main__":
+    main()

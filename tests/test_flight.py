@@ -1,0 +1,531 @@
+"""Crash & stall forensics (hyperopt_tpu/obs/{flight,watchdog,export}.py):
+flight-recorder ring + signal dumps, hang watchdog, Perfetto export, and
+the post-mortem renderer.
+
+All tier-1 (CPU, fast).  The signal-path test is a real subprocess killed
+mid-``fmin`` — the acceptance scenario: a SIGTERM'd child leaves a
+parseable ``*.flight.jsonl`` that ``obs.report --postmortem`` renders.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+from hyperopt_tpu.obs import get_flight, read_jsonl
+from hyperopt_tpu.obs.flight import FlightRecorder, flight_path_for
+from hyperopt_tpu.obs.report import main as report_main, render_postmortem
+from hyperopt_tpu.obs.trace import JsonlSink, Tracer, iter_jsonl
+from hyperopt_tpu.obs.watchdog import Watchdog
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import validate_trace  # noqa: E402  (scripts/validate_trace.py)
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring bounds, always-on feed, dump lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_count_and_bytes():
+    fr = FlightRecorder(max_records=8, max_bytes=1 << 20)
+    for i in range(100):
+        fr.record({"kind": "event", "name": f"e{i}", "ts": float(i)})
+    recs = fr.records()
+    assert len(recs) == 8
+    assert recs[0]["name"] == "e92" and recs[-1]["name"] == "e99"
+
+    # byte bound trips before the count bound for fat records
+    fr = FlightRecorder(max_records=10_000, max_bytes=2_000)
+    for i in range(1_000):
+        fr.record({"kind": "event", "name": "x" * 100, "ts": float(i)})
+    assert len(fr.records()) < 100  # ~150 estimated bytes per record
+    assert fr._bytes <= 2_000
+
+
+def test_flight_dump_enforces_exact_byte_budget(tmp_path):
+    fr = FlightRecorder(max_records=10_000, max_bytes=3_000)
+    fr.max_bytes = 10 ** 9  # let the ring grow...
+    for i in range(200):
+        fr.record({"kind": "event", "name": "y" * 50, "ts": float(i)})
+    fr.max_bytes = 3_000  # ...then dump under a tight exact budget
+    path = tmp_path / "budget.flight.jsonl"
+    fr.dump("test", path=str(path))
+    assert os.path.getsize(path) <= 3_000 + 200  # header + slack
+    recs = read_jsonl(path)
+    # newest records survive the budget; the header still leads
+    assert recs[0]["kind"] == "flight_dump"
+    assert recs[-1]["name"] == "y" * 50
+
+
+def test_disarmed_spans_feed_flight_ring():
+    fr = get_flight()
+    fr.clear()
+    tr = Tracer(run_id="flight-t")  # no sink: the disarmed fast path
+    with tr.span("suggest"):
+        pass
+    tr.event("stop_reason", why="test")
+    names = [(r.get("kind"), r.get("name")) for r in fr.records()]
+    assert ("span", "suggest") in names
+    assert ("event", "stop_reason") in names
+
+
+def test_disarmed_fmin_leaves_flight_records():
+    fr = get_flight()
+    fr.clear()
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=4,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    kinds = {(r.get("kind"), r.get("name", r.get("event")))
+             for r in fr.records()}
+    assert ("span", "suggest") in kinds
+    assert ("trial_event", "trial_finished") in kinds
+
+
+def test_open_spans_reported_in_dump(tmp_path):
+    fr = FlightRecorder()
+    tr = Tracer(flight=fr)
+    path = str(tmp_path / "open.flight.jsonl")
+    with tr.span("evaluate"):
+        fr.dump("mid-span", path=path)
+    recs = read_jsonl(path)
+    opened = [r for r in recs if r.get("kind") == "open_span"]
+    assert [r["name"] for r in opened] == ["evaluate"]
+    assert opened[0]["age_sec"] >= 0
+    assert opened[0]["thread"] == "MainThread"
+    # after a clean exit the span is closed: a later dump reports none
+    fr.dump("after", path=path)
+    assert not [r for r in read_jsonl(path) if r.get("kind") == "open_span"]
+
+
+def test_armed_fmin_derives_and_releases_flight_target(tmp_path):
+    path = str(tmp_path / "armed.jsonl")
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=3,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    # the derived per-run target (armed.flight.jsonl) was removed at
+    # finish(): clean exits must not litter
+    assert flight_path_for(path) not in get_flight()._targets
+
+
+# ---------------------------------------------------------------------------
+# satellite: _Span stack-leak fix (disarm mid-span)
+# ---------------------------------------------------------------------------
+
+
+def test_span_stack_survives_midspan_disarm(tmp_path):
+    tr = Tracer(sink=JsonlSink(tmp_path / "mid.jsonl"), run_id="t")
+    with tr.span("outer"):
+        tr.sink = None  # disarmed mid-span (RunObs.finish on re-entry)
+    # the armed __enter__ pushed; the disarmed __exit__ must still pop —
+    # otherwise every later span on this thread nests under a ghost
+    assert tr._stack() == []
+    with tr.span("after") as s:
+        assert s._pushed is False  # disarmed now: no stack bookkeeping
+    tr.sink = JsonlSink(tmp_path / "mid2.jsonl")
+    with tr.span("rearmed") as s:
+        assert s.depth == 0 and s.parent_id is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: JsonlSink survives a dead filesystem
+# ---------------------------------------------------------------------------
+
+
+def test_sink_disables_on_oserror_instead_of_raising(tmp_path, caplog):
+    target = tmp_path / "is_a_dir.jsonl"
+    target.mkdir()  # open() will raise IsADirectoryError (an OSError)
+    sink = JsonlSink(target)
+    with caplog.at_level("ERROR", logger="hyperopt_tpu.obs.trace"):
+        sink.write({"kind": "span", "name": "a"})  # must not raise
+        sink.write({"kind": "span", "name": "b"})
+        sink.write({"kind": "span", "name": "c"})
+    assert sink._dead
+    # log-once: the disable is reported exactly one time
+    assert sum("disabling the JSONL stream" in r.message
+               for r in caplog.records) == 1
+    # the instrumented path keeps working on the dead sink
+    tr = Tracer(sink=sink, run_id="dead")
+    with tr.span("still_fine"):
+        pass
+    assert tr._stack() == []
+    # pickling resets the latch: a resumed process retries fresh
+    import pickle
+
+    sink2 = pickle.loads(pickle.dumps(sink))
+    assert sink2._dead is False
+
+
+# ---------------------------------------------------------------------------
+# satellite: streaming reader
+# ---------------------------------------------------------------------------
+
+
+def test_iter_jsonl_streams_and_wrapper_matches(tmp_path):
+    import types
+
+    path = tmp_path / "s.jsonl"
+    with open(path, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"kind": "event", "i": i}) + "\n")
+        f.write('{"kind": "event", "i": 5, "torn')  # killed mid-write
+    it = iter_jsonl(path)
+    assert isinstance(it, types.GeneratorType)
+    assert next(it)["i"] == 0  # lazily consumable, record by record
+    rest = list(it)
+    assert [r["i"] for r in rest] == [1, 2, 3, 4]  # torn line skipped
+    assert read_jsonl(path) == list(iter_jsonl(path))
+
+
+# ---------------------------------------------------------------------------
+# watchdog: fake clock — once per quiet period, not per tick
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def write(self, rec):
+        self.records.append(rec)
+
+
+def test_watchdog_fires_once_per_quiet_period():
+    clock = _Clock()
+    wd = Watchdog(quiet_sec=300.0, clock=clock, flight=FlightRecorder())
+    wd.retain()  # a run is live (RunObs does this)
+    sink = _ListSink()
+    wd.attach_sink(sink)
+    wd.beat("fmin.tick", n=1)
+
+    # ticks inside the quiet period: silent
+    for t in (10.0, 100.0, 299.0):
+        clock.t = t
+        assert wd.check() is None
+    # first tick past the quiet period: exactly one stall
+    clock.t = 301.0
+    rec = wd.check()
+    assert rec is not None and rec["kind"] == "stall"
+    # subsequent ticks in the SAME quiet period: silent, not per-tick
+    for t in (302.0, 350.0, 500.0, 600.9):
+        clock.t = t
+        assert wd.check() is None
+    # a second full quiet period of silence: the next (single) report
+    clock.t = 601.1
+    assert wd.check() is not None
+    assert wd.stall_count == 2
+    assert len(sink.records) == 2
+
+    # recovery re-arms: a beat, then silence, fires again after quiet_sec
+    clock.t = 700.0
+    wd.beat("fmin.tick", n=2)
+    clock.t = 900.0
+    assert wd.check() is None
+    clock.t = 1000.5
+    rec = wd.check()
+    assert rec is not None and wd.stall_count == 3
+
+
+def test_watchdog_quiesces_without_live_runs():
+    clock = _Clock()
+    wd = Watchdog(quiet_sec=10.0, clock=clock, flight=FlightRecorder())
+    wd.retain()
+    wd.beat("fmin.tick")
+    wd.release()  # the run finished (RunObs.finish)
+    # the process outlives the run: NEVER report its idleness as a stall
+    for t in (100.0, 1000.0, 100000.0):
+        clock.t = t
+        assert wd.check() is None
+    # a resumed run (rearm) re-enables detection
+    wd.retain()
+    clock.t += 50.0
+    assert wd.check() is not None
+
+
+def test_watchdog_stall_record_contents():
+    clock = _Clock()
+    fr = FlightRecorder()
+    wd = Watchdog(quiet_sec=60.0, clock=clock, flight=fr)
+    wd.retain()
+    wd.beat("driver.allgather", point="losses", mark="pre", gen=7)
+    clock.t = 100.0
+    rec = wd.check()
+    beats = rec["last_heartbeats"]
+    assert beats["driver.allgather"]["age_sec"] == pytest.approx(100.0)
+    # the named blocked collective: detail survives verbatim
+    assert beats["driver.allgather"]["detail"] == {
+        "point": "losses", "mark": "pre", "gen": 7}
+    # this (main) thread's stack is captured, watchdog-free
+    assert any("MainThread" in name for name in rec["stacks"])
+    frames = rec["stacks"]["MainThread"]
+    assert any("test_flight" in f for f in frames)
+    # the stall landed in the flight ring too
+    assert any(r.get("kind") == "stall" for r in fr.records())
+
+
+def test_fmin_feeds_global_watchdog():
+    from hyperopt_tpu.obs.watchdog import get_watchdog
+
+    wd = get_watchdog()
+    if wd is None:
+        pytest.skip("global watchdog disabled via HYPEROPT_TPU_WATCHDOG")
+    wd._beats.pop("fmin.tick", None)
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=3,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert "fmin.tick" in wd._beats
+    assert "fmin.evaluate" in wd._beats
+
+
+# ---------------------------------------------------------------------------
+# signal-path forensics: SIGTERM'd child leaves a renderable flight dump
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_child_leaves_parseable_flight_dump(tmp_path, capsys):
+    flight_path = str(tmp_path / "child.flight.jsonl")
+    ready_path = str(tmp_path / "ready")
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_flight_child.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "",
+           "PYTHONPATH": repo_root + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "HYPEROPT_TPU_FLIGHT": flight_path}
+    proc = subprocess.Popen([sys.executable, child, ready_path],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, cwd=repo_root)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(ready_path):
+            assert proc.poll() is None, (
+                "child died before hanging:\n"
+                + proc.stderr.read().decode()[-2000:])
+            assert time.time() < deadline, "child never reached the hang"
+            time.sleep(0.1)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == -signal.SIGTERM  # default disposition preserved
+
+    # the dump exists and parses with the ordinary JSONL reader
+    assert os.path.exists(flight_path)
+    recs = read_jsonl(flight_path)
+    kinds = {r.get("kind") for r in recs}
+    assert "flight_dump" in kinds
+    head = [r for r in recs if r["kind"] == "flight_dump"][-1]
+    assert head["reason"] == "signal:SIGTERM"
+    # the process died INSIDE evaluate: reported as an open span
+    open_names = {r["name"] for r in recs if r.get("kind") == "open_span"}
+    assert "evaluate" in open_names and "run" in open_names
+    # trial lifecycle made it into the ring: the hanging trial is claimed
+    # but never finished
+    claimed = {r["tid"] for r in recs
+               if r.get("event") == "trial_claimed"}
+    finished = {r["tid"] for r in recs
+                if r.get("event") == "trial_finished"}
+    assert claimed - finished, "the hanging trial should be in flight"
+    # faulthandler wiring: the hard-fault file was armed next to the dump
+    assert os.path.exists(flight_path + ".faults")
+
+    # --postmortem renders it (the golden-substring contract)
+    assert report_main(["--postmortem", flight_path]) == 0
+    out = capsys.readouterr().out
+    assert "reason=signal:SIGTERM" in out
+    assert "open spans at death" in out
+    assert "evaluate" in out
+    assert "in-flight trials" in out
+    assert "last records" in out
+
+
+# ---------------------------------------------------------------------------
+# post-mortem renderer (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_render_postmortem_sections():
+    t0 = 1000.0
+    recs = [
+        {"kind": "span", "name": "suggest", "ts": t0 - 5.0,
+         "wall_sec": 0.2},
+        {"kind": "trial_event", "event": "trial_new", "tid": 3,
+         "ts": t0 - 4.0},
+        {"kind": "trial_event", "event": "trial_claimed", "tid": 3,
+         "ts": t0 - 3.5},
+        {"kind": "stall", "ts": t0 - 1.0, "quiet_sec": 1.0,
+         "quiet_for_sec": 2.5, "stall_count": 1,
+         "stacks": {"MainThread": ["f.py:1 hang"]},
+         "last_heartbeats": {}},
+        {"kind": "flight_dump", "reason": "signal:SIGTERM", "ts": t0,
+         "pid": 42, "n_records": 4},
+        {"kind": "open_span", "name": "evaluate", "ts": t0 - 3.0,
+         "age_sec": 3.0, "thread": "MainThread"},
+        {"kind": "last_heartbeats", "ts": t0, "beats": {
+            "driver.allgather": {"age_sec": 2.0, "ts": t0 - 2.0,
+                                 "detail": {"point": "losses",
+                                            "mark": "pre"}}}},
+    ]
+    text = render_postmortem(recs, name="child.flight.jsonl")
+    assert "reason=signal:SIGTERM" in text
+    assert "evaluate" in text and "open for" in text
+    assert "driver.allgather" in text and '"point": "losses"' in text
+    assert "STALL" in text or "stall record" in text
+    assert "tid      3" in text and "claimed" in text
+    assert "f.py:1 hang" in text
+
+
+def test_render_postmortem_tolerates_plain_stream():
+    # a live (non-dump) stream still renders — no flight_dump header
+    text = render_postmortem([
+        {"kind": "span", "name": "suggest", "ts": 1.0, "wall_sec": 0.1}])
+    assert "no flight_dump header" in text
+
+
+# ---------------------------------------------------------------------------
+# trace export + validator
+# ---------------------------------------------------------------------------
+
+
+def test_export_trace_single_stream_validates(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    fmin(quad, SPACE, algo=rand.suggest, max_evals=5,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    out = str(tmp_path / "run.trace.json")
+    assert report_main(["--export-trace", out, path]) == 0
+    assert validate_trace.validate_file(out) == []
+    with open(out) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {"run", "suggest", "evaluate"} <= {e["name"] for e in spans}
+    trials = [e for e in events if e.get("cat") == "trial"]
+    assert len(trials) >= 10  # new/claimed/finished per trial
+    # process metadata names the stream
+    meta = [e for e in events if e["ph"] == "M"
+            and e["name"] == "process_name"]
+    assert meta and meta[0]["args"]["name"] == "run.jsonl"
+
+
+def test_export_trace_merged_controllers_validates(tmp_path):
+    from hyperopt_tpu.obs import ObsConfig, RunObs
+    from hyperopt_tpu.obs.health import controller_stream_path
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    base = str(tmp_path / "mh.jsonl")
+    streams = []
+    for pidx in range(2):
+        p = controller_stream_path(base, pidx)
+        obs = RunObs(ObsConfig(level="trace", jsonl_path=p),
+                     run_id=f"mh-p{pidx}")
+        fmin_multihost(quad, SPACE, max_evals=4, batch=2, seed=0, obs=obs,
+                       _force_single=True)
+        streams.append(p)
+    out = str(tmp_path / "mh.trace.json")
+    assert report_main(["--merge", "--export-trace", out] + streams) == 0
+    assert validate_trace.validate_file(out) == []
+    with open(out) as f:
+        events = json.load(f)["traceEvents"]
+    # controllers land in separate track groups, each named after its file
+    assert {e["pid"] for e in events} == {0, 1}
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {"mh.p0.jsonl", "mh.p1.jsonl"}
+    # propose/evaluate/fold spans exist per controller
+    for pid in (0, 1):
+        spans = {e["name"] for e in events
+                 if e["ph"] == "X" and e["pid"] == pid}
+        assert {"propose", "evaluate", "fold"} <= spans
+
+
+def test_validator_rejects_broken_traces():
+    ok = [{"name": "p", "ph": "M", "pid": 0, "tid": 0, "args": {}},
+          {"name": "a", "ph": "X", "ts": 1.0, "dur": 2.0,
+           "pid": 0, "tid": 0}]
+    assert validate_trace.validate_events(ok) == []
+    # non-monotonic ts on one track
+    bad_ts = ok + [{"name": "b", "ph": "X", "ts": 0.5, "dur": 1.0,
+                    "pid": 0, "tid": 0}]
+    assert any("backwards" in e for e in validate_trace.validate_events(bad_ts))
+    # negative duration
+    bad_dur = [{"name": "a", "ph": "X", "ts": 1.0, "dur": -2.0,
+                "pid": 0, "tid": 0}]
+    assert any("bad dur" in e for e in validate_trace.validate_events(bad_dur))
+    # unmatched B/E
+    dangling = [{"name": "a", "ph": "B", "ts": 1.0, "pid": 0, "tid": 0}]
+    assert any("unclosed" in e
+               for e in validate_trace.validate_events(dangling))
+    orphan_e = [{"name": "a", "ph": "E", "ts": 1.0, "pid": 0, "tid": 0}]
+    assert any("E without" in e
+               for e in validate_trace.validate_events(orphan_e))
+    # unknown phase
+    assert any("unknown ph" in e for e in validate_trace.validate_events(
+        [{"name": "a", "ph": "Z", "ts": 1.0, "pid": 0, "tid": 0}]))
+
+
+# ---------------------------------------------------------------------------
+# filestore: flight dumps as attachments
+# ---------------------------------------------------------------------------
+
+
+def test_fileworker_retains_watchdog_and_arms_flight(tmp_path):
+    from hyperopt_tpu.obs.watchdog import get_watchdog
+    from hyperopt_tpu.worker import FileWorker
+
+    wd = get_watchdog()
+    before = wd._active if wd is not None else None
+    w = FileWorker(str(tmp_path / "store"))
+    try:
+        # the worker's crash dump lands inside the store it serves
+        assert w.flight_dump.startswith(
+            os.path.join(str(tmp_path / "store"), "attachments"))
+        assert w.flight_dump in get_flight()._targets
+        if wd is not None:
+            # a standalone worker counts as a live run, or stall detection
+            # would silently no-op in worker processes
+            assert wd._active == before + 1
+    finally:
+        get_flight().remove_target(w.flight_dump)
+        if wd is not None:
+            wd.release()
+
+
+def test_filestore_flight_dump_attachment_roundtrip(tmp_path):
+    from hyperopt_tpu.filestore import FileStore
+
+    store = FileStore(str(tmp_path / "store"))
+    path = store.flight_dump_path("host:123")
+    assert os.path.dirname(path).endswith("attachments")
+    assert ":" not in os.path.basename(path)
+    fr = FlightRecorder()
+    fr.record({"kind": "event", "name": "worker_died", "ts": 1.0})
+    fr.dump("signal:SIGKILL-adjacent", path=path)
+    dumps = store.read_flight_dumps()
+    assert list(dumps) == ["host-123"]
+    assert any(r.get("name") == "worker_died" for r in dumps["host-123"])
+    # arm_flight registers the store path on the global recorder
+    armed = store.arm_flight("host:456")
+    assert armed in get_flight()._targets
+    get_flight().remove_target(armed)  # leave the global state clean
