@@ -17,9 +17,10 @@ import re
 __all__ = ["forced_cpu_env", "enable_persistent_compilation_cache"]
 
 _CACHE_CONFIGURED = False
+_EXPLICIT_DIR = None  # the explicit dir currently configured, if any
 
 
-def enable_persistent_compilation_cache():
+def enable_persistent_compilation_cache(cache_dir=None):
     """Point jax at an on-disk compilation cache (once per process) unless
     the user already configured one or opted out via
     ``HYPEROPT_TPU_NO_CACHE=1``.
@@ -29,13 +30,49 @@ def enable_persistent_compilation_cache():
     cost is paid once per MACHINE instead of once per process — every later
     "cold" ``fmin`` starts near-warm.  Called lazily by the fmin entry
     points, never at import (mutating global jax config on import would
-    surprise embedders)."""
+    surprise embedders).
+
+    ``cache_dir`` (or ``HYPEROPT_TPU_COMPILE_CACHE=<dir>``) pins the cache
+    directory EXPLICITLY: no per-machine fingerprint partitioning (the
+    caller owns dir hygiene across config changes), and the
+    min-compile-time floor drops to 0 so even sub-second kernels cache —
+    the setting bench's ``compile_cache`` stage measures cold-vs-warm
+    with.  An explicit dir wins over an earlier automatic configuration.
+    """
     global _CACHE_CONFIGURED
     opt_out = os.environ.get("HYPEROPT_TPU_NO_CACHE", "").strip().lower()
-    if _CACHE_CONFIGURED or opt_out not in ("", "0", "false", "no"):
+    if opt_out not in ("", "0", "false", "no"):
+        return
+    explicit = (str(cache_dir) if cache_dir
+                else os.environ.get("HYPEROPT_TPU_COMPILE_CACHE", "").strip()
+                or None)
+    global _EXPLICIT_DIR
+    if _CACHE_CONFIGURED and (explicit is None or explicit == _EXPLICIT_DIR):
+        return
+    import jax
+
+    if explicit is not None:
+        path = os.path.abspath(os.path.expanduser(explicit))
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+            _CACHE_CONFIGURED = True
+            _EXPLICIT_DIR = explicit
+            return
+        except Exception as e:
+            # an unwritable EXPLICIT dir must not silently disable caching
+            # wholesale: warn once and fall through to the automatic
+            # per-machine dir, which is what an unset variable would use
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "compilation cache dir %s unusable (%s); falling back to "
+                "the automatic per-machine cache", path, e)
+    if _CACHE_CONFIGURED:
         return
     _CACHE_CONFIGURED = True
-    import jax
 
     if getattr(jax.config, "jax_compilation_cache_dir", None):
         return  # user (or bench harness) already picked a cache dir

@@ -15,8 +15,35 @@ import jax
 
 from ..utils import LRUCache
 
-__all__ = ["suggest", "suggest_batch", "flat_to_new_trial_docs", "seed_to_key",
+__all__ = ["suggest", "suggest_async", "suggest_batch", "AskHandle",
+           "flat_to_new_trial_docs", "seed_to_key",
            "fold_ids", "pad_ids_pow2", "pad_ids_sticky"]
+
+
+class AskHandle:
+    """One dispatched ask: the suggest program is already in flight on the
+    accelerator; :meth:`result` performs the (blocking) readback and builds
+    the reference-shaped trial docs.
+
+    This is the seam the pipelined host loop overlaps on: ``fmin``'s
+    ``lookahead=N`` dispatches the next batch's handle before evaluating
+    the current trials, and only awaits it when the objective actually
+    needs the values.  Dispatch-then-immediate-``result()`` is the plain
+    synchronous ask, bit-identical to calling ``suggest`` directly.
+    """
+
+    def __init__(self, new_ids, finish):
+        self.new_ids = list(new_ids)
+        self._finish = finish
+        self._docs = None
+
+    def result(self):
+        """Block on the packed proposal buffer and return the trial docs
+        (idempotent)."""
+        if self._finish is not None:
+            self._docs = self._finish()
+            self._finish = None
+        return self._docs
 
 
 def seed_to_key(seed):
@@ -155,6 +182,28 @@ def _get_sample_jit(domain):
     return fn
 
 
+def suggest_async(new_ids, domain, trials, seed):
+    """Dispatch the batched prior-sample program and return an
+    :class:`AskHandle`; the readback (and doc building) happens in its
+    ``result()``.  ``suggest`` below is dispatch + immediate result."""
+    if not len(new_ids):
+        return AskHandle([], lambda: [])
+    seed = int(seed)
+    seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
+    mat = _get_sample_jit(domain)(seed_words, pad_ids_sticky(domain, new_ids))
+
+    def finish():
+        flats = unpack_flats(domain.cs, mat, len(new_ids))
+        health = getattr(trials, "obs_health", None)
+        if health is not None and len(flats) >= 2:
+            from ..obs.health import record_proposal_health
+
+            record_proposal_health(health, "rand", domain.cs.labels, flats)
+        return flat_to_new_trial_docs(domain, trials, new_ids, flats)
+
+    return AskHandle(new_ids, finish)
+
+
 def suggest(new_ids, domain, trials, seed):
     """Draw one prior sample per new id (hyperopt/rand.py sym: suggest).
 
@@ -165,18 +214,7 @@ def suggest(new_ids, domain, trials, seed):
     (per-label duplicate rate + proposal spread across the batch) from the
     already-fetched host values — no extra device work, nothing at all
     when disarmed (obs/health.py sym: record_proposal_health)."""
-    if not len(new_ids):
-        return []
-    seed = int(seed)
-    seed_words = np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
-    mat = _get_sample_jit(domain)(seed_words, pad_ids_sticky(domain, new_ids))
-    flats = unpack_flats(domain.cs, mat, len(new_ids))
-    health = getattr(trials, "obs_health", None)
-    if health is not None and len(flats) >= 2:
-        from ..obs.health import record_proposal_health
-
-        record_proposal_health(health, "rand", domain.cs.labels, flats)
-    return flat_to_new_trial_docs(domain, trials, new_ids, flats)
+    return suggest_async(new_ids, domain, trials, seed).result()
 
 
 def suggest_batch(new_ids, domain, trials, seed):

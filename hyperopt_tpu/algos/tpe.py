@@ -48,6 +48,7 @@ from . import rand
 __all__ = [
     "EPS",
     "suggest",
+    "suggest_async",
     "suggest_sharded",
     "adaptive_parzen_normal",
     "linear_forgetting_weights",
@@ -1090,7 +1091,19 @@ def _apply_rows(labels, history, rows):
     }
 
 
-def _get_suggest_jit(domain, cfg_key, cfg, diag=False):
+def _donation_enabled():
+    """Buffer donation of the history pytree into the fused tell+ask
+    program (in-place scatter instead of a cap-sized copy per tick).
+    ``HYPEROPT_TPU_NO_DONATION=1`` opts out for backends where XLA cannot
+    alias the update (donation is then silently a copy anyway, but the
+    flag also silences per-call unusable-donation warnings)."""
+    import os
+
+    return os.environ.get("HYPEROPT_TPU_NO_DONATION",
+                          "").strip().lower() in ("", "0", "false", "no")
+
+
+def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True):
     """The fused tell+ask program:
     ``run(history, rows, seed_words[2], ids[B]) -> (history', packed[B, L])``.
 
@@ -1106,10 +1119,19 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False):
     per-label stats ``[B, L, |HEALTH_STATS|]`` and split sizes ``[B, 2]``.
     The disarmed key and program are byte-identical to the plain build, so
     arming a run never perturbs an unarmed run's cache or hot path.
+
+    ``donate=True`` (default) jits with ``donate_argnums=(0,)``: the
+    history pytree is donated, so ``_apply_rows``'s scatters alias the
+    input buffers in place and no tick materializes a cap-sized copy of
+    the padded history (callers MUST thread the returned history handle
+    forward — ``PaddedHistory.device_state(donate=True)`` /
+    ``commit_device`` enforce that with a stale-handle guard).
     """
     cs = domain.cs
     key = ((cs.signature(), cfg_key, "health") if diag
            else (cs.signature(), cfg_key))
+    if not donate:
+        key = key + ("nodonate",)
     fn = _suggest_jit_cache.get(key)
     if fn is None:
         if diag:
@@ -1144,7 +1166,7 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False):
                 out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
                 return hist, rand.pack_labels(cs, out)
 
-        fn = jax.jit(run)
+        fn = jax.jit(run, donate_argnums=(0,)) if donate else jax.jit(run)
         _suggest_jit_cache.put(key, fn)
     return fn
 
@@ -1161,7 +1183,7 @@ def _seed_words(seed):
 # ---------------------------------------------------------------------------
 
 
-def suggest(
+def suggest_async(
     new_ids,
     domain,
     trials,
@@ -1176,23 +1198,22 @@ def suggest(
     prior_eps=0.0,
     verbose=False,
 ):
-    """Propose new trials by TPE (hyperopt/tpe.py sym: suggest).
+    """Dispatch one fused tell+ask program and return a
+    :class:`~hyperopt_tpu.algos.rand.AskHandle` whose ``result()`` performs
+    the packed readback and builds the trial docs.
 
-    Signature-compatible with the reference plugin boundary, incl.
-    ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` tuning.
-    The first ``n_startup_jobs`` trials delegate to random search; after that
-    every proposal is one jitted device program, vmapped over ``new_ids``.
-
-    ``ei_select``/``ei_tau``/``prior_eps`` are TPU-batch extensions with no
-    reference analog (the reference proposes one trial at a time):
-    stochastic EI selection and ε-prior mixing keep a WIDE ``new_ids`` batch
-    diverse when every proposal shares one posterior — see
-    ``_select_candidate``.  The defaults reproduce reference semantics.
+    The dispatch side does everything history-related: it folds the
+    just-completed trials into the DONATED device mirror (zero-copy
+    in-place scatter; see ``_get_suggest_jit``) and commits the program's
+    returned history handle immediately, so by the time the handle is
+    awaited the trials object is already consistent.  Only the proposal
+    buffer rides the future — exactly the piece the pipelined ``fmin``
+    loop overlaps with objective evaluation (``lookahead=N``).
     """
     if not len(new_ids):
-        return []
+        return rand.AskHandle([], lambda: [])
     if len(trials.trials) < n_startup_jobs:
-        return rand.suggest(new_ids, domain, trials, seed)
+        return rand.suggest_async(new_ids, domain, trials, seed)
 
     cfg = {
         "prior_weight": float(prior_weight),
@@ -1205,11 +1226,11 @@ def suggest(
     }
     cfg_key = tuple(sorted(cfg.items()))
     ph = trials.history_object(domain.cs.labels)
-    dev, rows = ph.device_state()
 
     # ONE device program (fold completed trials + propose whole queue) and
-    # one single-buffer readback; the updated history stays device-resident.
-    # ids pad to a power-of-two bucket (extras discarded on host) so the
+    # one single-buffer readback; the updated history stays device-resident
+    # and the fold scatters into the DONATED input buffers in place.  ids
+    # pad to a power-of-two bucket (extras discarded on host) so the
     # program shape — and hence the XLA compile — is stable across queue
     # ramp-up/drain batch sizes.
     #
@@ -1219,23 +1240,67 @@ def suggest(
     # Disarmed runs take the plain branch — same cache key, same program,
     # same single readback as before the health layer existed.
     health = getattr(trials, "obs_health", None)
-    run = _get_suggest_jit(domain, cfg_key, cfg, diag=health is not None)
+    donate = _donation_enabled()
+    run = _get_suggest_jit(domain, cfg_key, cfg, diag=health is not None,
+                           donate=donate)
     ids = rand.pad_ids_sticky(domain, new_ids)
-    if health is None:
-        new_dev, mat = run(dev, rows, _seed_words(seed), ids)
-    else:
+    dev, rows = ph.device_state(donate=donate)
+    args = (dev, rows, _seed_words(seed), ids)
+    if health is not None:
         from ..obs import health as _health_mod
 
-        _health_mod.capture_jit_cost(run, (dev, rows, _seed_words(seed), ids),
-                                     "suggest.tpe")
-        new_dev, mat, stats, splits = run(dev, rows, _seed_words(seed), ids)
-        _health_mod.record_tpe_health(
-            health, domain.cs.labels,
-            np.asarray(stats)[: len(new_ids)],
-            np.asarray(splits)[: len(new_ids)])
-    ph.commit_device(new_dev)
-    flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
-    return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)
+        # lower-only cost capture: reads the cost table, consumes no buffers
+        _health_mod.capture_jit_cost(run, args, "suggest.tpe")
+    try:
+        out = run(*args)
+    except BaseException:
+        # the donated input may already be invalid and no updated handle
+        # exists: drop the mirror so the next ask rebuilds from host
+        ph.abandon_device()
+        raise
+    ph.commit_device(out[0])
+
+    if health is None:
+        mat = out[1]
+
+        def finish():
+            flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
+            return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)
+
+    else:
+        _, mat, stats, splits = out
+
+        def finish():
+            from ..obs import health as _health_mod
+
+            _health_mod.record_tpe_health(
+                health, domain.cs.labels,
+                np.asarray(stats)[: len(new_ids)],
+                np.asarray(splits)[: len(new_ids)])
+            flats = rand.unpack_flats(domain.cs, mat, len(new_ids))
+            return rand.flat_to_new_trial_docs(domain, trials, new_ids, flats)
+
+    return rand.AskHandle(new_ids, finish)
+
+
+def suggest(new_ids, domain, trials, seed, **kwargs):
+    """Propose new trials by TPE (hyperopt/tpe.py sym: suggest).
+
+    Signature-compatible with the reference plugin boundary, incl.
+    ``functools.partial(tpe.suggest, gamma=..., n_EI_candidates=...)`` tuning.
+    The first ``n_startup_jobs`` trials delegate to random search; after that
+    every proposal is one jitted device program, vmapped over ``new_ids``.
+
+    ``ei_select``/``ei_tau``/``prior_eps`` are TPU-batch extensions with no
+    reference analog (the reference proposes one trial at a time):
+    stochastic EI selection and ε-prior mixing keep a WIDE ``new_ids`` batch
+    diverse when every proposal shares one posterior — see
+    ``_select_candidate``.  The defaults reproduce reference semantics.
+
+    This is ``suggest_async`` (dispatch) + an immediate ``result()``
+    (readback) — bit-identical proposals, one code path.
+    """
+    return suggest_async(new_ids, domain, trials, seed, **kwargs).result()
 
 
 # (space sig, cfg, mesh geometry, kind) -> jitted fn; LRU-bounded like

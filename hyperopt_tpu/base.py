@@ -35,6 +35,7 @@ from .exceptions import (
     InvalidLoss,
     InvalidResultStatus,
     InvalidTrial,
+    StaleHistoryError,
 )
 from .spaces import CompiledSpace, as_expr, compile_space
 
@@ -249,6 +250,10 @@ class PaddedHistory:
         self._has_loss = np.zeros(self.cap, bool)
         self._dev = None  # device mirror of the arrays above
         self._dev_synced = 0  # rows folded into the mirror
+        self._pending_commit_n = 0
+        # True while the mirror's buffers are donated to an in-flight fused
+        # program and the returned handle has not been committed back
+        self._donated = False
 
     def _grow(self, need):
         new_cap = _bucket_cap(need)
@@ -304,7 +309,18 @@ class PaddedHistory:
         }
         self._dev_synced = self.n
 
-    def device_state(self):
+    def _check_not_donated(self, what):
+        if self._donated:
+            raise StaleHistoryError(
+                f"PaddedHistory.{what}: the device mirror was DONATED to a "
+                "fused tell+ask dispatch and the program's returned history "
+                "has not been committed back.  Call commit_device(new_dev) "
+                "with the kernel's returned handle (or abandon_device() to "
+                "drop the mirror) before touching device state again — "
+                "reusing a donated buffer crashes XLA with an opaque "
+                "invalid-buffer error.")
+
+    def device_state(self, donate=False):
         """``(dev, rows)`` for FUSED update+propose kernels.
 
         ``dev`` is the device mirror as of the last commit; ``rows`` is a
@@ -315,7 +331,19 @@ class PaddedHistory:
         program (saving one device program per ask→tell iteration — on a
         tunneled TPU each program costs tens of ms of completion latency)
         and hands the updated mirror back via :meth:`commit_device`.
+
+        ``donate=True`` declares that the caller's program is jitted with
+        ``donate_argnums`` on the history: XLA aliases the update in place
+        (zero-copy scatter instead of a cap-sized copy per tick) and the
+        returned buffers become INVALID the moment the program dispatches.
+        Until :meth:`commit_device` hands the program's returned history
+        back, every further device access raises
+        :class:`~hyperopt_tpu.exceptions.StaleHistoryError` — the guard
+        that turns the classic donated-buffer-reuse crash into a clear
+        error.  The numpy arrays stay the host source of truth throughout
+        (appends, pickling and rebuilds never depend on the mirror).
         """
+        self._check_not_donated("device_state")
         delta = self.n - self._dev_synced
         if self._dev is None or delta > self._ROW_BUCKETS[-1]:
             self._full_upload()
@@ -327,17 +355,55 @@ class PaddedHistory:
         for j, i in enumerate(range(self._dev_synced, self.n)):
             rows[j] = self._pack_row(i)
         self._pending_commit_n = self.n
+        self._pending_commit_cap = self.cap
+        self._donated = bool(donate)
         return self._dev, rows
 
     def commit_device(self, dev):
         """Adopt a kernel-updated mirror (see :meth:`device_state`)."""
-        self._dev = dev
-        self._dev_synced = self._pending_commit_n
+        if getattr(self, "_pending_commit_cap", self.cap) != self.cap:
+            # capacity grew between dispatch and commit: the returned
+            # handle has the OLD shapes — drop it, rebuild at next use
+            self._dev = None
+        else:
+            self._dev = dev
+            self._dev_synced = self._pending_commit_n
+        self._donated = False
+
+    def abandon_device(self):
+        """Drop the device mirror after a FAILED donated dispatch: the
+        donated buffers are gone and no updated handle exists, so the next
+        device access rebuilds the mirror from the host arrays."""
+        self._dev = None
+        self._donated = False
+
+    def host_materialize(self):
+        """Host-side snapshot of the folded history (checkpoint/pickle
+        boundary).  The numpy arrays are authoritative by construction —
+        device kernels only ever *read* history the host already folded —
+        so this never blocks on (possibly donated) device buffers."""
+        return {
+            "vals": {l: self._vals[l][: self.n].copy() for l in self.labels},
+            "active": {l: self._active[l][: self.n].copy()
+                       for l in self.labels},
+            "losses": self._losses[: self.n].copy(),
+            "has_loss": self._has_loss[: self.n].copy(),
+        }
+
+    # pickle boundary: jax buffers (possibly donated/invalid) never travel;
+    # the mirror rebuilds lazily from the authoritative numpy arrays
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_dev"] = None
+        state["_dev_synced"] = 0
+        state["_donated"] = False
+        return state
 
     def device_view(self):
         """Device-resident arrays for the jitted kernels, synced incrementally
         (one fused update dispatch per new row; full upload only on capacity
         growth or first use)."""
+        self._check_not_donated("device_view")
         if self._dev is None:
             self._full_upload()
         elif self._dev_synced < self.n:
@@ -671,6 +737,8 @@ class Trials:
         trials_save_file="",
         device_loop=False,
         obs=None,
+        lookahead=0,
+        compile_cache=None,
     ):
         from .fmin import fmin as _fmin
 
@@ -694,6 +762,8 @@ class Trials:
             trials_save_file=trials_save_file,
             device_loop=device_loop,
             obs=obs,
+            lookahead=lookahead,
+            compile_cache=compile_cache,
         )
 
     # pickle: drop the numpy history (rebuilt lazily) for a compact file, and

@@ -219,8 +219,9 @@ class DeviceLoopRunner:
         # the jitted chunk program is cached across runner instances (the
         # shared LRU with fmin_device): a warm re-run of the same
         # (space, objective, cap, cfg) must not recompile
+        donate = tpe._donation_enabled()
         cache_key = ("chunk", cs.expr, domain.fn, self.cap, int(n_startup),
-                     tuple(sorted(cfg.items())), self.CHUNK)
+                     tuple(sorted(cfg.items())), self.CHUNK, donate)
         cached = _RUN_CACHE.get(cache_key)
         _record_cache_stats()
         if cached is not None:
@@ -234,7 +235,11 @@ class DeviceLoopRunner:
         rand_flat, tpe_flat, typed = _flat_samplers(
             cs, cfg, with_tpe=n_startup < cap_i)
 
-        @jax.jit
+        # the cap-sized history tuple is DONATED: each chunk's scatters
+        # alias the previous state's buffers in place, so a 10-trial chunk
+        # never materializes a fresh cap-sized copy of the history.  The
+        # caller-side contract (thread the RETURNED state forward, never
+        # reuse the argument) is what FMinIter._run_device already does.
         def run_chunk(state, start, limit, seed_words):
             vals, active, losses, has_loss = state
             base = jax.random.fold_in(
@@ -282,6 +287,9 @@ class DeviceLoopRunner:
                 step, (vals, active, losses, has_loss),
                 jnp.arange(chunk, dtype=jnp.int32))
             return state, rows
+
+        run_chunk = (jax.jit(run_chunk, donate_argnums=(0,)) if donate
+                     else jax.jit(run_chunk))
 
         self._holder = {"jit": run_chunk, "compiled": None}
         self._L = L

@@ -31,3 +31,11 @@ class InvalidLoss(HyperoptTpuError):
 
 class InvalidAnnotatedParameter(HyperoptTpuError):
     """Raised when an ``hp.*`` call is malformed (bad label, bad args)."""
+
+
+class StaleHistoryError(HyperoptTpuError):
+    """Raised when a device-resident trial history is touched after its
+    buffers were DONATED to a fused tell+ask dispatch and the program's
+    returned handle has not been committed back
+    (``PaddedHistory.commit_device``).  Without this guard the reuse would
+    surface as an opaque XLA invalid-buffer crash deep inside the runtime."""

@@ -125,10 +125,12 @@ class FMinIter:
         trials_save_file="",
         device_loop=False,
         obs=None,
+        lookahead=0,
+        compile_cache=None,
     ):
         from ._env import enable_persistent_compilation_cache
 
-        enable_persistent_compilation_cache()
+        enable_persistent_compilation_cache(compile_cache)
         self.device_loop = device_loop
         self.algo = algo
         self.domain = domain
@@ -178,6 +180,29 @@ class FMinIter:
         self.show_progressbar = show_progressbar
         self.early_stop_args = []
         self.is_cancelled = False
+        # pipelined ask→tell (hyperopt's standard async-evaluation
+        # semantics: in-flight trials simply don't contribute losses to the
+        # posterior).  lookahead=N keeps up to N speculative asks in flight
+        # — dispatched before the evaluate phase so their device programs
+        # (and readbacks) overlap with objective evaluation.  lookahead=0
+        # (default) is the synchronous loop, proposal-for-proposal
+        # identical to the unpipelined driver (pinned by golden test).
+        self.lookahead = int(lookahead)
+        if self.lookahead < 0:
+            raise ValueError(f"lookahead must be >= 0, got {lookahead}")
+        self._algo_async = self._resolve_async_algo()
+        self._ask_inflight = []  # speculative AskHandles, FIFO
+        if self.lookahead > 0:
+            if self.asynchronous:
+                raise ValueError(
+                    "lookahead > 0 applies to the serial in-process loop "
+                    "only — an asynchronous Trials backend already "
+                    "overlaps evaluation with asks via max_queue_len")
+            if self._algo_async is None:
+                raise ValueError(
+                    "lookahead > 0 requires a suggester with an async "
+                    "dispatch/readback split (tpe.suggest or rand.suggest, "
+                    "optionally functools.partial-tuned)")
         # per-phase timing counters, shared with (and surfaced on) the trials
         # object; accumulates across fmin calls that reuse one Trials
         if not hasattr(trials, "phase_timings"):
@@ -205,6 +230,34 @@ class FMinIter:
                 trials.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
         else:
             trials.attachments["FMinIter_Domain"] = domain
+
+    def _resolve_async_algo(self):
+        """An ``(ids, domain, trials, seed) -> AskHandle`` dispatcher when
+        the configured algo has a dispatch/readback split (tpe.suggest or
+        rand.suggest, possibly ``functools.partial``-tuned), else None.
+        Used for the suggest.dispatch/suggest.readback span split and —
+        with ``lookahead > 0`` — the speculative-ask pipeline."""
+        import functools as _ft
+
+        from .algos import rand as _rand
+
+        try:
+            from .algos import tpe as _tpe
+        except ModuleNotFoundError:  # partial checkout only
+            _tpe = None
+        algo, kwargs = self.algo, {}
+        while isinstance(algo, _ft.partial):
+            if algo.args:  # positional partial args: leave the plain path
+                return None
+            for k, v in (algo.keywords or {}).items():
+                kwargs.setdefault(k, v)
+            algo = algo.func
+        if _tpe is not None and algo is _tpe.suggest:
+            return lambda ids, dom, tr, s: _tpe.suggest_async(
+                ids, dom, tr, s, **kwargs)
+        if algo is _rand.suggest and not kwargs:
+            return _rand.suggest_async
+        return None
 
     def serial_evaluate(self, N=-1):
         """Evaluate queued NEW trials in-process
@@ -336,6 +389,12 @@ class FMinIter:
             reasons.append("max_queue_len != 1 (host loop already amortizes)")
         if self.max_evals == float("inf"):
             reasons.append("unbounded max_evals")
+        if self.lookahead:
+            # the device loop already pipelines the whole ask→tell chain on
+            # device; silently swallowing lookahead would be inconsistent
+            # with the strict validation the host loop applies
+            reasons.append("lookahead > 0 (host-loop speculation; the "
+                           "device loop pipelines on device already)")
         # trials this iter's own device loop populated are resumable (the
         # device-side history is retained on self); foreign history is not
         if len(self.trials) != getattr(self, "_device_n_done", 0):
@@ -408,8 +467,19 @@ class FMinIter:
                 seed = (self.rstate.integers(2**31 - 1)
                         if hasattr(self.rstate, "integers")
                         else self.rstate.randint(2**31 - 1))
-                with self._timed("suggest"):
-                    state, rows = runner.run_chunk(state, n_done, limit, seed)
+                try:
+                    with self._timed("suggest"):
+                        state, rows = runner.run_chunk(state, n_done, limit,
+                                                       seed)
+                except BaseException:
+                    # the donated state tuple is consumed by the dispatch:
+                    # drop the resume handle so a later run() re-checks
+                    # eligibility instead of feeding freed buffers back in
+                    # (the device-loop analog of PaddedHistory's
+                    # stale-handle guard / abandon_device)
+                    self._device_state = None
+                    self._device_n_done = 0
+                    raise
                 k = limit - n_done
                 new_ids = trials.new_trial_ids(k)
                 now = coarse_utcnow()
@@ -476,6 +546,13 @@ class FMinIter:
                         "; ".join(reasons))
         trials = self.trials
         algo = self.algo
+        async_algo = self._algo_async
+        # speculative asks are scoped to ONE run(): handles left by an
+        # earlier interrupted/stopped run are dropped here, because their
+        # batch size was budgeted against that run's N and landing them
+        # wholesale could overshoot this run's budget (their reserved ids
+        # simply go unused — id gaps are legal in the doc schema)
+        self._ask_inflight = inflight = []
         n_queued = 0
 
         def get_queue_len():
@@ -488,6 +565,14 @@ class FMinIter:
             unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
             return self.trials.count_by_state_unsynced(unfinished_states)
 
+        def inflight_n():
+            return sum(len(h.new_ids) for h in inflight)
+
+        def next_seed():
+            return (self.rstate.integers(2**31 - 1)
+                    if hasattr(self.rstate, "integers")
+                    else self.rstate.randint(2**31 - 1))
+
         stopped = False
         initial_n_done = get_n_done()
         n_reported = initial_n_done
@@ -496,42 +581,93 @@ class FMinIter:
         ) as progress_ctx:
             all_trials_complete = False
             best_loss = float("inf")
+
+            def land(new_trials):
+                """Insert freshly-asked docs; False = suggester is done."""
+                nonlocal n_queued, qlen, stopped
+                self.obs.counter("suggest.calls").inc()
+                if not len(new_trials):
+                    stopped = True
+                    return False
+                for doc in new_trials:
+                    self.obs.trial_event(
+                        obs_mod.events_mod.TRIAL_NEW, doc["tid"])
+                self.obs.counter("trials.suggested").inc(len(new_trials))
+                self.trials.insert_trial_docs(new_trials)
+                self.trials.refresh()
+                n_queued += len(new_trials)
+                qlen = get_queue_len()
+                self.obs.gauge("queue_depth").set(qlen)
+                return True
+
             while n_queued < N or (block_until_done and not all_trials_complete):
                 # one beat per ask→tell tick: the stall watchdog's quiet
                 # period measures from here when the host loop wedges
                 self.obs.heartbeat("fmin.tick", n_queued=n_queued)
                 qlen = get_queue_len()
+                # land speculative asks first: their device programs ran
+                # while the previous tick's trials evaluated, so only the
+                # readback is paid here
+                while (inflight and qlen < self.max_queue_len
+                       and n_queued < N and not self.is_cancelled):
+                    handle = inflight.pop(0)
+                    self.obs.gauge("suggest.inflight").set(len(inflight))
+                    t_ask = time.perf_counter()
+                    with self._timed("suggest"):
+                        with self._timed("suggest.readback"):
+                            new_trials = handle.result()
+                    self.obs.histogram("ask.blocked_sec").observe(
+                        time.perf_counter() - t_ask)
+                    if not land(new_trials):
+                        break
                 while (
-                    qlen < self.max_queue_len and n_queued < N and not self.is_cancelled
+                    qlen < self.max_queue_len and n_queued < N
+                    and not self.is_cancelled and not stopped
                 ):
                     n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     self.trials.refresh()
+                    t_ask = time.perf_counter()
                     with self._timed("suggest"):
-                        new_trials = algo(
-                            new_ids,
-                            self.domain,
-                            trials,
-                            self.rstate.integers(2**31 - 1)
-                            if hasattr(self.rstate, "integers")
-                            else self.rstate.randint(2**31 - 1),
-                        )
+                        if async_algo is not None:
+                            # same computation as the plain call, but the
+                            # dispatch/readback split is visible as child
+                            # spans (and in phase_timings)
+                            with self._timed("suggest.dispatch"):
+                                handle = async_algo(
+                                    new_ids, self.domain, trials, next_seed())
+                            with self._timed("suggest.readback"):
+                                new_trials = handle.result()
+                        else:
+                            new_trials = algo(
+                                new_ids, self.domain, trials, next_seed())
+                    self.obs.histogram("ask.blocked_sec").observe(
+                        time.perf_counter() - t_ask)
                     assert len(new_ids) >= len(new_trials)
-                    self.obs.counter("suggest.calls").inc()
-                    if len(new_trials):
-                        for doc in new_trials:
-                            self.obs.trial_event(
-                                obs_mod.events_mod.TRIAL_NEW, doc["tid"])
-                        self.obs.counter("trials.suggested").inc(
-                            len(new_trials))
-                        self.trials.insert_trial_docs(new_trials)
-                        self.trials.refresh()
-                        n_queued += len(new_trials)
-                        qlen = get_queue_len()
-                        self.obs.gauge("queue_depth").set(qlen)
-                    else:
-                        stopped = True
+                    if not land(new_trials):
                         break
+
+                # speculative dispatch: ask for the NEXT batch(es) before
+                # this tick's trials evaluate — the fused tell+ask program
+                # computes on device while the objective runs on host, and
+                # the pending trials are simply absent from its posterior
+                if (self.lookahead and async_algo is not None and not stopped
+                        and not self.is_cancelled):
+                    while len(inflight) < self.lookahead:
+                        k = min(self.max_queue_len, N - n_queued - inflight_n())
+                        if not (k >= 1 and k != float("inf")):
+                            break
+                        new_ids = trials.new_trial_ids(int(k))
+                        self.trials.refresh()
+                        # dispatch-only span, NOT nested under "suggest":
+                        # the landing readback next tick carries the one
+                        # "suggest" span for this ask, so phase counts stay
+                        # one-per-ask in both pipelined and sync modes
+                        with self._timed("suggest.dispatch"):
+                            inflight.append(async_algo(
+                                new_ids, self.domain, trials, next_seed()))
+                        self.obs.counter("suggest.speculative").inc()
+                        self.obs.gauge("suggest.inflight").set(len(inflight))
 
                 if self.asynchronous:
                     # wait for workers to fill in the trials
@@ -641,6 +777,8 @@ def fmin(
     trials_save_file="",
     device_loop=False,
     obs=None,
+    lookahead=0,
+    compile_cache=None,
 ):
     """Minimize ``fn`` over ``space`` (hyperopt/fmin.py sym: fmin).
 
@@ -660,6 +798,21 @@ def fmin(
     streams spans + trial events + a metrics snapshot to that JSONL file
     (render with ``python -m hyperopt_tpu.obs.report``), or pass an
     :class:`hyperopt_tpu.obs.ObsConfig` directly.
+
+    ``lookahead`` (TPU extension): keep up to N speculative asks in flight
+    — the next batch's fused tell+ask program dispatches before the
+    current trials evaluate, so device compute and readback overlap with
+    the objective.  This is hyperopt's standard asynchronous-evaluation
+    semantics (a pending trial contributes no loss to the posterior);
+    ``lookahead=0`` (default) stays proposal-for-proposal identical to the
+    synchronous loop.  Requires a tpe/rand suggester (possibly
+    ``functools.partial``-tuned) and a serial (non-async) Trials backend.
+
+    ``compile_cache`` (TPU extension): directory for the persistent XLA
+    compilation cache — repeat runs skip the one-time compile that
+    dominates short-run wall clock.  Defaults to
+    ``HYPEROPT_TPU_COMPILE_CACHE`` (or an automatic per-machine dir);
+    ``HYPEROPT_TPU_NO_CACHE=1`` disables.
     """
     if algo is None:
         try:
@@ -716,6 +869,8 @@ def fmin(
             trials_save_file=trials_save_file,
             device_loop=device_loop,
             obs=obs,
+            lookahead=lookahead,
+            compile_cache=compile_cache,
         )
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
@@ -735,6 +890,8 @@ def fmin(
         trials_save_file=trials_save_file,
         device_loop=device_loop,
         obs=obs,
+        lookahead=lookahead,
+        compile_cache=compile_cache,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()

@@ -258,6 +258,38 @@ def _metrics_section(metric_recs, out):
                                      default=str).replace("\n", "\n  "))
 
 
+def _pipeline_section(spans, metrics, out):
+    """Ask-pipeline summary (ISSUE 4): dispatch vs readback wall time and
+    the speculative-ask overlap, when the run recorded the split."""
+    agg = {}
+    for s in spans:
+        if s["name"] in ("suggest", "suggest.dispatch", "suggest.readback"):
+            e = agg.setdefault(s["name"], [0.0, 0])
+            e[0] += s.get("wall_sec", 0.0)
+            e[1] += 1
+    if "suggest.dispatch" not in agg and "suggest.readback" not in agg:
+        return
+    out.append("")
+    out.append("== ask pipeline " + "=" * 48)
+    for name in ("suggest", "suggest.dispatch", "suggest.readback"):
+        if name in agg:
+            sec, count = agg[name]
+            out.append(f"  {name:<18} wall {_fmt_sec(sec):>8}  x{count}")
+    spec = metrics.get("suggest.speculative", 0)
+    blocked = metrics.get("ask.blocked_sec") or {}
+    if blocked.get("count"):
+        out.append(
+            f"  blocked per ask    p50 {_fmt_sec(blocked.get('p50', 0)):>8}"
+            f"  p99 {_fmt_sec(blocked.get('p99', 0)):>8}"
+            f"  x{blocked['count']}  (speculative asks: {spec})")
+    if spec:
+        out.append("  overlap: speculative dispatches ran while trials "
+                   "evaluated — readback p50 above is the residual wait")
+    else:
+        out.append("  no speculative asks recorded (lookahead=0: "
+                   "synchronous dispatch+readback)")
+
+
 def render(records, top=5):
     """Build the report text from parsed JSONL records."""
     spans = [r for r in records if r.get("kind") == "span"]
@@ -269,6 +301,7 @@ def render(records, top=5):
     out = []
     out.append("== phase-time breakdown " + "=" * 40)
     _phase_section(spans, out)
+    _pipeline_section(spans, _last_snapshot_metrics(records), out)
     out.append("")
     out.append("== search health " + "=" * 47)
     _health_section(health_recs, out)
@@ -299,7 +332,8 @@ def render(records, top=5):
 _ALLGATHER_METRICS = (
     "allgather.resume_sec",
     "allgather.proposals_sec",
-    "allgather.losses_sec",
+    "allgather.results_sec",
+    "allgather.losses_sec",  # pre-payload streams (renamed to results)
     "allgather.checksum_sec",
 )
 

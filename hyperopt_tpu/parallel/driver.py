@@ -51,6 +51,7 @@ from ..obs import ObsConfig, RunObs
 from ..obs.health import controller_stream_path
 from ..spaces import compile_space
 from ..algos import tpe
+from . import payload as payload_mod
 
 __all__ = ["fmin_multihost", "MultihostResult", "ControllerDivergence"]
 
@@ -209,7 +210,14 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     n_dev = len(jax.devices())
     if batch is None:
         batch = n_dev
-    cfg = dict(_default_cfg(batch), **(cfg or {}))
+    cfg = dict(cfg or {})
+    # cfg["compile_cache"] wires the persistent XLA compilation cache (the
+    # multihost analog of fmin's compile_cache= kwarg); it is NOT a kernel
+    # parameter, so pop it before cfg feeds run_params / jit cache keys
+    from .._env import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache(cfg.pop("compile_cache", None))
+    cfg = dict(_default_cfg(batch), **cfg)
     if n_startup is None:
         n_startup = max(batch, 20)
 
@@ -263,12 +271,15 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     # the proposal kernels: a plain local vmap in single mode, the
     # global-mesh sharded program otherwise (bitwise-identical outputs —
     # the mesh test asserts it)
+    from . import sharding
+
     if single:
+        mesh = None
         propose_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg),
                                       in_axes=(None, 0)))
         sample_fn = jax.jit(jax.vmap(cs.sample_flat))
     else:
-        from . import multihost, sharding
+        from . import multihost
 
         mesh = multihost.global_mesh()
         # packed=True: one [batch, L] buffer -> ONE cross-host collective
@@ -276,6 +287,53 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         propose_sharded = sharding.suggest_batch_sharded(cs, cfg, mesh,
                                                          packed=True)
         sample_fn = jax.jit(jax.vmap(cs.sample_flat))
+
+    # DEVICE-RESIDENT history mirror: built once (replicated on the global
+    # mesh in multihost mode), then advanced per generation by a DONATED
+    # in-place scatter of just that generation's rows
+    # (sharding.build_history_fold) — replacing the old cap-sized
+    # replicate-the-whole-pytree upload every generation.  The numpy
+    # ``hist`` stays the host source of truth (checkpoints pickle FROM it,
+    # never from device buffers — the host-materialization boundary), so a
+    # failed donated fold just drops the mirror and rebuilds.
+    mirror = {"dev": None, "synced": 0}
+    wire_fmt = payload_mod.wire_format()
+
+    def device_history(n_now):
+        L_n = len(labels)
+        if mirror["dev"] is not None and mirror["synced"] < n_now:
+            s, e = mirror["synced"], n_now
+            k = e - s  # <= batch by construction (one fold per generation)
+            vals_rows = np.zeros((batch, L_n), np.float32)
+            act_rows = np.zeros((batch, L_n), bool)
+            lo = np.zeros(batch, np.float32)
+            hl = np.zeros(batch, bool)
+            idx = np.full(batch, cap, np.int32)  # padding: dropped in-trace
+            for j, l in enumerate(labels):
+                vals_rows[:k, j] = hist["vals"][l][s:e]
+                act_rows[:k, j] = hist["active"][l][s:e]
+            lo[:k] = hist["losses"][s:e]
+            hl[:k] = hist["has_loss"][s:e]
+            idx[:k] = np.arange(s, e, dtype=np.int32)
+            args = (vals_rows, act_rows, lo, hl, idx)
+            if not single:
+                args = tuple(multihost.replicate_global(a, mesh)
+                             for a in args)
+            try:
+                mirror["dev"] = sharding.build_history_fold(labels)(
+                    mirror["dev"], *args)
+                mirror["synced"] = e
+                obs.counter("mirror.incremental_folds").inc()
+            except Exception:
+                # the donated input is gone either way; rebuild from host
+                mirror["dev"] = None
+        if mirror["dev"] is None:
+            mirror["dev"] = (multihost.replicate_global(hist, mesh)
+                             if not single
+                             else jax.tree.map(jnp.asarray, hist))
+            mirror["synced"] = n_now
+            obs.counter("mirror.full_uploads").inc()
+        return mirror["dev"]
 
     def local_keys(gseed):
         return jax.vmap(
@@ -354,7 +412,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     def _save_checkpoint():
         """Atomic generation-boundary snapshot; controller 0 writes (every
         controller holds an identical history — that is the divergence
-        guarantee this driver enforces)."""
+        guarantee this driver enforces).
+
+        Host-materialization boundary: the snapshot is built from the
+        numpy ``hist`` exclusively — never from the device-resident mirror,
+        whose buffers may be donated/aliased by the in-place generation
+        fold and are not picklable state."""
         if checkpoint_file is None or pid != 0:
             return
         import pickle
@@ -386,13 +449,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 out = sample_fn(local_keys(gseed))
                 flats = {l: np.asarray(out[l]) for l in labels}
             elif single:
-                out = propose_fn(jax.tree.map(jnp.asarray, hist),
-                                 local_keys(gseed))
+                out = propose_fn(device_history(n_done), local_keys(gseed))
                 flats = {l: np.asarray(out[l]) for l in labels}
             else:
                 keys = multihost.global_key_batch(gseed, batch, mesh)
-                hist_dev = multihost.replicate_global(hist, mesh)
-                flats = gather_packed(propose_sharded(hist_dev, keys))
+                flats = gather_packed(
+                    propose_sharded(device_history(n_done), keys))
 
         def flat_j(j):
             """Host-typed flat sample (int families come back exact off the
@@ -403,53 +465,71 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 for l in labels
             }
 
-        # evaluate MY shard (round-robin by global position in the batch)
+        # evaluate MY shard (round-robin by global position in the batch);
+        # the active masks of conditional params are computed HERE, for my
+        # shard only, and ride the result exchange — every controller used
+        # to recompute them for the whole batch during the fold
+        L_n = len(labels)
         my_js = [j for j in range(B) if j % P == pid]
         my_losses = np.full(len(my_js), np.nan, np.float32)
+        my_active = np.zeros((len(my_js), L_n), bool)
         with obs.span("evaluate", gen=gen, n_local=len(my_js)):
             for k, j in enumerate(my_js):
+                flat = flat_j(j)
+                act = cs.active_flat(flat)
+                my_active[k] = [bool(act[l]) for l in labels]
                 try:
-                    my_losses[k] = float(fn(cs.assemble(flat_j(j))))
+                    my_losses[k] = float(fn(cs.assemble(flat)))
                 except Exception:
                     # failed trial: no loss, stays typical
                     my_losses[k] = np.nan
                     obs.counter("trials.failed").inc()
         if single:
             losses = my_losses
+            active_rows = my_active
         else:
-            # pad to the max shard width so allgather shapes agree, then
-            # reassemble in global order: j = p + k*P
+            # pad to the max shard width so allgather shapes agree, encode
+            # as ONE lean wire buffer per controller (losses as a narrow
+            # f32 column, active/evaluated flags as uint8 bitfields — see
+            # payload.py; HYPEROPT_TPU_PAYLOAD=f32 selects the wide debug
+            # rows), then reassemble in global order: j = p + k*P
             width = (B + P - 1) // P
-            padded = np.full(width, np.nan, np.float32)
-            padded[: len(my_losses)] = my_losses
-            obs.heartbeat("driver.allgather", point="losses", mark="pre",
+            pl = np.full(width, np.nan, np.float32)
+            pl[: len(my_js)] = my_losses
+            pa = np.zeros((width, L_n), bool)
+            pa[: len(my_js)] = my_active
+            ev = np.zeros(width, bool)
+            ev[: len(my_js)] = True
+            wire = payload_mod.to_wire(pl, pa, ev, wire_fmt)
+            obs.gauge("payload.bytes_per_controller").set(int(wire.nbytes))
+            obs.heartbeat("driver.allgather", point="results", mark="pre",
                           gen=gen)
             t0 = time.perf_counter()
             gathered = np.asarray(
-                multihost_utils.process_allgather(jnp.asarray(padded))
-            ).reshape(P, width)
-            obs.histogram("allgather.losses_sec").observe(
+                multihost_utils.process_allgather(jnp.asarray(wire))
+            ).reshape(P, width, wire.shape[1])
+            obs.histogram("allgather.results_sec").observe(
                 time.perf_counter() - t0)
-            obs.heartbeat("driver.allgather", point="losses", mark="post",
+            obs.heartbeat("driver.allgather", point="results", mark="post",
                           gen=gen)
             losses = np.full(B, np.nan, np.float32)
+            active_rows = np.zeros((B, L_n), bool)
             for p in range(P):
+                l_p, a_p, ev_p = payload_mod.from_wire(gathered[p], L_n,
+                                                       wire_fmt)
                 js = np.arange(p, B, P)
-                losses[js] = gathered[p, : len(js)]
+                assert ev_p[: len(js)].all(), "padding row folded as real"
+                losses[js] = l_p[: len(js)]
+                active_rows[js] = a_p[: len(js)]
 
-        # deterministic fold, global trial-id order
+        # deterministic fold, global trial-id order (shared with the wire
+        # formats' bitwise-equality test: payload.fold_generation is THE
+        # fold, whatever encoding delivered the rows)
         with obs.span("fold", gen=gen):
+            payload_mod.fold_generation(
+                hist, raw_losses, n_done, labels,
+                {l: flats[l][:B] for l in labels}, losses, active_rows)
             for j in range(B):
-                i = n_done + j
-                ok = np.isfinite(losses[j])
-                hist["losses"][i] = losses[j] if ok else np.inf
-                hist["has_loss"][i] = ok
-                raw_losses[i] = losses[j]
-                for l in labels:
-                    hist["vals"][l][i] = flats[l][j]
-                act = cs.active_flat(flat_j(j))
-                for l in labels:
-                    hist["active"][l][i] = bool(act[l])
                 digest.update(np.float32(losses[j]).tobytes())
                 digest.update(
                     b"".join(np.float32(flats[l][j]).tobytes()

@@ -32,10 +32,56 @@ __all__ = [
     "suggest_batch_sharded",
     "propose_sharded_candidates",
     "replicate_history",
+    "build_history_fold",
 ]
 
 TRIALS_AXIS = "trials"
 CAND_AXIS = "cand"
+
+# labels tuple -> donated jitted generation fold (shape specialization is
+# jit's own cache; bounded because spaces are few per process)
+_fold_cache = {}
+
+
+def build_history_fold(labels):
+    """One DONATED device program scattering a generation's rows into the
+    replicated history pytree **in place**:
+
+        fold(hist, vals_rows[W, L], active_rows[W, L], losses[W], has[W],
+             idx[W]) -> hist'
+
+    This is what lets the multihost driver keep the padded history
+    device-resident across generations: instead of re-replicating the full
+    cap-sized pytree every generation (cap × (5 bytes + 5/label) over the
+    host↔device link), only the generation's W rows travel and the scatter
+    aliases the donated buffers.  Padding rows carry ``idx = cap`` and are
+    dropped in-trace (``mode='drop'``), so the program shape is stable at
+    the batch width.  Callers must thread the RETURNED pytree forward —
+    the donated argument is invalid after dispatch (same contract as
+    ``PaddedHistory.device_state(donate=True)``).
+    """
+    labels = tuple(labels)
+    fn = _fold_cache.get(labels)
+    if fn is None:
+
+        def fold(hist, vals_rows, active_rows, losses, has, idx):
+            return {
+                "losses": hist["losses"].at[idx].set(losses, mode="drop"),
+                "has_loss": hist["has_loss"].at[idx].set(has, mode="drop"),
+                "vals": {
+                    l: hist["vals"][l].at[idx].set(vals_rows[:, j],
+                                                   mode="drop")
+                    for j, l in enumerate(labels)
+                },
+                "active": {
+                    l: hist["active"][l].at[idx].set(active_rows[:, j],
+                                                     mode="drop")
+                    for j, l in enumerate(labels)
+                },
+            }
+
+        fn = _fold_cache[labels] = jax.jit(fold, donate_argnums=(0,))
+    return fn
 
 
 def make_mesh(n_devices=None, n_cand_shards=1):

@@ -8,6 +8,10 @@
 # Opt-in trace gate: TRACE_GATE=1 additionally runs a tiny armed
 # two-controller run end-to-end, exports it via obs.report --export-trace
 # and validates the trace-event invariants (scripts/validate_trace.py).
+# Opt-in donation gate: DONATION_GATE=1 additionally re-runs the
+# zero-copy suite under forced-CPU JAX with the strict allocation checks
+# armed — pins that no ask→tell tick allocates a cap-sized history copy
+# (buffer pointers stable, live cap-sized buffer count non-increasing).
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -16,5 +20,9 @@ if [ "${BENCH_GATE:-0}" = "1" ]; then
 fi
 if [ "${TRACE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --self-test || exit 1
+fi
+if [ "${DONATION_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DONATION_GATE=1 \
+        python -m pytest tests/test_pipeline.py -q -k donation || exit 1
 fi
 exit 0

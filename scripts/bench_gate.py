@@ -35,16 +35,27 @@ import os
 import re
 import sys
 
-# metric-name → allowed relative drop (new >= prev * (1 - threshold))
+# metric-name → allowed relative drop (new >= prev * (1 - threshold));
+# for the LOWER_IS_BETTER latency metrics the same threshold bounds the
+# allowed relative rise instead (new <= prev * (1 + threshold))
 DEFAULT_THRESHOLDS = {
     "headline.value": 0.20,
     "headline.vs_baseline": 0.35,
     "trials_per_sec": 0.20,
     "candidates_per_sec": 0.20,
     "cv_fits_per_sec": 0.20,
+    # per-ask wall latency (bench.py ask_latency stage): shared contended
+    # hardware makes tails noisy — p50 gates tightest, p99 loosest
+    "ask_p50_ms": 0.35,
+    "ask_p95_ms": 0.50,
+    "ask_p99_ms": 1.00,
 }
 
-_TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec")
+_TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
+                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms")
+
+# latency metrics regress UPWARD
+LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms")
 
 
 def bench_files(root):
@@ -86,8 +97,17 @@ def compare(prev, new, thresholds):
     n_scalars, n_seqs = new
 
     def check(name, pv, nv):
-        thr = thresholds.get(name.split("[")[0],
-                             thresholds.get("default", 0.20))
+        base = name.split("[")[0]
+        thr = thresholds.get(base, thresholds.get("default", 0.20))
+        if base in LOWER_IS_BETTER:
+            ceil = pv * (1.0 + thr)
+            if nv > ceil:
+                regressions.append(
+                    f"{name}: {nv:.6g} > {pv:.6g} * (1 + {thr:.0%}) "
+                    f"= {ceil:.6g}")
+            else:
+                notes.append(f"{name}: {pv:.6g} -> {nv:.6g}  ok (lower=better)")
+            return
         floor = pv * (1.0 - thr)
         if nv < floor:
             regressions.append(

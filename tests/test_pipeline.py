@@ -1,0 +1,423 @@
+"""Zero-copy pipelined ask→tell (ISSUE 4): buffer donation, the
+dispatch/readback split + ``lookahead`` overlap, lean multihost payloads
+and the persistent compilation cache.
+
+Golden values in this file were captured from the PRE-donation synchronous
+loop (commit b7c53aa) with fixed seeds: ``lookahead=0`` on the donated
+fused path must reproduce them bit for bit.
+"""
+
+import copy
+import functools
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.exceptions import StaleHistoryError
+from hyperopt_tpu.fmin import FMinIter
+from hyperopt_tpu.algos import rand, tpe
+
+SPACE = {"x": hp.uniform("x", -5, 5), "lr": hp.loguniform("lr", -3, 1)}
+
+
+def obj(d):
+    return (d["x"] - 1.0) ** 2 + (d["lr"] - 0.5) ** 2
+
+
+def _vals(t, label):
+    return [float(d["misc"]["vals"][label][0]).hex() for d in t.trials]
+
+
+# captured from the pre-PR synchronous fused path (seed 1234, 24 evals,
+# n_startup_jobs=8) — the lookahead=0 bit-identity pin
+GOLD_QL1_X = ['-0x1.f9c2ec0000000p+0', '-0x1.f7f3e00000000p-2', '0x1.258da00000000p+2', '-0x1.6d90740000000p+1', '-0x1.9c01480000000p-1', '-0x1.25c6b40000000p+2', '0x1.3f9d180000000p-1', '0x1.047edc0000000p+2', '0x1.f7fcc00000000p+0', '0x1.458ae20000000p+1', '0x1.5ccb8c0000000p+0', '0x1.03e68a0000000p+1', '-0x1.3dc1740000000p+2', '0x1.cb25a00000000p+1', '0x1.80eba20000000p-2', '-0x1.950b1a0000000p+1', '0x1.0bbf580000000p+0', '0x1.1f08f80000000p+0', '0x1.85ad400000000p+1', '0x1.0387820000000p+0', '-0x1.32e10e0000000p+0', '0x1.3a43f00000000p-2', '0x1.3bc8da0000000p+2', '-0x1.07edf40000000p+1']
+GOLD_QL1_LR = ['0x1.e8f7420000000p-5', '0x1.16480c0000000p-3', '0x1.6c61440000000p+0', '0x1.5396e40000000p-2', '0x1.f8760a0000000p-3', '0x1.1d3e440000000p-3', '0x1.7e43e20000000p+0', '0x1.365e640000000p-2', '0x1.2e124c0000000p+1', '0x1.f7b91a0000000p-1', '0x1.9a4a800000000p-1', '0x1.85d7920000000p-1', '0x1.1bce460000000p-1', '0x1.4378a20000000p+1', '0x1.194d480000000p-1', '0x1.50898c0000000p-3', '0x1.c301d00000000p-5', '0x1.d49f3c0000000p-5', '0x1.9f939a0000000p-5', '0x1.5c55fe0000000p-4', '0x1.69c2dc0000000p-4', '0x1.6de73c0000000p-4', '0x1.495bf80000000p-4', '0x1.7847660000000p-3']
+GOLD_QL4_X = ['-0x1.f9c2ec0000000p+0', '-0x1.6650de0000000p+1', '-0x1.b351680000000p+0', '0x1.ceae0e0000000p+1', '-0x1.a0aa040000000p+0', '-0x1.2b21620000000p+2', '0x1.335d5a0000000p+2', '0x1.3fab100000000p+2', '0x1.3770e40000000p+1', '0x1.030e3c0000000p+1', '0x1.4927020000000p+1', '0x1.34a2dc0000000p+1', '0x1.ccf4c40000000p-2', '0x1.e6a7920000000p-2', '0x1.7d02400000000p-1', '0x1.23bab80000000p-1', '0x1.f2836a0000000p+1', '0x1.cd17540000000p+1', '-0x1.04c9540000000p+2', '0x1.e2d81e0000000p+1', '0x1.0fcdb20000000p+1', '0x1.e46a9a0000000p+0', '0x1.9da5940000000p+0', '0x1.a864be0000000p+0']
+
+# captured from the pre-PR driver: single-process fold digest of a
+# 24-eval conditional-space run — pins that the payload/device-mirror
+# rework kept the fold byte-identical
+GOLD_MH_CHECKSUM = "2e34e3dc7a77f3fcd82fed14adf23dfa961310049c1253a962b928eae2374252"
+GOLD_MH_BEST = "0x1.c0beec0000000p-5"
+
+MH_SPACE = {"x": hp.uniform("x", -5, 5), "m": hp.choice("m", [
+    {"kind": 0, "a": hp.uniform("a", 0, 1)},
+    {"kind": 1, "b": hp.uniform("b", -1, 0)},
+])}
+
+
+def mh_obj(s):
+    inner = s["m"]
+    extra = inner.get("a", 0.0) if inner["kind"] == 0 else -inner.get("b", 0.0)
+    return (s["x"] - 1.0) ** 2 + extra
+
+
+# ---------------------------------------------------------------------------
+# lookahead=0 golden bit-identity + lookahead=1 masked-posterior semantics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ql,gold_x,gold_lr", [
+    (1, GOLD_QL1_X, GOLD_QL1_LR),
+    (4, GOLD_QL4_X, None),
+])
+def test_lookahead0_bitwise_matches_pre_pr_golden(ql, gold_x, gold_lr):
+    t = Trials()
+    algo = functools.partial(tpe.suggest, n_startup_jobs=8)
+    fmin(obj, SPACE, algo=algo, max_evals=24, trials=t, max_queue_len=ql,
+         lookahead=0, rstate=np.random.default_rng(1234),
+         show_progressbar=False)
+    assert _vals(t, "x") == gold_x
+    if gold_lr is not None:
+        assert _vals(t, "lr") == gold_lr
+
+
+def test_lookahead1_equals_pending_masked_reference():
+    # lookahead=1 proposals must equal a reference run where the pending
+    # trial's loss is masked from the posterior — hyperopt's standard
+    # async-evaluation semantics (Bergstra et al. 2011)
+    from hyperopt_tpu.base import JOB_STATE_NEW
+
+    n_startup = 6
+    max_evals = 14
+    algo = functools.partial(tpe.suggest, n_startup_jobs=n_startup)
+    t = Trials()
+    fmin(obj, SPACE, algo=algo, max_evals=max_evals, trials=t,
+         max_queue_len=1, lookahead=1, rstate=np.random.default_rng(5),
+         show_progressbar=False)
+    assert len(t) == max_evals
+
+    # replay the per-ask seed stream the loop drew
+    rng = np.random.default_rng(5)
+    seeds = [rng.integers(2**31 - 1) for _ in range(max_evals)]
+
+    for i in range(n_startup, max_evals):
+        # ask i was dispatched while trial i-1 was still pending: docs
+        # 0..i-2 DONE, doc i-1 present but loss-less
+        docs = [copy.deepcopy(t.trials[j]) for j in range(i)]
+        docs[i - 1]["state"] = JOB_STATE_NEW
+        docs[i - 1]["result"] = {"status": "new"}
+        ref = Trials()
+        ref.insert_trial_docs(docs)
+        ref.refresh()
+        ref_docs = tpe.suggest([i], Domain(obj, SPACE), ref, seeds[i],
+                               n_startup_jobs=n_startup)
+        for label in ("x", "lr"):
+            assert ref_docs[0]["misc"]["vals"][label] == \
+                t.trials[i]["misc"]["vals"][label], f"trial {i} / {label}"
+
+
+def test_lookahead_converges_and_counts():
+    t = Trials()
+    fmin(obj, SPACE, algo=functools.partial(tpe.suggest, n_startup_jobs=8),
+         max_evals=40, trials=t, lookahead=2, max_queue_len=2,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) == 40
+    assert min(l for l in t.losses() if l is not None) < 0.5
+    assert t.obs_metrics.counter("suggest.speculative").value > 0
+
+
+def test_lookahead_validation():
+    with pytest.raises(ValueError, match="lookahead"):
+        fmin(obj, SPACE, algo=lambda ids, d, t, s: [], max_evals=4,
+             lookahead=1, show_progressbar=False)
+    with pytest.raises(ValueError, match="lookahead"):
+        fmin(obj, SPACE, algo=tpe.suggest, max_evals=4, lookahead=-1,
+             show_progressbar=False)
+    # the device loop pipelines on device already: lookahead>0 makes a
+    # device_loop=True run ineligible instead of being silently ignored
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["quadratic1"]
+    with pytest.raises(ValueError, match="lookahead"):
+        fmin(dom.objective, dom.space, algo=tpe.suggest, max_evals=10,
+             lookahead=1, device_loop=True,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+
+
+def test_rand_suggest_async_equals_sync():
+    dom = Domain(obj, SPACE)
+    t1, t2 = Trials(), Trials()
+    docs_sync = rand.suggest([0, 1, 2], dom, t1, 99)
+    handle = rand.suggest_async([0, 1, 2], Domain(obj, SPACE), t2, 99)
+    docs_async = handle.result()
+    assert handle.result() is docs_async  # idempotent
+    for a, b in zip(docs_sync, docs_async):
+        assert a["misc"]["vals"] == b["misc"]["vals"]
+
+
+# ---------------------------------------------------------------------------
+# buffer donation: in-place fold, stale-handle guard, pickle boundary
+# ---------------------------------------------------------------------------
+
+
+def _populated_trials(n=8):
+    t = Trials()
+    fmin(obj, SPACE, algo=rand.suggest, max_evals=n, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    return t
+
+
+def test_donation_folds_in_place():
+    dom = Domain(obj, SPACE)
+    t = _populated_trials()
+    ph = t.history_object(dom.cs.labels)
+    tpe.suggest(t.new_trial_ids(1), dom, t, 17, n_startup_jobs=5)
+    old = ph._dev
+    ptrs = {
+        "losses": old["losses"].unsafe_buffer_pointer(),
+        "x": old["vals"]["x"].unsafe_buffer_pointer(),
+    }
+    tpe.suggest(t.new_trial_ids(1), dom, t, 18, n_startup_jobs=5)
+    # the previous handle was donated (consumed), and the committed mirror
+    # reuses its buffers in place — no cap-sized copy materialized
+    assert old["losses"].is_deleted()
+    assert ph._dev["losses"].unsafe_buffer_pointer() == ptrs["losses"]
+    assert ph._dev["vals"]["x"].unsafe_buffer_pointer() == ptrs["x"]
+    assert not ph._donated  # committed, not pending
+
+
+@pytest.mark.skipif(not os.environ.get("DONATION_GATE"),
+                    reason="opt-in: DONATION_GATE=1 ./run_tests.sh")
+def test_donation_gate_no_cap_sized_copy_per_tick():
+    # the strict allocation gate: across many ticks, every history leaf
+    # keeps its buffer pointer and the number of LIVE cap-sized f32 device
+    # buffers does not grow — i.e. no tick allocates a cap-sized copy
+    import jax.numpy as jnp
+
+    dom = Domain(obj, SPACE)
+    t = _populated_trials()
+    ph = t.history_object(dom.cs.labels)
+    tpe.suggest(t.new_trial_ids(1), dom, t, 1000, n_startup_jobs=5)
+    ptrs = {l: ph._dev["vals"][l].unsafe_buffer_pointer()
+            for l in dom.cs.labels}
+    ptrs["losses"] = ph._dev["losses"].unsafe_buffer_pointer()
+
+    def live_cap_f32():
+        return sum(1 for a in jax.live_arrays()
+                   if a.shape == (ph.cap,) and a.dtype == jnp.float32)
+
+    n0 = live_cap_f32()
+    for i in range(12):
+        tpe.suggest(t.new_trial_ids(1), dom, t, 2000 + i, n_startup_jobs=5)
+        assert ph._dev["losses"].unsafe_buffer_pointer() == ptrs["losses"]
+        for l in dom.cs.labels:
+            assert ph._dev["vals"][l].unsafe_buffer_pointer() == ptrs[l]
+    assert live_cap_f32() <= n0
+
+
+def test_stale_handle_guard():
+    dom = Domain(obj, SPACE)
+    t = _populated_trials()
+    ph = t.history_object(dom.cs.labels)
+    dev, rows = ph.device_state(donate=True)
+    # the classic donated-buffer-reuse crash becomes a clear error
+    with pytest.raises(StaleHistoryError, match="commit_device"):
+        ph.device_state()
+    with pytest.raises(StaleHistoryError, match="donated"):
+        ph.device_view()
+    # host materialization never depends on the (possibly invalid) mirror
+    host = ph.host_materialize()
+    assert len(host["losses"]) == ph.n
+    ph.commit_device(dev)  # hand a handle back: guard clears
+    ph.device_state()
+    ph.abandon_device()
+    assert ph._dev is None and not ph._donated
+
+
+def test_pickle_midrun_with_donation_resumes_bitwise():
+    # satellite regression: pickling Trials mid-run (device mirror live,
+    # donation enabled) and resuming must reproduce the uninterrupted run
+    algo = functools.partial(tpe.suggest, n_startup_jobs=6)
+
+    def make_iter(trials, rng):
+        return FMinIter(algo, Domain(obj, SPACE), trials, rstate=rng,
+                        max_evals=20, show_progressbar=False)
+
+    t_full = Trials()
+    make_iter(t_full, np.random.default_rng(3)).run(20)
+
+    rng = np.random.default_rng(3)
+    t_a = Trials()
+    make_iter(t_a, rng).run(12)
+    labels = Domain(obj, SPACE).cs.labels
+    assert t_a.history_object(labels)._dev is not None  # mirror live
+    t_b = pickle.loads(pickle.dumps(t_a))
+    assert t_b._history is None  # device state never traveled
+    make_iter(t_b, rng).run(8)
+    assert [d["misc"]["vals"] for d in t_b.trials] == \
+        [d["misc"]["vals"] for d in t_full.trials]
+    np.testing.assert_array_equal(t_b.losses(), t_full.losses())
+
+
+def test_device_loop_chunk_donates_state():
+    from hyperopt_tpu.device_fmin import DeviceLoopRunner
+    from hyperopt_tpu.zoo import ZOO
+
+    dom_z = ZOO["quadratic1"]
+    runner = DeviceLoopRunner(Domain(dom_z.objective, dom_z.space),
+                              {"prior_weight": 1.0, "n_EI_candidates": 24,
+                               "gamma": 0.25, "LF": 25}, 5, 40)
+    state = runner.init_state()
+    old_losses = state[2]
+    ptr = old_losses.unsafe_buffer_pointer()
+    state2, rows = runner.run_chunk(state, 0, 10, 0)
+    assert rows.shape[0] == 10
+    assert old_losses.is_deleted()
+    assert state2[2].unsafe_buffer_pointer() == ptr
+
+
+# ---------------------------------------------------------------------------
+# lean multihost payloads
+# ---------------------------------------------------------------------------
+
+
+def test_payload_roundtrip_and_fold_bitwise():
+    from hyperopt_tpu.parallel import payload
+
+    rng = np.random.default_rng(0)
+    W, L = 16, 5
+    losses = rng.normal(size=W).astype(np.float32)
+    losses[3] = np.nan  # failed trial
+    losses[7] = np.inf  # objective returned inf
+    active = rng.random((W, L)) < 0.6
+    evaluated = np.ones(W, bool)
+    evaluated[-2:] = False  # padding rows
+
+    for fmt in ("u8", "f32"):
+        wire = payload.to_wire(losses, active, evaluated, fmt)
+        assert wire.dtype == np.uint8
+        assert wire.shape == (W, payload.row_nbytes(L, fmt))
+        lo, ac, ev = payload.from_wire(wire, L, fmt)
+        # bit-pattern exact, incl. the NaN
+        assert lo.tobytes() == losses.tobytes()
+        np.testing.assert_array_equal(ac, active)
+        np.testing.assert_array_equal(ev, evaluated)
+
+    # the lean rows are at least half the wide f32 rows
+    assert payload.row_nbytes(L, "u8") * 2 <= payload.row_nbytes(L, "f32")
+
+    # fold from either wire format is byte-identical
+    labels = tuple(f"p{i}" for i in range(L))
+    flats = {l: rng.uniform(-1, 1, W).astype(np.float32) for l in labels}
+
+    def fold_via(fmt):
+        cap = 32
+        hist = {"losses": np.full(cap, np.inf, np.float32),
+                "has_loss": np.zeros(cap, bool),
+                "vals": {l: np.zeros(cap, np.float32) for l in labels},
+                "active": {l: np.zeros(cap, bool) for l in labels}}
+        raw = np.full(cap, np.nan, np.float32)
+        lo, ac, ev = payload.from_wire(
+            payload.to_wire(losses, active, evaluated, fmt), L, fmt)
+        k = int(ev.sum())
+        payload.fold_generation(hist, raw, 0, labels,
+                                {l: flats[l][:k] for l in labels},
+                                lo[:k], ac[:k])
+        return (hist["losses"].tobytes(), hist["has_loss"].tobytes(),
+                raw.tobytes(),
+                b"".join(hist["vals"][l].tobytes() for l in labels),
+                b"".join(hist["active"][l].tobytes() for l in labels))
+
+    assert fold_via("u8") == fold_via("f32")
+
+
+def test_payload_wire_format_env(monkeypatch):
+    from hyperopt_tpu.parallel import payload
+
+    assert payload.wire_format({}) == "u8"
+    assert payload.wire_format({"HYPEROPT_TPU_PAYLOAD": "f32"}) == "f32"
+    with pytest.raises(ValueError):
+        payload.wire_format({"HYPEROPT_TPU_PAYLOAD": "zstd"})
+
+
+def test_multihost_single_fold_checksum_golden():
+    # the payload + device-mirror rework must keep the driver's fold (and
+    # its divergence digest) byte-identical to the pre-PR driver
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+
+    res = fmin_multihost(mh_obj, MH_SPACE, max_evals=24, batch=4, seed=7,
+                         n_startup=8, _force_single=True)
+    assert res.checksum == GOLD_MH_CHECKSUM
+    assert float(res.best_loss).hex() == GOLD_MH_BEST
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_env_and_kwarg(tmp_path, monkeypatch):
+    import hyperopt_tpu._env as _env
+
+    old_flag = _env._CACHE_CONFIGURED
+    old_explicit = _env._EXPLICIT_DIR
+    old_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    old_min = getattr(jax.config,
+                      "jax_persistent_cache_min_compile_time_secs", 1.0)
+    try:
+        target = tmp_path / "cc"
+        monkeypatch.setenv("HYPEROPT_TPU_COMPILE_CACHE", str(target))
+        _env.enable_persistent_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+        assert os.path.isdir(target)
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+
+        # an explicit dir argument (fmin's compile_cache=) wins over a
+        # prior configuration
+        target2 = tmp_path / "cc2"
+        monkeypatch.delenv("HYPEROPT_TPU_COMPILE_CACHE")
+        _env.enable_persistent_compilation_cache(str(target2))
+        assert jax.config.jax_compilation_cache_dir == str(target2)
+
+        # opt-out beats everything
+        monkeypatch.setenv("HYPEROPT_TPU_NO_CACHE", "1")
+        _env.enable_persistent_compilation_cache(str(tmp_path / "cc3"))
+        assert jax.config.jax_compilation_cache_dir == str(target2)
+    finally:
+        _env._CACHE_CONFIGURED = old_flag
+        _env._EXPLICIT_DIR = old_explicit
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_min)
+
+
+# ---------------------------------------------------------------------------
+# obs: dispatch/readback split + inflight gauge
+# ---------------------------------------------------------------------------
+
+
+def test_obs_dispatch_readback_spans_and_inflight_gauge(tmp_path):
+    import json
+
+    stream = tmp_path / "run.jsonl"
+    t = Trials()
+    fmin(obj, SPACE, algo=functools.partial(tpe.suggest, n_startup_jobs=6),
+         max_evals=12, trials=t, lookahead=1, obs=str(stream),
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    names = set()
+    metrics = {}
+    with open(stream) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "span":
+                names.add(rec.get("name"))
+            if rec.get("kind") == "metrics":
+                metrics = rec["snapshot"]["metrics"]
+    assert "suggest.dispatch" in names
+    assert "suggest.readback" in names
+    assert "suggest.inflight" in metrics
+    assert metrics["suggest.speculative"] > 0
+    assert metrics["ask.blocked_sec"]["count"] == 12
+    # aggregate view mirrors the split, and phase counts stay ONE per ask
+    # in pipelined mode (speculative dispatches are not double-counted
+    # under "suggest")
+    assert t.phase_timings["suggest"]["count"] == 12
+    assert t.phase_timings["suggest.dispatch"]["count"] == 12
+    assert t.phase_timings["suggest.readback"]["count"] == 12
