@@ -574,6 +574,52 @@ def bench_compile_cache():
         shutil.rmtree(cache_dir, ignore_errors=True)
 
 
+def bench_devmem(max_evals=200, seed=0):
+    """Device-memory telemetry stage (ISSUE 5): run the on-device Branin
+    loop with the devmem sampler armed and attach peak HBM + the live-array
+    census to the stage results (and the headline line), so memory
+    regressions — a leaked cap-sized buffer, a history that stopped being
+    donated — show up in the bench trajectory next to the throughput
+    numbers.  ``peak_hbm_bytes`` is gated lower-is-better by
+    ``scripts/bench_gate.py``.  On backends without ``memory_stats`` (CPU)
+    the byte fields come back None and the census alone is recorded.
+
+    ``peak_bytes_in_use`` is PROCESS-cumulative (the backend never resets
+    it), so this stage runs FIRST in ``_JAX_STAGES``: the recorded peak is
+    attributable to this stage's loop, not to whichever later stage
+    happened to allocate most."""
+    from hyperopt_tpu.device_fmin import fmin_device
+    from hyperopt_tpu.obs import RunObs, ObsConfig
+    from hyperopt_tpu.obs.devmem import DevMemSampler, roll_up
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    obs = RunObs(ObsConfig(level="basic"), run_id="bench-devmem")
+    sampler = DevMemSampler(obs, period=0.0)  # every explicit call samples
+    t0 = time.perf_counter()
+    fmin_device(dom.objective, dom.space, max_evals=max_evals, seed=seed)
+    rec = sampler.sample(reason="bench")
+    wall = time.perf_counter() - t0
+    obs.finish()
+    if rec is None:  # sampler failed open (backend raised): census-only
+        return {"wall_clock_sec": wall, "max_evals": max_evals,
+                "error": "devmem sampling unavailable on this backend"}
+    devices, census = rec["devices"], rec["census"]
+    in_use, peak, limit, _ = roll_up(devices)
+    out = {"wall_clock_sec": wall, "max_evals": max_evals,
+           "n_devices": len(devices),
+           "memory_stats_available": in_use is not None,
+           "census": {k: dict(v) for k, v in census.items()},
+           "history_bytes": census.get("history", {}).get("bytes", 0)}
+    if peak is not None:
+        out["peak_hbm_bytes"] = peak
+        out["bytes_in_use"] = in_use
+        if limit:
+            out["bytes_limit"] = limit
+            out["hbm_watermark_frac"] = peak / limit
+    return out
+
+
 def bench_hr_conditional(max_evals=100, seed=0):
     """BASELINE config #3: Hartmann6 + 20-D Rosenbrock mixed conditional
     space under TPE (28 hyperparameters, nested hp.choice)."""
@@ -893,6 +939,9 @@ def bench_sharded_scaling():
 # every jax-touching stage, in the order the child runs them.  Each entry:
 # (stage name, thunk).  Thunks are resolved inside the child process only.
 _JAX_STAGES = (
+    # FIRST: peak_bytes_in_use is process-cumulative, so the devmem
+    # stage's peak must be recorded before any other stage allocates
+    ("devmem", bench_devmem),
     ("jax_same_grid", lambda: bench_jax(n_cand=24)),
     ("jax_scaled", lambda: bench_jax(n_cand=8192)),
     ("jax_batched", lambda: bench_jax(n_cand=8192, batch=64, repeats=20)),
@@ -1101,6 +1150,14 @@ def main():
         obs_summary["flight_overhead"] = {
             k: rec["result"].get(k)
             for k in ("flight_off_sec", "flight_on_sec", "overhead_frac")}
+    # peak device memory rides the headline line (lower-is-better, gated by
+    # scripts/bench_gate.py): a leaked cap-sized buffer fails the gate
+    rec = stages.get("devmem")
+    if rec and rec.get("ok"):
+        obs_summary["devmem"] = {
+            k: rec["result"].get(k)
+            for k in ("peak_hbm_bytes", "bytes_limit", "hbm_watermark_frac",
+                      "history_bytes", "memory_stats_available")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact

@@ -11,10 +11,80 @@ per call site.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 
-__all__ = ["forced_cpu_env", "enable_persistent_compilation_cache"]
+__all__ = [
+    "forced_cpu_env",
+    "enable_persistent_compilation_cache",
+    "parse_obs_http",
+    "parse_devmem_period",
+]
+
+logger = logging.getLogger(__name__)
+
+# observability env vars follow one convention: unset/0/off disables, a bad
+# value WARNS ONCE and disables — telemetry misconfiguration must never take
+# down the run it would have observed
+_warned_envs = set()
+
+
+def _warn_once(var, raw, why):
+    if var not in _warned_envs:
+        _warned_envs.add(var)
+        logger.warning("%s=%r is not %s; disabling (observability env "
+                       "values warn-and-disable, never raise)", var, raw, why)
+
+
+def parse_obs_http(env=None):
+    """``HYPEROPT_TPU_OBS_HTTP=<port>`` (or ``<host>:<port>`` to bind
+    beyond the loopback default) → the value for ``ObsConfig.http_port``,
+    or None when unset/disabled/invalid.  ``0`` in the ENVIRONMENT means
+    "off" (the kwarg form ``obs_http=0`` means "ephemeral port" — only an
+    explicit API caller can usefully ask for a port it must then
+    discover)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_OBS_HTTP", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    host, _, port_s = raw.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_OBS_HTTP", raw,
+                   "an integer port (or host:port)")
+        return None
+    if not 1 <= port <= 65535:
+        _warn_once("HYPEROPT_TPU_OBS_HTTP", raw, "a port in [1, 65535]")
+        return None
+    return raw if host else port
+
+
+# default devmem sample period, owned here so obs/devmem.py and the env
+# parser can share it without an import cycle
+DEFAULT_DEVMEM_PERIOD_SEC = 10.0
+
+
+def parse_devmem_period(env=None):
+    """``HYPEROPT_TPU_DEVMEM=<seconds>`` → float sample period for the
+    device-memory telemetry sampler (``obs/devmem.py``), or None when
+    unset/disabled/invalid.  ``1``/``on`` selects the default period."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_DEVMEM", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    if raw.lower() in ("1", "on", "true", "yes"):
+        return DEFAULT_DEVMEM_PERIOD_SEC
+    try:
+        period = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_DEVMEM", raw, "a sample period in seconds")
+        return None
+    if not period > 0:
+        _warn_once("HYPEROPT_TPU_DEVMEM", raw, "a positive sample period")
+        return None
+    return period
 
 _CACHE_CONFIGURED = False
 _EXPLICIT_DIR = None  # the explicit dir currently configured, if any

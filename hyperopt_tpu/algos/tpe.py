@@ -1248,9 +1248,13 @@ def suggest_async(
     args = (dev, rows, _seed_words(seed), ids)
     if health is not None:
         from ..obs import health as _health_mod
+        from ..obs.devmem import register_owner
 
         # lower-only cost capture: reads the cost table, consumes no buffers
         _health_mod.capture_jit_cost(run, args, "suggest.tpe")
+        # tag the packed proposal readback buffer for the devmem census
+        # (armed runs only — the disarmed ask path stays byte-identical)
+        register_owner("candidates", (len(ids), len(domain.cs.labels)))
     try:
         out = run(*args)
     except BaseException:

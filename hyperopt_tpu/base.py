@@ -301,6 +301,12 @@ class PaddedHistory:
     _ROW_BUCKETS = (16,)
 
     def _full_upload(self):
+        # tag the cap-sized mirror buffers for the devmem live-array census
+        # (obs/devmem.py) — uploads are rare (first view / growth), so the
+        # set-add is off the per-suggest path
+        from .obs.devmem import register_owner
+
+        register_owner("history", (self.cap,))
         self._dev = {
             "vals": {l: jnp.asarray(self._vals[l]) for l in self.labels},
             "active": {l: jnp.asarray(self._active[l]) for l in self.labels},
@@ -737,6 +743,7 @@ class Trials:
         trials_save_file="",
         device_loop=False,
         obs=None,
+        obs_http=None,
         lookahead=0,
         compile_cache=None,
     ):
@@ -762,6 +769,7 @@ class Trials:
             trials_save_file=trials_save_file,
             device_loop=device_loop,
             obs=obs,
+            obs_http=obs_http,
             lookahead=lookahead,
             compile_cache=compile_cache,
         )

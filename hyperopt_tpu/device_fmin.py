@@ -297,6 +297,12 @@ class DeviceLoopRunner:
 
     def init_state(self):
         cap = self.cap
+        # tag the cap-sized loop state for the devmem live-array census
+        # (obs/devmem.py): an OOM dump then says how much HBM the history
+        # itself held vs everything else.  A set-add per runner, host-side.
+        from .obs.devmem import register_owner
+
+        register_owner("history", (cap,))
         return (
             {l: jnp.zeros(cap, jnp.float32) for l in self.labels},
             {l: jnp.zeros(cap, bool) for l in self.labels},

@@ -125,6 +125,7 @@ class FMinIter:
         trials_save_file="",
         device_loop=False,
         obs=None,
+        obs_http=None,
         lookahead=0,
         compile_cache=None,
     ):
@@ -212,9 +213,29 @@ class FMinIter:
         # phase_timings (back-compat view), and an armed config additionally
         # streams spans/events/metrics as JSONL.  One flag arms everything,
         # including the jax.profiler hook (HYPEROPT_TPU_OBS / obs= kwarg).
+        # obs_http=<port|"host:port"> arms the live scrape server on top of
+        # whatever the obs config says (0 = ephemeral port; see
+        # obs/serve.py — validation happens there, fail-open)
+        if obs_http is not None:
+            if isinstance(obs, obs_mod.RunObs):
+                # a pre-built bundle already decided its server config —
+                # rebuilding it here would double-arm; say so instead of
+                # silently dropping the kwarg
+                logger.warning(
+                    "obs_http=%r ignored: obs= is a pre-built RunObs "
+                    "(set http_port on its ObsConfig instead)", obs_http)
+            else:
+                import dataclasses as _dc
+
+                obs = _dc.replace(obs_mod.ObsConfig.resolve(obs),
+                                  http_port=obs_http)
         self.obs = obs_mod.RunObs.resolve(obs, totals=trials.phase_timings)
         trials.obs_run_id = self.obs.run_id
         trials.obs_metrics = self.obs.metrics  # direct post-run handle
+        # where the live endpoints landed (None when the server is
+        # disarmed or failed open) — the ephemeral-port discovery handle
+        trials.obs_http_url = (self.obs.http.url
+                               if self.obs.http is not None else None)
         # armed runs hand the bundle to the suggesters through the trials
         # object (the suggest plugin signature has no obs channel): tpe
         # switches to its health-instrumented kernel, rand/anneal record
@@ -463,6 +484,7 @@ class FMinIter:
         ) as progress_ctx:
             while n_done < target and not stopped:
                 self.obs.heartbeat("fmin.device_chunk", n_done=n_done)
+                self.obs.devmem_sample()  # chunk-boundary HBM watermark
                 limit = min(n_done + runner.CHUNK, target)
                 seed = (self.rstate.integers(2**31 - 1)
                         if hasattr(self.rstate, "integers")
@@ -521,6 +543,7 @@ class FMinIter:
                         logger.info("Early stop triggered")
                         stopped = True
                 if np.isfinite(best_loss):
+                    self.obs.gauge("best_loss").set(float(best_loss))
                     progress_ctx.postfix = progress_mod.format_postfix(
                         best_loss, self.obs)
                 progress_ctx.update(k)
@@ -604,6 +627,7 @@ class FMinIter:
                 # one beat per ask→tell tick: the stall watchdog's quiet
                 # period measures from here when the host loop wedges
                 self.obs.heartbeat("fmin.tick", n_queued=n_queued)
+                self.obs.devmem_sample()  # tick-boundary HBM watermark
                 qlen = get_queue_len()
                 # land speculative asks first: their device programs ran
                 # while the previous tick's trials evaluated, so only the
@@ -701,6 +725,9 @@ class FMinIter:
                     new_best = min(ok_losses)
                     if new_best < best_loss:
                         best_loss = new_best
+                    # the live scrape server and obs.top read best loss
+                    # from this gauge (a gauge set is a dict store)
+                    self.obs.gauge("best_loss").set(float(best_loss))
                     # armed runs append live search health (EI p50, dup
                     # rate) next to the best loss
                     progress_ctx.postfix = progress_mod.format_postfix(
@@ -777,6 +804,7 @@ def fmin(
     trials_save_file="",
     device_loop=False,
     obs=None,
+    obs_http=None,
     lookahead=0,
     compile_cache=None,
 ):
@@ -798,6 +826,14 @@ def fmin(
     streams spans + trial events + a metrics snapshot to that JSONL file
     (render with ``python -m hyperopt_tpu.obs.report``), or pass an
     :class:`hyperopt_tpu.obs.ObsConfig` directly.
+
+    ``obs_http`` (TPU extension): port for the in-process live scrape
+    server (``/metrics`` Prometheus, ``/snapshot`` JSON, ``/events`` SSE —
+    see ``hyperopt_tpu/obs/serve.py``); ``0`` binds an ephemeral port,
+    read back from ``trials.obs_http_url``.  Defaults to
+    ``HYPEROPT_TPU_OBS_HTTP``.  Watch live with
+    ``python -m hyperopt_tpu.obs.top <url>``.  Fail-open: an occupied
+    port logs one warning and disables the server, never the run.
 
     ``lookahead`` (TPU extension): keep up to N speculative asks in flight
     — the next batch's fused tell+ask program dispatches before the
@@ -869,6 +905,7 @@ def fmin(
             trials_save_file=trials_save_file,
             device_loop=device_loop,
             obs=obs,
+            obs_http=obs_http,
             lookahead=lookahead,
             compile_cache=compile_cache,
         )
@@ -890,6 +927,7 @@ def fmin(
         trials_save_file=trials_save_file,
         device_loop=device_loop,
         obs=obs,
+        obs_http=obs_http,
         lookahead=lookahead,
         compile_cache=compile_cache,
     )

@@ -94,6 +94,17 @@ class ObsConfig:
     disarmed runs, falls back to the recorder's cwd default on abnormal
     death only.  The ring itself is always on regardless of ``level``
     (disable the whole recorder with ``HYPEROPT_TPU_FLIGHT=0``).
+
+    ``http_port`` arms the live scrape server (``obs/serve.py``:
+    ``/metrics`` + ``/snapshot`` + ``/events``) — ``HYPEROPT_TPU_OBS_HTTP``
+    or ``fmin(obs_http=<port>)``; 0 binds an ephemeral port, and a
+    ``"host:port"`` string binds beyond the loopback default (remote
+    Prometheus / cross-host ``obs.top``).
+    ``devmem_period`` arms device-memory telemetry (``obs/devmem.py``)
+    at that sample period in seconds — ``HYPEROPT_TPU_DEVMEM``.  Both are
+    independent of ``level`` (registry scraping needs no JSONL stream) and
+    both fail open: a bad env value, an occupied port, or a backend
+    without ``memory_stats`` warn once and disable.
     """
 
     level: str = "basic"
@@ -101,9 +112,13 @@ class ObsConfig:
     profile_dir: str | None = None
     run_id: str | None = None
     flight_path: str | None = None
+    http_port: int | str | None = None  # port, or "host:port"
+    devmem_period: float | None = None
 
     @classmethod
     def from_env(cls, env=None):
+        from .._env import parse_devmem_period, parse_obs_http
+
         env = os.environ if env is None else env
         raw = env.get("HYPEROPT_TPU_OBS", "").strip()
         profile_dir = env.get("HYPEROPT_TPU_PROFILE", "") or None
@@ -119,7 +134,9 @@ class ObsConfig:
         else:  # a path arms the full trace stream
             level, jsonl_path = "trace", raw
         return cls(level=level, jsonl_path=jsonl_path,
-                   profile_dir=profile_dir, flight_path=flight_path)
+                   profile_dir=profile_dir, flight_path=flight_path,
+                   http_port=parse_obs_http(env),
+                   devmem_period=parse_devmem_period(env))
 
     @classmethod
     def resolve(cls, obs):
@@ -134,7 +151,9 @@ class ObsConfig:
             env_cfg = cls.from_env()
             return cls(level="trace", jsonl_path=str(obs),
                        profile_dir=env_cfg.profile_dir,
-                       flight_path=env_cfg.flight_path)
+                       flight_path=env_cfg.flight_path,
+                       http_port=env_cfg.http_port,
+                       devmem_period=env_cfg.devmem_period)
         raise TypeError(f"obs must be None, a path, or ObsConfig; got {obs!r}")
 
 
@@ -179,6 +198,22 @@ class RunObs:
             if self.sink is not None:
                 # armed runs stream stall records next to their spans
                 self.watchdog.attach_sink(self.sink)
+        # live observability plane (obs/serve.py, obs/devmem.py): both are
+        # arm-optional — a disarmed run imports neither module, starts no
+        # thread, and its hot path stays exactly the pre-serve code
+        self.http = None
+        self.devmem = None
+        if self.config.devmem_period is not None:
+            from .devmem import DevMemSampler
+
+            self.devmem = DevMemSampler(self, period=self.config.devmem_period)
+            self.devmem.start()
+        if self.config.http_port is not None:
+            from .serve import ObsHTTPServer
+
+            http = ObsHTTPServer(self.config.http_port, obs=self)
+            # fail-open: an occupied port warned once inside start()
+            self.http = http if http.start() else None
 
     @classmethod
     def resolve(cls, obs, totals=None, run_id=None):
@@ -203,6 +238,13 @@ class RunObs:
         quiet period means a real hang, not a slow phase."""
         if self.watchdog is not None:
             self.watchdog.beat(component, **detail)
+
+    def devmem_sample(self):
+        """Span-boundary device-memory sample (rate-limited to the
+        configured period; obs/devmem.py).  A disarmed run pays one
+        attribute check."""
+        if self.devmem is not None:
+            self.devmem.maybe_sample()
 
     def trial_event(self, event, tid, **attrs):
         self.events.emit(event, tid, **attrs)
@@ -251,6 +293,13 @@ class RunObs:
         first, or anything resolving the namespace by run id would get a
         fresh empty registry while the bundle keeps counting into this
         one."""
+        if self.devmem is not None and not self._finished:
+            # one final sample (the run's last watermark lands in the
+            # stream/snapshot), then stop the sampler thread
+            self.devmem.sample(reason="finish")
+            self.devmem.stop()
+        if self.http is not None:
+            self.http.stop()
         if self.sink is not None:
             self.sink.write({"kind": "metrics", "run_id": self.run_id,
                              "snapshot": self.snapshot()})
@@ -283,4 +332,14 @@ class RunObs:
                 self.watchdog.retain()
                 if self.sink is not None:
                     self.watchdog.attach_sink(self.sink)
+            if self.devmem is not None:
+                self.devmem.start()  # restart the sampler thread
+            if self.config.http_port is not None:
+                # a shut-down http.server cannot restart: rebuild.  A
+                # pinned port that the finished server just released binds
+                # again; an ephemeral port may move (url is re-read)
+                from .serve import ObsHTTPServer
+
+                http = ObsHTTPServer(self.config.http_port, obs=self)
+                self.http = http if http.start() else None
             self._finished = False

@@ -1,0 +1,281 @@
+"""Device-memory (HBM) telemetry: watermarks, live-array census, OOM
+narrative.
+
+The span/metric pillars answer "where did the time go"; this module answers
+"where did the *memory* go" — the question a multi-hour sweep asks the
+moment XLA raises ``RESOURCE_EXHAUSTED``.  Three pieces:
+
+* :func:`memory_stats` — per-device ``device.memory_stats()``
+  (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``), guarded for
+  backends that return None (CPU) or raise — the sample then records which
+  devices reported nothing instead of failing.
+* :func:`live_array_census` — a ``jax.live_arrays()`` walk bucketed by
+  shape-owner: the donated history pytree (cap-sized buffers registered by
+  ``PaddedHistory`` / ``DeviceLoopRunner`` via :func:`register_owner`),
+  proposal/candidate buffers, and everything else.  This is how an OOM dump
+  says "the history held 1.9 GiB, your objective leaked the rest".
+* :class:`DevMemSampler` — the per-run collector: emits ``devmem.*`` gauges
+  into the run's metrics namespace and ``kind="devmem"`` JSONL records,
+  keeps a bounded tail ring that the flight recorder attaches to crash
+  dumps (``FlightRecorder.devmem``), and optionally runs a low-rate daemon
+  sampler thread.  Span-boundary call sites (``fmin`` tick, device-loop
+  chunk, driver generation) go through :meth:`maybe_sample`, which
+  rate-limits to the configured period — armed sampling adds no per-trial
+  host work beyond a clock read.
+
+Arming: ``HYPEROPT_TPU_DEVMEM=<seconds>`` (sample period; ``1``/``on`` →
+the 10 s default) or ``ObsConfig(devmem_period=...)``.  Disarmed runs
+construct nothing: no thread, no gauges, no census walks.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from .._env import DEFAULT_DEVMEM_PERIOD_SEC
+
+__all__ = [
+    "DEFAULT_PERIOD_SEC",
+    "memory_stats",
+    "live_array_census",
+    "register_owner",
+    "roll_up",
+    "DevMemSampler",
+]
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PERIOD_SEC = DEFAULT_DEVMEM_PERIOD_SEC
+
+# shape-owner registry for the census: owner name -> set of array shapes.
+# Registration happens at history-allocation sites (PaddedHistory uploads,
+# DeviceLoopRunner.init_state, suggest readback buffers) — rare, host-side,
+# a set-add each; the census classifies by exact shape match at walk time.
+_OWNER_SHAPES: dict = {}
+_OWNER_LOCK = threading.Lock()
+
+
+def register_owner(name, shape):
+    """Tag arrays of ``shape`` as belonging to ``name`` ("history",
+    "candidates") in the live-array census.  Idempotent and cheap."""
+    shape = tuple(int(d) for d in shape)
+    with _OWNER_LOCK:
+        _OWNER_SHAPES.setdefault(str(name), set()).add(shape)
+
+
+def _owner_of(shape):
+    with _OWNER_LOCK:
+        for name, shapes in _OWNER_SHAPES.items():
+            if shape in shapes:
+                return name
+    return "other"
+
+
+def memory_stats():
+    """Per-device memory stats: ``[{device, platform, bytes_in_use,
+    peak_bytes_in_use, bytes_limit}, ...]``.  Backends without the API (CPU
+    often returns None, some PJRT plugins raise) yield entries whose byte
+    fields are None — the caller decides how to render "unavailable"."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        entry = {"device": str(d), "platform": d.platform,
+                 "bytes_in_use": None, "peak_bytes_in_use": None,
+                 "bytes_limit": None}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if isinstance(stats, dict):
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                v = stats.get(key)
+                if v is not None:
+                    entry[key] = int(v)
+        out.append(entry)
+    return out
+
+
+def live_array_census():
+    """Bucket every live jax array by shape-owner:
+    ``{owner: {"count", "bytes"}}`` plus a ``"total"`` roll-up.  Only
+    arrays a Python reference keeps alive are visible — which is exactly
+    the leak surface (in-trace temporaries free themselves)."""
+    import jax
+
+    buckets = {}
+    total_n = total_b = 0
+    for a in jax.live_arrays():
+        try:
+            shape, nbytes = tuple(a.shape), int(a.nbytes)
+        except Exception:  # deleted/donated handle mid-walk
+            continue
+        owner = _owner_of(shape)
+        b = buckets.setdefault(owner, {"count": 0, "bytes": 0})
+        b["count"] += 1
+        b["bytes"] += nbytes
+        total_n += 1
+        total_b += nbytes
+    buckets["total"] = {"count": total_n, "bytes": total_b}
+    return buckets
+
+
+def roll_up(devices):
+    """Max-watermark roll-up across one sample's per-device entries (the
+    number a progressbar, report line or dashboard row wants):
+    ``(in_use, peak, limit, frac)`` with None where no device reported.
+    THE one implementation — report/top/bench all read through here."""
+    # .get: parsed-JSONL consumers may hand in records whose entries were
+    # written by an older/trimmed producer
+    in_use = [d.get("bytes_in_use") for d in devices]
+    in_use = [v for v in in_use if v is not None]
+    peaks = [d.get("peak_bytes_in_use") for d in devices]
+    peaks = [v for v in peaks if v is not None]
+    limits = [d.get("bytes_limit") for d in devices]
+    limits = [v for v in limits if v is not None]
+    mx_use = max(in_use) if in_use else None
+    mx_peak = max(peaks) if peaks else None
+    mx_lim = max(limits) if limits else None
+    frac = (mx_use / mx_lim) if (mx_use is not None and mx_lim) else None
+    return mx_use, mx_peak, mx_lim, frac
+
+
+class DevMemSampler:
+    """Per-run device-memory collector (see module docstring).
+
+    ``sample()`` does the work: read per-device stats, walk the census, set
+    ``devmem.*`` gauges on the run's registry, stream a ``kind="devmem"``
+    JSONL record when the run is armed, and remember the record in a
+    bounded tail ring for crash dumps.  ``maybe_sample()`` is the
+    span-boundary entry: a monotonic-clock read, then ``sample()`` at most
+    once per ``period``.
+    """
+
+    def __init__(self, obs, period=DEFAULT_PERIOD_SEC, keep=32):
+        self.obs = obs
+        self.period = float(period)
+        self._tail = deque(maxlen=int(keep))
+        self._last_mono = None
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._dead = False
+
+    # -- sampling ----------------------------------------------------------
+
+    def maybe_sample(self, reason="span"):
+        """Rate-limited sample — the span-boundary hot-path entry.  Costs
+        one clock read between samples."""
+        now = time.monotonic()
+        last = self._last_mono
+        if last is not None and now - last < self.period:
+            return None
+        return self.sample(reason=reason)
+
+    def sample(self, reason="tick"):
+        """Take one sample now; returns the record (or None after a
+        permanent failure — telemetry never raises into the run)."""
+        if self._dead:
+            return None
+        try:
+            return self._sample(reason)
+        except Exception as e:
+            self._dead = True
+            logger.warning("devmem sampling failed (%s); disabling the "
+                           "sampler — the run continues without HBM "
+                           "telemetry", e)
+            return None
+
+    def _sample(self, reason):
+        self._last_mono = time.monotonic()
+        devices = memory_stats()
+        census = live_array_census()
+        mx_use, mx_peak, mx_lim, frac = roll_up(devices)
+        obs = self.obs
+        m = obs.metrics
+        m.counter("devmem.samples").inc()
+        if mx_use is not None:
+            m.gauge("devmem.bytes_in_use").set(mx_use)
+        if mx_peak is not None:
+            m.gauge("devmem.peak_bytes_in_use").set(mx_peak)
+        if mx_lim is not None:
+            m.gauge("devmem.bytes_limit").set(mx_lim)
+        if frac is not None:
+            m.gauge("devmem.watermark_frac").set(frac)
+        hist_b = census.get("history", {}).get("bytes", 0)
+        m.gauge("devmem.history_bytes").set(hist_b)
+        m.gauge("devmem.live_arrays").set(census["total"]["count"])
+        m.gauge("devmem.live_bytes").set(census["total"]["bytes"])
+        rec = {"kind": "devmem", "ts": time.time(), "reason": reason,
+               "run_id": obs.run_id, "devices": devices, "census": census}
+        with self._lock:
+            self._tail.append(rec)
+        sink = getattr(obs, "sink", None)
+        if sink is not None:
+            sink.write(rec)
+        return rec
+
+    # -- crash-dump providers (FlightRecorder.devmem) ----------------------
+
+    def tail(self):
+        """Recent samples, oldest first — attached to flight dumps."""
+        with self._lock:
+            return list(self._tail)
+
+    def census_record(self):
+        """A fresh census as a JSONL record (taken AT dump time: the tail
+        shows the ramp, this shows the end state)."""
+        return {"kind": "devmem_census", "ts": time.time(),
+                "census": live_array_census()}
+
+    def watermark(self):
+        """``(frac, peak_bytes)`` from the last sample's roll-up, or
+        ``(None, None)`` before the first — the progressbar's HBM line.
+        ``frac`` is CURRENT in-use/limit (what a live surface wants);
+        the report's "peak watermark" is peak/limit — a different number
+        on runs whose allocation spiked and settled."""
+        with self._lock:
+            if not self._tail:
+                return None, None
+            devices = self._tail[-1]["devices"]
+        _, mx_peak, _, frac = roll_up(devices)
+        return frac, mx_peak
+
+    # -- sampler-thread lifecycle ------------------------------------------
+
+    def start(self):
+        """Start the low-rate daemon sampler (idempotent).  Span-boundary
+        ``maybe_sample`` calls cover the busy phases; the thread covers the
+        quiet ones (a wedged readback still advances the HBM tail).
+        ``period <= 0`` means explicit-sample-only (bench mode): no thread
+        at all — a zero wait would busy-spin."""
+        if (self.period > 0
+                and (self._thread is None or not self._thread.is_alive())):
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="hyperopt-obs-devmem", daemon=True)
+            self._thread.start()
+        from .flight import get_flight
+
+        fl = get_flight()
+        if fl.devmem is None:
+            fl.devmem = self  # crash dumps attach the memory narrative
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.period):
+            self.maybe_sample(reason="sampler")
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        from .flight import get_flight
+
+        fl = get_flight()
+        if fl.devmem is self:
+            fl.devmem = None

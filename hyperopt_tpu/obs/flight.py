@@ -121,6 +121,16 @@ class FlightRecorder:
         self.max_records = int(max_records)
         self.max_bytes = int(max_bytes)
         self.watchdog = None  # optional: last-heartbeat provider for dumps
+        self.devmem = None  # optional: device-memory tail/census provider
+        # optional live-record tap (the scrape server's SSE broadcast hub,
+        # obs/serve.py).  One attribute load + None check per record when no
+        # server is armed; the tap itself must never raise or block (the
+        # broadcast hub appends to bounded per-client rings, dropping
+        # oldest on overflow)
+        self.tap = None
+        # shutdown hooks (scrape-server close): run on fatal signals and at
+        # atexit so an armed HTTP listener dies with the run, not after it
+        self._shutdown_hooks = []
         self._ring = deque()  # (record dict, estimated bytes)
         self._bytes = 0
         # REENTRANT: the fatal-signal handler runs on the main thread
@@ -159,6 +169,12 @@ class FlightRecorder:
             while self._ring and (len(self._ring) > self.max_records
                                   or self._bytes > self.max_bytes):
                 self._bytes -= self._ring.popleft()[1]
+        tap = self.tap
+        if tap is not None:
+            try:
+                tap(rec)
+            except Exception:  # a broken tap must not touch the hot path
+                self.tap = None
 
     def note_open(self, key, name, ts):
         """Register a span as open; a dump reports every span still open at
@@ -194,6 +210,30 @@ class FlightRecorder:
         with self._lock:
             if path in self._targets:
                 self._targets.remove(path)
+
+    def add_shutdown_hook(self, fn):
+        """Register an idempotent, non-raising callable to run when the
+        process dies (fatal signal or atexit) — how the scrape server's
+        listener socket is closed on the flight recorder's signal path."""
+        with self._lock:
+            if fn not in self._shutdown_hooks:
+                self._shutdown_hooks.append(fn)
+
+    def remove_shutdown_hook(self, fn):
+        with self._lock:
+            if fn in self._shutdown_hooks:
+                self._shutdown_hooks.remove(fn)
+
+    def run_shutdown_hooks(self):
+        """Run (and keep) the registered hooks; they are idempotent, so a
+        signal dump followed by the atexit dump is safe."""
+        with self._lock:
+            hooks = list(self._shutdown_hooks)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:  # a dying process: best-effort only
+                pass
 
     def install(self, path=None):
         """Arm the crash handlers (idempotent) and, when ``path`` is given,
@@ -288,6 +328,17 @@ class FlightRecorder:
                      "beats": wd.last_beats()}, default=_json_default))
             except Exception:
                 pass
+        dm = self.devmem
+        if dm is not None:
+            # the memory narrative: recent devmem samples + a live-array
+            # census, so an OOM'd process dumps WHAT was holding HBM
+            try:
+                for rec in dm.tail():
+                    extra.append(json.dumps(rec, default=_json_default))
+                extra.append(json.dumps(dm.census_record(),
+                                        default=_json_default))
+            except Exception:
+                pass
         written = []
         for target in targets:
             try:
@@ -321,6 +372,7 @@ class FlightRecorder:
                      "ts": time.time(), "attrs": {"signal": name}})
         self._abnormal_seq = self._seq
         self.dump(reason=f"signal:{name}")
+        self.run_shutdown_hooks()
         prev = self._prev_signal.get(signum)
         if callable(prev):
             prev(signum, frame)
@@ -337,6 +389,14 @@ class FlightRecorder:
                          "ts": time.time(),
                          "attrs": {"type": exc_type.__name__,
                                    "message": str(exc)[:500]}})
+            if self.devmem is not None and "RESOURCE_EXHAUSTED" in str(exc):
+                # device OOM: take one FRESH sample + census at the moment
+                # of death (the tail alone shows the ramp, not the peak
+                # that killed us) so the dump carries a memory narrative
+                try:
+                    self.devmem.sample(reason="oom")
+                except Exception:
+                    pass
             self._abnormal_seq = self._seq
             self.dump(reason=f"exception:{exc_type.__name__}")
         finally:
@@ -352,6 +412,7 @@ class FlightRecorder:
         if self._targets and (self._abnormal_seq is None
                               or self._seq > self._abnormal_seq):
             self.dump(reason="atexit")
+        self.run_shutdown_hooks()
 
 
 _global = None

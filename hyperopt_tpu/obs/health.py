@@ -70,6 +70,7 @@ __all__ = [
     "record_program_cost",
     "capture_jit_cost",
     "utilization_snapshot",
+    "utilization_from_metrics",
     "controller_stream_path",
 ]
 
@@ -281,7 +282,16 @@ def utilization_snapshot(wall_sec=None, stages=("chunk", "whole_run"),
     the execute totals cover every stage so far, and the clip keeps the
     fraction sane rather than exact."""
     reg = metrics if metrics is not None else get_metrics("device")
-    dev = reg.snapshot()["metrics"]
+    return utilization_from_metrics(reg.snapshot()["metrics"],
+                                    wall_sec=wall_sec, stages=stages)
+
+
+def utilization_from_metrics(dev, wall_sec=None,
+                             stages=("chunk", "whole_run")):
+    """:func:`utilization_snapshot` over an already-snapshotted metrics
+    dict — the form a RECORDED stream's final snapshot arrives in, so the
+    live ``/snapshot`` endpoint and ``obs.report --format json`` share one
+    join (obs/serve.py, report.headline_sections)."""
     out = {}
     busy_total = 0.0
     for st in stages:

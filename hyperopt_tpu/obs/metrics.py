@@ -134,12 +134,19 @@ class MetricsRegistry:
     def histogram(self, name, maxlen=512) -> Histogram:
         return self._get(name, Histogram, maxlen)
 
+    def iter_metrics(self):
+        """Sorted ``(name, metric object)`` pairs — the typed view the
+        Prometheus exposition needs (a snapshot can't distinguish a counter
+        from an integer-valued gauge).  ``dict()`` first: the scrape
+        thread iterates while the run thread creates metrics."""
+        return sorted(dict(self._metrics).items())
+
     def snapshot(self):
         return {
             "namespace": self.namespace,
             "metrics": {
                 name: m.snapshot()
-                for name, m in sorted(self._metrics.items())
+                for name, m in sorted(dict(self._metrics).items())
             },
         }
 

@@ -56,7 +56,8 @@ from .events import (
 )
 from .trace import iter_jsonl, read_jsonl  # noqa: F401  (read_jsonl re-export)
 
-__all__ = ["main", "render", "render_merged", "render_postmortem"]
+__all__ = ["main", "render", "render_merged", "render_postmortem",
+           "headline_sections", "json_report"]
 
 _BAR_W = 30
 
@@ -290,12 +291,164 @@ def _pipeline_section(spans, metrics, out):
                    "synchronous dispatch+readback)")
 
 
+def _devmem_section(devmem_recs, out):
+    """HBM watermark over the run's devmem samples (obs/devmem.py) + the
+    last live-array census, so "how much memory did it hold" is answerable
+    from the report alone."""
+    if not devmem_recs:
+        return
+    from .devmem import roll_up
+
+    out.append("")
+    out.append("== device memory (HBM) " + "=" * 41)
+    rolls = [roll_up(r.get("devices", [])) for r in devmem_recs]
+    in_use = [r[0] for r in rolls]
+    limit = next((r[2] for r in reversed(rolls) if r[2] is not None), None)
+    peak = max((r[1] for r in rolls if r[1] is not None), default=None)
+    if peak is None and not any(v is not None for v in in_use):
+        out.append(f"  {len(devmem_recs)} sample(s); backend reports no "
+                   "memory_stats (CPU?) — census only")
+    else:
+        line = f"  samples {len(devmem_recs)}"
+        if peak is not None:
+            line += f"  peak {_fmt_bytes(peak)}"
+        if limit:
+            line += f"  limit {_fmt_bytes(limit)}"
+            if peak is not None:
+                # explicitly the PEAK fraction — the live "hbm N%"
+                # progressbar/top figure is current in-use, a different
+                # (and for a live surface, more useful) number
+                line += f"  peak watermark {peak / limit:.0%}"
+        out.append(line)
+        spark = _spark([v for v in in_use if v is not None])
+        if spark:
+            out.append(f"  in-use trend  {spark}")
+    census = devmem_recs[-1].get("census") or {}
+    if census:
+        parts = []
+        for owner in sorted(census):
+            if owner == "total":
+                continue
+            b = census[owner]
+            parts.append(f"{owner} {_fmt_bytes(b['bytes'])} "
+                         f"(x{b['count']})")
+        tot = census.get("total", {})
+        out.append("  live arrays (last census): " + "  ".join(parts)
+                   + (f"  | total {_fmt_bytes(tot.get('bytes', 0))} "
+                      f"(x{tot.get('count', 0)})" if tot else ""))
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f}{unit}" if unit == "B" else f"{n:.2f}{unit}")
+        n /= 1024
+
+
+# ---------------------------------------------------------------------------
+# the shared headline serializer (``--format json`` == ``/snapshot``)
+# ---------------------------------------------------------------------------
+
+
+def headline_sections(phases, metrics, device_metrics, wall_sec=None):
+    """The four headline report sections as pure data — report (phase
+    breakdown), health, utilization, ask_pipeline.
+
+    ONE serializer for both consumers: the live ``/snapshot`` endpoint
+    (obs/serve.py) feeds it the tracer's phase totals + live registry
+    snapshots, ``obs.report --format json`` feeds it the same shapes
+    recovered from a recorded stream — so the two outputs can never drift
+    (tests/test_serve.py golden-pins the structure).
+
+    ``phases``: ``{name: {"sec", "count"}}``; ``metrics`` /
+    ``device_metrics``: snapshotted metric dicts (the ``"metrics"`` value
+    of ``MetricsRegistry.snapshot()``).
+    """
+    from .health import utilization_from_metrics
+
+    total = sum(e.get("sec", 0.0) for e in phases.values()) or 1.0
+    report = {
+        name: {"sec": e.get("sec", 0.0), "count": e.get("count", 0),
+               "frac": e.get("sec", 0.0) / total}
+        for name, e in sorted(phases.items())
+    }
+
+    health = {"asks": metrics.get("health.asks", 0)}
+    if health["asks"]:
+        health.update(
+            proposals=metrics.get("health.proposals", 0),
+            prior_fallbacks=metrics.get("health.prior_fallbacks", 0),
+            last_ei_p50=metrics.get("health.last_ei_p50"),
+            last_dup_rate=metrics.get("health.last_dup_rate"),
+            n_below=metrics.get("health.n_below"),
+            n_above=metrics.get("health.n_above"),
+            ei_p50=metrics.get("health.ei_p50"),
+            dup_rate=metrics.get("health.dup_rate"),
+        )
+
+    blocked = metrics.get("ask.blocked_sec")
+    ask_pipeline = {
+        "calls": metrics.get("suggest.calls", 0),
+        "speculative": metrics.get("suggest.speculative", 0),
+        "inflight": metrics.get("suggest.inflight", 0),
+        "queue_depth": metrics.get("queue_depth", 0),
+        "blocked_sec": blocked if isinstance(blocked, dict) else None,
+    }
+
+    return {
+        "report": report,
+        "health": health,
+        "utilization": utilization_from_metrics(device_metrics,
+                                                wall_sec=wall_sec),
+        "ask_pipeline": ask_pipeline,
+    }
+
+
+def _stream_sections(records):
+    """Recover :func:`headline_sections` inputs from a recorded stream:
+    phase totals re-aggregated from spans (same wall-clock-by-name sum the
+    live ``PhaseTimings`` accumulates), metric dicts from the final
+    embedded snapshot."""
+    phases = {}
+    for s in records:
+        if s.get("kind") != "span" or s.get("aggregate") is False:
+            # aggregate=False umbrella spans are excluded from the live
+            # totals too — offline and live rebuild the SAME dict
+            continue
+        e = phases.setdefault(s["name"], {"sec": 0.0, "count": 0})
+        e["sec"] += s.get("wall_sec", 0.0)
+        e["count"] += 1
+    metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    snap = metric_recs[-1].get("snapshot", {}) if metric_recs else {}
+    metrics = snap.get("metrics", {})
+    device = ((snap.get("shared") or {}).get("device") or {}).get(
+        "metrics", {})
+    run_ids = sorted({r["run_id"] for r in records if r.get("run_id")})
+    return {"run_id": ",".join(run_ids) or None,
+            "sections": headline_sections(phases, metrics, device)}
+
+
+def json_report(streams, merge=False):
+    """``--format json``: the machine-readable headline sections for one
+    stream (or per controller with ``--merge``), via the SAME serializer
+    the live ``/snapshot`` endpoint uses."""
+    if not merge:
+        return _stream_sections(streams[0][1])
+    return {"merged": True,
+            "controllers": {name: _stream_sections(recs)
+                            for name, recs in streams}}
+
+
 def render(records, top=5):
     """Build the report text from parsed JSONL records."""
     spans = [r for r in records if r.get("kind") == "span"]
     trial_events = [r for r in records if r.get("kind") == "trial_event"]
     metric_recs = [r for r in records if r.get("kind") == "metrics"]
     health_recs = [r for r in records if r.get("kind") == "health"]
+    devmem_recs = [r for r in records if r.get("kind") == "devmem"]
     events = [r for r in records if r.get("kind") == "event"]
 
     out = []
@@ -305,6 +458,7 @@ def render(records, top=5):
     out.append("")
     out.append("== search health " + "=" * 47)
     _health_section(health_recs, out)
+    _devmem_section(devmem_recs, out)
     out.append("")
     out.append("== trial-state waterfall " + "=" * 39)
     _waterfall_section(trial_events, out)
@@ -405,6 +559,38 @@ def render_merged(streams):
         out.append("  (no allgather metrics in the streams — single-process"
                    " run, or metrics snapshots missing)")
 
+    # per-controller device memory: each controller samples its OWN devices
+    # (obs/devmem.py), so the merged view is the cluster's HBM picture
+    from .devmem import roll_up
+
+    dm_rows = []
+    for name, recs in streams:
+        dms = [r for r in recs if r.get("kind") == "devmem"]
+        if not dms:
+            continue
+        rolls = [roll_up(r.get("devices", [])) for r in dms]
+        peaks = [r[1] for r in rolls if r[1] is not None]
+        limits = [r[2] for r in rolls if r[2] is not None]
+        hist = (dms[-1].get("census") or {}).get("history", {})
+        dm_rows.append((name, len(dms),
+                        max(peaks) if peaks else None,
+                        max(limits) if limits else None,
+                        hist.get("bytes")))
+    if dm_rows:
+        out.append("")
+        out.append("== device memory per controller " + "=" * 32)
+        w = max(len(n) for n, *_ in dm_rows)
+        for name, n, peak, limit, hist_b in dm_rows:
+            line = (f"  {name:<{w}}  samples {n}"
+                    f"  peak {_fmt_bytes(peak):>10}")
+            if limit:
+                line += f"  limit {_fmt_bytes(limit):>10}"
+                if peak is not None:
+                    line += f"  peak watermark {peak / limit:.0%}"
+            if hist_b is not None:
+                line += f"  history {_fmt_bytes(hist_b)}"
+            out.append(line)
+
     out.append("")
     out.append("== per-controller phase breakdown " + "=" * 30)
     for c in ctrls:
@@ -444,7 +630,7 @@ def _last_moments(records, death_ts, out, tail=12):
     """The ring's final records, as a T-minus timeline."""
     shown = [r for r in records
              if r.get("kind") in ("span", "event", "trial_event", "stall",
-                                  "health") and "ts" in r][-tail:]
+                                  "health", "devmem") and "ts" in r][-tail:]
     if not shown:
         out.append("  (empty ring)")
         return
@@ -466,6 +652,14 @@ def _last_moments(records, death_ts, out, tail=12):
                     f"(#{r.get('stall_count', '?')})")
         elif kind == "health":
             what = f"health ask ({r.get('algo', '?')})"
+        elif kind == "devmem":
+            census = r.get("census") or {}
+            tot = census.get("total", {})
+            devs = [d.get("bytes_in_use") for d in r.get("devices", [])
+                    if d.get("bytes_in_use") is not None]
+            what = (f"devmem  in-use {_fmt_bytes(max(devs) if devs else None)}"
+                    f"  live {_fmt_bytes(tot.get('bytes'))}"
+                    f" (x{tot.get('count', '?')})")
         else:
             what = f"event {r.get('name', '?')}"
         out.append(f"  T-{dt:8.2f}s  {what}")
@@ -552,6 +746,18 @@ def render_postmortem(records, name=None):
     out.extend(inflight if inflight
                else ["  (none — no trial was mid-evaluation)"])
 
+    # the memory narrative (devmem tail + at-death census attached by the
+    # flight recorder when the sampler was armed — OOMs die explained)
+    devmem_recs = [r for r in recs if r.get("kind") == "devmem"]
+    census_recs = [r for r in recs if r.get("kind") == "devmem_census"]
+    if devmem_recs or census_recs:
+        _devmem_section(devmem_recs, out)
+        if census_recs:
+            census = census_recs[-1].get("census") or {}
+            parts = [f"{o} {_fmt_bytes(b['bytes'])} (x{b['count']})"
+                     for o, b in sorted(census.items()) if o != "total"]
+            out.append("  at-death census: " + ("  ".join(parts) or "(empty)"))
+
     out.append("")
     out.append("== last records " + "=" * 48)
     _last_moments(recs, death_ts, out)
@@ -579,7 +785,15 @@ def main(argv=None):
                    help="write Chrome/Perfetto trace-event JSON to OUT "
                         "instead of rendering (each input stream becomes "
                         "its own process track group)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="json: machine-readable headline sections "
+                        "(report/health/utilization/ask-pipeline) via the "
+                        "same serializer the live /snapshot endpoint uses")
     args = p.parse_args(argv)
+    if args.format == "json" and args.postmortem:
+        print("error: --format json applies to the report/merge views, "
+              "not --postmortem", file=sys.stderr)
+        return 2
     for path in args.jsonl:
         if not os.path.exists(path):
             print(f"error: cannot read {path}: no such file",
@@ -612,7 +826,11 @@ def main(argv=None):
         print("error: no telemetry records in "
               + ", ".join(args.jsonl), file=sys.stderr)
         return 1
-    if args.postmortem:
+    if args.format == "json":
+        json.dump(json_report(streams, merge=args.merge), sys.stdout,
+                  indent=2, sort_keys=True, default=str)
+        sys.stdout.write("\n")
+    elif args.postmortem:
         for name, recs in streams:
             sys.stdout.write(render_postmortem(recs, name=name))
     elif args.merge:

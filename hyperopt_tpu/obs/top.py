@@ -1,0 +1,315 @@
+"""``obs.top`` — a live, curses-free terminal dashboard over a running
+sweep.
+
+Usage::
+
+    python -m hyperopt_tpu.obs.top http://127.0.0.1:9109        # scrape
+    python -m hyperopt_tpu.obs.top http://h0:9109 http://h1:9110  # multihost
+    python -m hyperopt_tpu.obs.top run.jsonl                    # tail files
+    python -m hyperopt_tpu.obs.top rundir/                      # tail a dir
+
+URL mode polls each server's ``/snapshot`` endpoint (the scrape server
+``fmin(obs_http=...)`` / ``HYPEROPT_TPU_OBS_HTTP`` arms — obs/serve.py);
+give one URL per controller for the multihost per-controller view (the
+driver offsets ``run.p<i>`` ports by process index).  File mode re-reads
+JSONL streams and rebuilds the same sections via the shared serializer —
+useful when the run armed a stream but no server.
+
+The screen redraws with plain ANSI (clear + home) every ``--interval``
+seconds: best loss + throughput, ask-pipeline inflight/blocked, EI/dup
+sparklines (trend accumulated across refreshes), HBM watermark, and a
+per-controller liveness table (last-heartbeat ages).  ``--once`` renders a
+single frame without clearing — scripts and tests use that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+from .report import _fmt_bytes, _fmt_sec, _spark
+
+__all__ = ["main", "render_frame", "fetch_snapshot", "snapshot_from_stream",
+           "snapshot_from_records"]
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_snapshot(url, timeout=3.0):
+    """GET ``<url>/snapshot`` → dict, or ``{"error": ...}`` (a dead
+    controller renders as a dead row, never a dead dashboard)."""
+    import urllib.request
+
+    if not url.rstrip("/").endswith("/snapshot"):
+        url = url.rstrip("/") + "/snapshot"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+class _StreamTail:
+    """Incrementally-tailed JSONL source: each refresh parses only the
+    bytes appended since the last one (a refresh loop over a multi-hour
+    stream must not re-parse hundreds of MB per frame).  A torn final
+    line (the run mid-write) is left for the next frame."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self.records = []
+
+    def read_new(self):
+        # binary mode: the resume offset is a byte count, and text-mode
+        # seek to arbitrary integers is undefined (and drifts on
+        # non-UTF-8 locales)
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            while True:
+                line = f.readline()
+                if not line or not line.endswith(b"\n"):
+                    break  # EOF or torn tail: retry from offset next frame
+                self.offset += len(line)
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self.records.append(json.loads(line.decode("utf-8")))
+                except (ValueError, UnicodeDecodeError):
+                    pass  # torn-then-flushed garbage: skip like iter_jsonl
+
+    def snapshot(self):
+        try:
+            self.read_new()
+        except OSError as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+        return snapshot_from_records(self.records)
+
+
+def snapshot_from_records(records):
+    """Rebuild the snapshot shape from parsed JSONL records via the SAME
+    serializer the live endpoint uses — then overlay what a MID-RUN
+    stream can tell us that the sections cannot: the metrics snapshot the
+    sections are built from is only written at ``RunObs.finish()``, so
+    until the run exits the trial count comes from lifecycle events and
+    the health gauges from the live ``kind="health"`` records."""
+    from .events import TRIAL_FINISHED
+    from .report import _stream_sections
+
+    out = _stream_sections(records)
+    out["ts"] = max((r["ts"] for r in records if "ts" in r), default=None)
+    dms = [r for r in records if r.get("kind") == "devmem"]
+    if dms:
+        out["devmem"] = dms[-1]
+    # best loss from the stream's final metrics snapshot gauge
+    metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    if metric_recs:
+        m = (metric_recs[-1].get("snapshot") or {}).get("metrics", {})
+        if "best_loss" in m:
+            out["best_loss"] = m["best_loss"]
+        out["trials_completed"] = m.get("trials.completed", 0)
+    else:
+        out["trials_completed"] = sum(
+            1 for r in records if r.get("kind") == "trial_event"
+            and r.get("event") == TRIAL_FINISHED)
+    health = out["sections"]["health"]
+    if not health.get("asks"):
+        hrecs = [r for r in records if r.get("kind") == "health"]
+        if hrecs:
+            health["asks"] = len(hrecs)
+            last = hrecs[-1]
+            if "ei_p50" in last:
+                health["last_ei_p50"] = last["ei_p50"]
+            if "dup_rate" in last:
+                health["last_dup_rate"] = last["dup_rate"]
+    return out
+
+
+def snapshot_from_stream(path):
+    """One-shot file-mode source (``--once`` / tests): full read."""
+    return _StreamTail(path).snapshot()
+
+
+def _expand_sources(args_sources):
+    """URLs pass through; a directory expands to its ``*.jsonl`` streams
+    (flight dumps excluded)."""
+    out = []
+    for src in args_sources:
+        if src.startswith(("http://", "https://")):
+            out.append(("url", src))
+        elif os.path.isdir(src):
+            for p in sorted(glob.glob(os.path.join(src, "*.jsonl"))):
+                if ".flight." not in os.path.basename(p):
+                    out.append(("file", p))
+        else:
+            out.append(("file", src))
+    return out
+
+
+class History:
+    """Per-source trend memory across refreshes: EI p50, dup rate, HBM
+    watermark, completed-trial counts (for throughput)."""
+
+    def __init__(self, width=120):
+        self.width = width
+        self.series = {}
+        self._counts = []  # (mono ts, trials completed)
+
+    def push(self, key, value):
+        if value is None:
+            return
+        s = self.series.setdefault(key, [])
+        s.append(float(value))
+        del s[:-self.width]
+
+    def trend(self, key):
+        return self.series.get(key, [])
+
+    def push_count(self, n_completed, now=None):
+        if n_completed is None:
+            return
+        self._counts.append((time.monotonic() if now is None else now,
+                             float(n_completed)))
+        del self._counts[:-self.width]
+
+    def throughput(self):
+        """trials/sec over the sampled window (None before 2 samples)."""
+        if len(self._counts) < 2:
+            return None
+        (t0, n0), (t1, n1) = self._counts[0], self._counts[-1]
+        if t1 <= t0:
+            return None
+        return max(0.0, (n1 - n0) / (t1 - t0))
+
+
+def render_frame(sources, histories, now=None):
+    """One dashboard frame (pure text) from ``[(name, snapshot), ...]`` —
+    the testable core of the refresh loop."""
+    now = time.time() if now is None else now
+    out = []
+    out.append("hyperopt-tpu obs.top — "
+               + time.strftime("%H:%M:%S", time.localtime(now))
+               + f"  ({len(sources)} source{'s' if len(sources) != 1 else ''})")
+    out.append("")
+
+    # -- per-controller liveness table ------------------------------------
+    w = max(len(name) for name, _ in sources)
+    for name, snap in sources:
+        hist = histories.setdefault(name, History())
+        if "error" in snap:
+            out.append(f"  {name:<{w}}  DEAD  {snap['error']}")
+            continue
+        sections = snap.get("sections") or {}
+        health = sections.get("health") or {}
+        ask = sections.get("ask_pipeline") or {}
+        best = snap.get("best_loss")
+        n_done = snap.get("trials_completed")
+        hist.push("ei_p50", health.get("last_ei_p50"))
+        hist.push("dup", health.get("last_dup_rate"))
+        hist.push_count(n_done)
+        tp = hist.throughput()
+        line = f"  {name:<{w}}"
+        line += (f"  best {best:.6g}" if isinstance(best, (int, float))
+                 else "  best -")
+        if n_done is not None:
+            line += f"  done {n_done:.0f}"
+        line += (f"  {tp:.2f} trials/s" if tp is not None else "")
+        line += (f"  asks {ask.get('calls', 0)}"
+                 f"  inflight {ask.get('inflight', 0):.0f}")
+        blocked = ask.get("blocked_sec") or {}
+        if blocked.get("count"):
+            line += f"  blocked p50 {_fmt_sec(blocked.get('p50'))}"
+        dm = snap.get("devmem")
+        if dm:
+            from .devmem import roll_up
+
+            in_use, _, _, frac = roll_up(dm.get("devices", []))
+            if frac is not None:
+                line += f"  hbm {frac * 100:.0f}%"
+            elif in_use is not None:
+                line += f"  hbm {_fmt_bytes(in_use)}"
+        out.append(line)
+        beats = snap.get("last_heartbeats") or {}
+        if beats:
+            newest = min(beats.values(),
+                         key=lambda b: b.get("age_sec", float("inf")))
+            comp = min(beats, key=lambda c: beats[c].get("age_sec",
+                                                         float("inf")))
+            out.append(f"  {'':<{w}}  last beat {comp} "
+                       f"{_fmt_sec(newest.get('age_sec'))} ago"
+                       + (f"  inflight trials "
+                          f"{len(snap.get('inflight_trials') or [])}"
+                          if snap.get("inflight_trials") is not None
+                          else ""))
+
+    # -- trends (first live source) ---------------------------------------
+    for name, snap in sources:
+        if "error" in snap:
+            continue
+        hist = histories[name]
+        shown = False
+        for key, label in (("ei_p50", "EI p50 "), ("dup", "dup    ")):
+            t = hist.trend(key)
+            if len(t) >= 2:
+                if not shown:
+                    out.append("")
+                    out.append(f"  trends ({name}):")
+                    shown = True
+                out.append(f"    {label} {t[-1]:+.3g}  {_spark(t)}")
+        break
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.obs.top",
+        description="Live terminal dashboard over scrape server URLs or "
+                    "recorded JSONL streams.")
+    p.add_argument("sources", nargs="+",
+                   help="scrape server URL(s) (http://host:port), JSONL "
+                        "stream(s), or a run directory")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no screen clearing)")
+    p.add_argument("--frames", type=int, default=None,
+                   help="exit after N frames (default: until Ctrl-C)")
+    args = p.parse_args(argv)
+
+    sources = _expand_sources(args.sources)
+    if not sources:
+        print("error: no sources (empty directory?)", file=sys.stderr)
+        return 2
+    histories = {}
+    tails = {src: _StreamTail(src) for kind, src in sources
+             if kind == "file"}
+    n = 0
+    try:
+        while True:
+            snaps = []
+            for kind, src in sources:
+                name = (src if kind == "url" else os.path.basename(src))
+                snap = (fetch_snapshot(src) if kind == "url"
+                        else tails[src].snapshot())
+                snaps.append((name, snap))
+            frame = render_frame(snaps, histories)
+            if args.once:
+                sys.stdout.write(frame)
+                return 0
+            sys.stdout.write(_CLEAR + frame)
+            sys.stdout.flush()
+            n += 1
+            if args.frames is not None and n >= args.frames:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

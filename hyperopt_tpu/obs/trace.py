@@ -235,6 +235,11 @@ class _Span:
             "ts": self.ts,
             "wall_sec": wall,
         }
+        if not self.aggregate:
+            # umbrella spans (fmin's "run", device.compile) are excluded
+            # from the live phase totals; mark them so offline consumers
+            # (report --format json) can rebuild the SAME totals
+            rec["aggregate"] = False
         if self._pushed:
             rec["cpu_sec"] = time.process_time() - self._c0
             rec["span_id"] = self.span_id

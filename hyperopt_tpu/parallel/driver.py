@@ -102,6 +102,28 @@ def _gen_seed(seed, gen):
     return (int(seed) + 0x9E3779B1 * (gen + 1)) & 0xFFFFFFFF
 
 
+def _controller_port(port, pid):
+    """Per-controller scrape port: explicit base port + process index
+    (``obs.top`` scrapes each controller's ``run.p<i>`` server); 0 stays 0
+    (every controller gets its own ephemeral port anyway).  ``host:port``
+    strings keep the host and offset the port; an offset past 65535 fails
+    open at bind time (serve.py)."""
+    if not port:
+        return port
+    try:
+        if isinstance(port, str) and ":" in port:
+            host, _, base = port.rpartition(":")
+            if int(base) == 0:  # host-form ephemeral: each controller's own
+                return port
+            return f"{host}:{int(base) + int(pid)}"
+        return int(port) + int(pid)
+    except (TypeError, ValueError):
+        # malformed value: pass through untouched — the server's own parse
+        # guard fails open with a warning (never kill a multihost sweep
+        # over a scrape-port typo)
+        return port
+
+
 def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                    n_startup=None, checkpoint_file=None, obs=None,
                    _force_single=False):
@@ -152,8 +174,9 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     else:
         pid, P = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
-    if isinstance(obs, RunObs) and P > 1 and (obs.config.jsonl_path
-                                              or obs.config.flight_path):
+    if isinstance(obs, RunObs) and P > 1 and (
+            obs.config.jsonl_path or obs.config.flight_path
+            or obs.config.http_port or obs.config.devmem_period is not None):
         # a pre-built bundle must ALSO split per controller — N processes
         # appending to its one stream would interleave records under one
         # untagged run_id, exactly what the merge view cannot attribute,
@@ -161,7 +184,14 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # Rebuild from its config with the tagged paths/run_id instead —
         # and disarm the parent bundle's process-global hooks first, or
         # its un-split flight target / stall sink would still collect
-        # every controller's output into the one shared file
+        # every controller's output into the one shared file, and its
+        # already-bound scrape server would squat the base port the
+        # rebuilt controller-0 bundle needs (plus keep serving the
+        # detached parent registries)
+        if obs.http is not None:
+            obs.http.stop()
+        if obs.devmem is not None:
+            obs.devmem.stop()
         if obs._flight_target is not None:
             obs.flight.remove_target(obs._flight_target)
         elif obs.config.flight_path:
@@ -180,10 +210,17 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                             if obs.config.jsonl_path else None),
                 flight_path=(controller_stream_path(obs.config.flight_path,
                                                     pid)
-                             if obs.config.flight_path else None)),
+                             if obs.config.flight_path else None),
+                http_port=_controller_port(obs.config.http_port, pid)),
             run_id=f"{obs.run_id}-p{pid}")
     elif not isinstance(obs, RunObs):
         config = ObsConfig.resolve(obs)
+        if P > 1 and config.http_port:
+            # one scrape server PER CONTROLLER, port offset by process
+            # index (controllers sharing a host would otherwise collide
+            # and fail open) — obs.top scrapes each run.p<i> server
+            config = dataclasses.replace(
+                config, http_port=_controller_port(config.http_port, pid))
         if P > 1 and config.jsonl_path:
             # one stream PER CONTROLLER (run.jsonl -> run.p<i>.jsonl),
             # run_id tagged with the process index: concurrent writers on
@@ -440,6 +477,9 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
 
     while n_done < max_evals:
         obs.heartbeat("driver.gen", gen=gen, n_done=n_done)
+        # generation-boundary HBM sample: each controller samples its OWN
+        # devices; obs.report --merge aggregates the per-controller streams
+        obs.devmem_sample()
         B = min(batch, max_evals - n_done)
         gseed = _gen_seed(seed, gen)
         with obs.span("propose", gen=gen):
@@ -537,6 +577,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         n_done += B
         gen += 1
         obs.counter("generations").inc()
+        # headline gauges for the live scrape/top surface (dict stores)
+        obs.counter("trials.completed").inc(B)
+        done_live = hist["has_loss"][:n_done]
+        if done_live.any():
+            obs.gauge("best_loss").set(float(
+                hist["losses"][:n_done][done_live].min()))
         # divergence checksum: every controller must have folded the same
         # bytes in the same order
         if not single:

@@ -12,8 +12,10 @@ __all__ = ["tqdm_progress_callback", "no_progress_callback",
 def format_postfix(best_loss, obs=None):
     """The live progress-bar postfix: best loss, plus the run's latest
     search-health gauges ("EI p50 …  dup …") when an armed obs bundle has
-    recorded at least one health ask.  Disarmed runs render exactly the
-    historical ``best loss: <x>`` string."""
+    recorded at least one health ask, plus the HBM watermark ("hbm 62%")
+    when device-memory telemetry is armed (``HYPEROPT_TPU_DEVMEM``).
+    Disarmed runs render exactly the historical ``best loss: <x>``
+    string."""
     s = f"best loss: {best_loss:.6g}"
     if obs is not None and getattr(obs, "sink", None) is not None:
         from .obs.health import live_health_postfix
@@ -21,6 +23,13 @@ def format_postfix(best_loss, obs=None):
         extra = live_health_postfix(obs)
         if extra:
             s += "  " + extra
+    devmem = getattr(obs, "devmem", None) if obs is not None else None
+    if devmem is not None:
+        frac, peak = devmem.watermark()
+        if frac is not None:
+            s += f"  hbm {frac * 100:.0f}%"
+        elif peak is not None:
+            s += f"  hbm peak {peak / (1 << 20):.0f}MiB"
     return s
 
 

@@ -12,6 +12,10 @@
 # zero-copy suite under forced-CPU JAX with the strict allocation checks
 # armed — pins that no ask→tell tick allocates a cap-sized history copy
 # (buffer pointers stable, live cap-sized buffer count non-increasing).
+# Opt-in serve gate: SERVE_GATE=1 additionally arms the live scrape
+# server on a short real fmin, scrapes /metrics + /snapshot MID-RUN and
+# validates the exposition-format / snapshot-shape invariants
+# (scripts/validate_scrape.py --self-test).
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -24,5 +28,8 @@ fi
 if [ "${DONATION_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DONATION_GATE=1 \
         python -m pytest tests/test_pipeline.py -q -k donation || exit 1
+fi
+if [ "${SERVE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_scrape.py --self-test || exit 1
 fi
 exit 0

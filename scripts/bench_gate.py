@@ -14,7 +14,10 @@ What gets compared (all higher-is-better throughputs):
   recorded output tail, compared positionally ONLY when both rounds report
   the same number of occurrences (a round that adds or drops a stage would
   otherwise misalign the comparison — those names are skipped with a note
-  instead of guessed at).
+  instead of guessed at);
+* lower-is-better latency/memory keys (``ask_p*_ms`` from the ask_latency
+  stage, ``peak_hbm_bytes``/``history_bytes`` from the devmem stage) gated
+  on the allowed relative RISE instead.
 
 The no-baseline case (fewer than two ``BENCH_r*.json`` — a fresh repo with
 an empty bench trajectory) records what the newest round reports and
@@ -49,13 +52,20 @@ DEFAULT_THRESHOLDS = {
     "ask_p50_ms": 0.35,
     "ask_p95_ms": 0.50,
     "ask_p99_ms": 1.00,
+    # peak device memory (bench.py devmem stage): a leaked cap-sized
+    # buffer shows up as a step, so the allowed rise is moderate; the
+    # history census is near-deterministic for a fixed config, hence tight
+    "peak_hbm_bytes": 0.30,
+    "history_bytes": 0.10,
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
-                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms")
+                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
+                 "peak_hbm_bytes", "history_bytes")
 
-# latency metrics regress UPWARD
-LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms")
+# latency and peak-memory metrics regress UPWARD
+LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
+                   "peak_hbm_bytes", "history_bytes")
 
 
 def bench_files(root):

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Validate the live observability plane's scrape surfaces
+(hyperopt_tpu/obs/serve.py): Prometheus ``/metrics`` text and the
+``/snapshot`` JSON shape.
+
+Checked invariants — the contract a scraper actually relies on:
+
+``/metrics`` (Prometheus text exposition):
+
+* every non-comment line is ``name{labels} value`` with a legal metric
+  name (``[a-zA-Z_:][a-zA-Z0-9_:]*``) and a float-parseable value;
+* every sample's family has a preceding ``# TYPE`` line with a known type
+  (``counter``/``gauge``/``summary``), counters end in ``_total``;
+* label syntax parses, label values are quote/backslash/newline-escaped;
+* no duplicate ``(name, labels)`` series.
+
+``/snapshot`` (JSON):
+
+* the four headline sections (``report``/``health``/``utilization``/
+  ``ask_pipeline``) are present — the shared-serializer shape
+  ``obs.report --format json`` also emits;
+* ``ask_pipeline`` carries numeric ``calls``/``speculative``/``inflight``.
+
+Exit 0 when every input validates, 1 otherwise, 2 on unreadable input.
+
+``--self-test`` is the end-to-end CI gate (``SERVE_GATE=1
+./run_tests.sh``): arm the scrape server on a short real ``fmin`` child
+process, scrape ``/metrics`` + ``/snapshot`` MID-RUN, validate both, and
+check the counters moved between two scrapes (monotonicity under load).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$")
+_LABEL_RE = re.compile(
+    r'(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"')
+_KNOWN_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+
+
+def validate_metrics_text(text):
+    """Return a list of human-readable violations (empty = valid)."""
+    errors = []
+    types = {}  # family name -> declared type
+    seen_series = set()
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.rstrip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                errors.append(f"line {i}: malformed TYPE line {line!r}")
+                continue
+            _, _, fam, typ = parts
+            if typ not in _KNOWN_TYPES:
+                errors.append(f"line {i}: unknown metric type {typ!r}")
+            if fam in types:
+                errors.append(f"line {i}: duplicate TYPE for {fam}")
+            types[fam] = typ
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name, labels, value = m.group("name", "labels", "value")
+        if not _NAME_RE.match(name):
+            errors.append(f"line {i}: illegal metric name {name!r}")
+        try:
+            float(value)
+        except ValueError:
+            if value not in ("+Inf", "-Inf", "NaN"):
+                errors.append(f"line {i}: non-numeric value {value!r}")
+        if labels:
+            consumed = _LABEL_RE.sub("", labels).strip(", ")
+            if consumed:
+                errors.append(
+                    f"line {i}: unparseable label fragment {consumed!r}")
+        # family resolution: strip summary/counter suffixes
+        fam = name
+        for suffix in ("_total", "_sum", "_count", "_bucket"):
+            if fam.endswith(suffix) and fam[: -len(suffix)] in types:
+                fam = fam[: -len(suffix)]
+                break
+        if fam not in types and name.endswith("_total"):
+            # counter families declare TYPE under the base name
+            base = name[: -len("_total")]
+            fam = base if base in types else fam
+        if fam not in types:
+            errors.append(f"line {i}: sample {name!r} has no TYPE line")
+        elif types.get(fam) == "counter" and not name.endswith("_total"):
+            errors.append(f"line {i}: counter sample {name!r} lacks _total")
+        series = (name, labels or "")
+        if series in seen_series:
+            errors.append(f"line {i}: duplicate series {series}")
+        seen_series.add(series)
+    return errors
+
+
+def parse_samples(text):
+    """``{(name, labels): float value}`` for monotonicity checks."""
+    out = {}
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line.strip())
+        if m and not line.startswith("#"):
+            try:
+                out[(m.group("name"), m.group("labels") or "")] = float(
+                    m.group("value"))
+            except ValueError:
+                pass
+    return out
+
+
+_SNAPSHOT_SECTIONS = ("report", "health", "utilization", "ask_pipeline")
+
+
+def validate_snapshot(snap):
+    """Violations in a ``/snapshot`` payload (empty = valid)."""
+    errors = []
+    if not isinstance(snap, dict):
+        return ["snapshot is not a JSON object"]
+    sections = snap.get("sections")
+    if not isinstance(sections, dict):
+        return ["snapshot has no 'sections' object"]
+    for name in _SNAPSHOT_SECTIONS:
+        if name not in sections:
+            errors.append(f"sections missing {name!r}")
+    ask = sections.get("ask_pipeline") or {}
+    for key in ("calls", "speculative", "inflight"):
+        if not isinstance(ask.get(key), (int, float)):
+            errors.append(f"ask_pipeline.{key} is not numeric "
+                          f"({ask.get(key)!r})")
+    report = sections.get("report")
+    if isinstance(report, dict):
+        for phase, e in report.items():
+            if not isinstance(e, dict) or "sec" not in e or "count" not in e:
+                errors.append(f"report[{phase!r}] lacks sec/count")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# end-to-end self test (the SERVE_GATE)
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import sys, time
+import numpy as np
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+url_file = sys.argv[1]
+t = Trials()
+
+state = {"n": 0, "written": False}
+def objective(d):
+    state["n"] += 1
+    if not state["written"]:
+        # the server is live once FMinIter constructed: hand the parent
+        # the ephemeral URL, then keep trials slow enough to scrape mid-run
+        with open(url_file + ".tmp", "w") as f:
+            f.write(t.obs_http_url or "DISABLED")
+        import os
+        os.replace(url_file + ".tmp", url_file)
+        state["written"] = True
+    time.sleep(0.05)
+    return (d["x"] - 1.0) ** 2
+
+fmin(objective, {"x": hp.uniform("x", -5, 5)}, algo=rand.suggest,
+     max_evals=60, trials=t, rstate=np.random.default_rng(0),
+     show_progressbar=False, obs_http=0)
+print("CHILD_DONE")
+"""
+
+
+def _self_test():
+    """Arm a real child fmin with the scrape server, validate mid-run."""
+    import os
+    import subprocess
+    import tempfile
+    import time
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    with tempfile.TemporaryDirectory() as d:
+        url_file = os.path.join(d, "url")
+        proc = subprocess.Popen([sys.executable, "-c", _CHILD, url_file],
+                                env=env, cwd=repo,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.time() + 120
+            while not os.path.exists(url_file):
+                if proc.poll() is not None or time.time() > deadline:
+                    out, err = proc.communicate(timeout=10)
+                    print("self-test: child died before serving:\n"
+                          + err[-2000:], file=sys.stderr)
+                    return 1
+                time.sleep(0.05)
+            with open(url_file) as f:
+                url = f.read().strip()
+            if url == "DISABLED":
+                print("self-test: server failed open in the child",
+                      file=sys.stderr)
+                return 1
+
+            def get(path):
+                with urllib.request.urlopen(url + path, timeout=10) as r:
+                    return r.read().decode()
+
+            # wait for the first landed trial: the url file is written
+            # DURING the first evaluation, before any counter increments
+            while True:
+                snap = json.loads(get("/snapshot"))
+                if snap.get("trials_completed", 0) >= 1:
+                    break
+                if time.time() > deadline:
+                    print("self-test: no trial ever completed",
+                          file=sys.stderr)
+                    return 1
+                time.sleep(0.05)
+            text1 = get("/metrics")
+            errors = validate_metrics_text(text1)
+            errors += validate_snapshot(snap)
+            time.sleep(0.5)
+            text2 = get("/metrics")
+            errors += validate_metrics_text(text2)
+            # counters must be monotone non-decreasing between scrapes
+            s1, s2 = parse_samples(text1), parse_samples(text2)
+            moved = False
+            for series, v1 in s1.items():
+                if not series[0].endswith("_total"):
+                    continue
+                v2 = s2.get(series)
+                if v2 is None:
+                    continue
+                if v2 < v1:
+                    errors.append(f"counter {series} went backwards "
+                                  f"({v1} -> {v2})")
+                if v2 > v1:
+                    moved = True
+            if not moved:
+                errors.append("no counter advanced between two mid-run "
+                              "scrapes — is the run actually live?")
+            if errors:
+                print("self-test: scrape INVALID:", file=sys.stderr)
+                for e in errors:
+                    print("  " + e, file=sys.stderr)
+                return 1
+            n_series = len(parse_samples(text2))
+            print(f"self-test OK: {n_series} series lint clean, snapshot "
+                  "sections present, counters monotone under load")
+            return 0
+        finally:
+            try:
+                proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python scripts/validate_scrape.py",
+        description="Validate /metrics (Prometheus text) and /snapshot "
+                    "(JSON) scrape payloads.")
+    p.add_argument("files", nargs="*",
+                   help="payload file(s): *.json validates as a snapshot, "
+                        "anything else as Prometheus text")
+    p.add_argument("--self-test", action="store_true",
+                   help="arm the server on a short real fmin and validate "
+                        "a mid-run scrape end to end (the CI gate)")
+    args = p.parse_args(argv)
+    if args.self_test:
+        return _self_test()
+    if not args.files:
+        p.error("give payload file(s) or --self-test")
+    rc = 0
+    for path in args.files:
+        try:
+            with open(path) as f:
+                body = f.read()
+        except OSError as e:
+            print(f"{path}: cannot read ({e})", file=sys.stderr)
+            return 2
+        if path.endswith(".json"):
+            try:
+                errors = validate_snapshot(json.loads(body))
+            except ValueError as e:
+                errors = [f"not JSON: {e}"]
+        else:
+            errors = validate_metrics_text(body)
+        if errors:
+            rc = 1
+            print(f"{path}: INVALID")
+            for e in errors:
+                print("  " + e)
+        else:
+            print(f"{path}: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

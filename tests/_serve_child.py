@@ -1,0 +1,41 @@
+"""Child process for the live-scrape test (tests/test_serve.py).
+
+Runs a host-loop ``fmin`` with the scrape server armed on an ephemeral
+port (``obs_http=0``).  The first evaluated trial writes the server's URL
+to the handshake file; subsequent trials are slow enough that the parent
+can scrape ``/metrics`` and ``/snapshot`` while the run is demonstrably
+mid-flight.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+
+def main():
+    url_file = sys.argv[1]
+    trials = Trials()
+    state = {"written": False}
+
+    def objective(d):
+        if not state["written"]:
+            with open(url_file + ".tmp", "w") as f:
+                f.write(trials.obs_http_url or "DISABLED")
+            os.replace(url_file + ".tmp", url_file)
+            state["written"] = True
+        time.sleep(0.05)
+        return (d["x"] - 1.0) ** 2
+
+    fmin(objective, {"x": hp.uniform("x", -5, 5)}, algo=rand.suggest,
+         max_evals=60, trials=trials, rstate=np.random.default_rng(0),
+         show_progressbar=False, obs_http=0)
+    print("CHILD_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,632 @@
+"""Live observability plane (hyperopt_tpu/obs/{serve,top,devmem}.py):
+scrape server, terminal dashboard, device-memory telemetry.
+
+All tier-1 (CPU, fast).  The load-bearing invariants pinned here:
+
+* the DISARMED hot path is untouched — no server/devmem envs means no new
+  threads and TPE proposals bit-identical to an armed run's;
+* ``/metrics`` is lint-clean Prometheus exposition (tiny parser in
+  scripts/validate_scrape.py) with monotone counters across scrapes;
+* the SSE subscriber ring drops-oldest on overflow, never blocks;
+* the server fails OPEN on port collision;
+* ``obs.report --format json`` and ``/snapshot`` share one serializer
+  (golden-pinned structure);
+* an OOM (faked ``RESOURCE_EXHAUSTED``) dump carries the devmem tail +
+  live-array census — the memory narrative.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu._env import parse_devmem_period, parse_obs_http
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.obs import ObsConfig, RunObs, read_jsonl
+from hyperopt_tpu.obs.devmem import (DevMemSampler, live_array_census,
+                                     memory_stats, register_owner)
+from hyperopt_tpu.obs.flight import FlightRecorder
+from hyperopt_tpu.obs.report import (headline_sections, json_report,
+                                     main as report_main,
+                                     render_postmortem)
+from hyperopt_tpu.obs.serve import Broadcast, ObsHTTPServer, prometheus_text
+from hyperopt_tpu.obs import top as top_mod
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import validate_scrape  # noqa: E402  (scripts/validate_scrape.py)
+
+SPACE = {"x": hp.uniform("x", -5, 5), "y": hp.uniform("y", 0, 3)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2 + d["y"]
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# env parsing (warn-and-disable, never raise)
+# ---------------------------------------------------------------------------
+
+
+def test_env_parsing_good_values():
+    assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "9109"}) == 9109
+    assert parse_obs_http({}) is None
+    assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "0"}) is None
+    assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "off"}) is None
+    assert parse_devmem_period({"HYPEROPT_TPU_DEVMEM": "2.5"}) == 2.5
+    assert parse_devmem_period({"HYPEROPT_TPU_DEVMEM": "on"}) == 10.0
+    assert parse_devmem_period({}) is None
+    assert parse_devmem_period({"HYPEROPT_TPU_DEVMEM": "off"}) is None
+
+
+def test_env_parsing_bad_values_warn_and_disable(caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="hyperopt_tpu._env"):
+        assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "not-a-port"}) is None
+        assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "99999"}) is None
+        assert parse_devmem_period({"HYPEROPT_TPU_DEVMEM": "-3"}) is None
+        assert parse_devmem_period({"HYPEROPT_TPU_DEVMEM": "soon"}) is None
+    assert "warn-and-disable" in caplog.text
+    # config construction through the same parsers never raises either
+    cfg = ObsConfig.from_env({"HYPEROPT_TPU_OBS_HTTP": "junk",
+                              "HYPEROPT_TPU_DEVMEM": "junk"})
+    assert cfg.http_port is None and cfg.devmem_period is None
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: lint, escaping, monotone counters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_lints_clean():
+    obs = RunObs(ObsConfig(level="basic"), run_id="serve-lint")
+    obs.counter("trials.completed").inc(3)
+    obs.gauge("queue_depth").set(2)
+    h = obs.histogram("ask.blocked_sec")
+    for v in (0.01, 0.02, 0.5):
+        h.observe(v)
+    text = prometheus_text(namespaces=["serve-lint"])
+    assert validate_scrape.validate_metrics_text(text) == []
+    samples = validate_scrape.parse_samples(text)
+    assert samples[("hyperopt_tpu_trials_completed_total",
+                    'namespace="serve-lint"')] == 3.0
+    assert samples[("hyperopt_tpu_queue_depth",
+                    'namespace="serve-lint"')] == 2.0
+    # summaries expose quantiles + _sum/_count
+    assert ("hyperopt_tpu_ask_blocked_sec_count",
+            'namespace="serve-lint"') in samples
+    assert any('quantile="0.5"' in labels for _, labels in samples)
+    obs.finish()
+
+
+def test_prometheus_label_escaping_and_name_sanitization():
+    weird = 'run "7"\nwith\\escapes'
+    obs = RunObs(ObsConfig(level="basic"), run_id=weird)
+    obs.counter("devmem.samples").inc()
+    text = prometheus_text(namespaces=[weird])
+    assert validate_scrape.validate_metrics_text(text) == []
+    assert '\\"7\\"' in text and "\\n" in text and "\\\\" in text
+    # dots sanitize to underscores; every name is legal
+    assert "hyperopt_tpu_devmem_samples_total" in text
+    obs.finish()
+
+
+def test_prometheus_counters_monotone_across_scrapes():
+    obs = RunObs(ObsConfig(level="basic"), run_id="serve-mono")
+    c = obs.counter("suggest.calls")
+    c.inc(5)
+    s1 = validate_scrape.parse_samples(
+        prometheus_text(namespaces=["serve-mono"]))
+    c.inc(2)
+    s2 = validate_scrape.parse_samples(
+        prometheus_text(namespaces=["serve-mono"]))
+    series = ("hyperopt_tpu_suggest_calls_total", 'namespace="serve-mono"')
+    assert s1[series] == 5.0 and s2[series] == 7.0
+    obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# SSE broadcast hub: bounded rings, drop-oldest, never block
+# ---------------------------------------------------------------------------
+
+
+def test_broadcast_overflow_drops_oldest_never_blocks():
+    hub = Broadcast()
+    sub = hub.subscribe(maxlen=8)
+    t0 = time.perf_counter()
+    for i in range(1000):
+        hub.publish({"i": i})
+    assert time.perf_counter() - t0 < 1.0  # publish never waits on readers
+    recs, dropped = hub.drain(sub, timeout=0)
+    assert [r["i"] for r in recs] == list(range(992, 1000))  # newest kept
+    assert dropped == 992
+    # a fresh publish after the drain is delivered (ring re-arms)
+    hub.publish({"i": "next"})
+    recs, dropped = hub.drain(sub, timeout=0)
+    assert dropped == 0 and [r["i"] for r in recs] == ["next"]
+    hub.unsubscribe(sub)
+    assert hub.n_subscribers == 0
+
+
+def test_broadcast_publish_without_subscribers_is_noop():
+    hub = Broadcast()
+    for i in range(100):
+        hub.publish({"i": i})  # must not raise or accumulate
+
+
+# ---------------------------------------------------------------------------
+# fail-open server lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_port_collision_fails_open(caplog):
+    import logging
+
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="hyperopt_tpu.obs.serve"):
+            srv = ObsHTTPServer(port)
+            assert srv.start() is False
+        assert "cannot bind" in caplog.text
+        assert srv.url is None
+        srv.stop()  # idempotent even when never started
+        # a whole RunObs armed on the occupied port still constructs fine
+        obs = RunObs(ObsConfig(level="basic", http_port=port),
+                     run_id="serve-collide")
+        assert obs.http is None
+        obs.finish()
+    finally:
+        blocker.close()
+
+
+def test_out_of_range_port_and_hostport_forms_fail_open():
+    # port past 65535 (e.g. a multihost base-port offset): OverflowError
+    # from bind must degrade to warn-and-disable, never raise
+    srv = ObsHTTPServer(65536)
+    assert srv.start() is False
+    # unparseable kwarg value: same fail-open path
+    srv = ObsHTTPServer("junk")
+    assert srv.start() is False
+    # host:port form binds the named host
+    srv = ObsHTTPServer("127.0.0.1:0")
+    assert srv.start() is True
+    assert srv.url.startswith("http://127.0.0.1:")
+    srv.stop()
+    # env parser accepts host:port and keeps the host
+    assert (parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "0.0.0.0:9109"})
+            == "0.0.0.0:9109")
+    assert parse_obs_http({"HYPEROPT_TPU_OBS_HTTP": "0.0.0.0:junk"}) is None
+    # the driver's per-controller offset keeps the host too
+    from hyperopt_tpu.parallel.driver import _controller_port
+
+    assert _controller_port("0.0.0.0:9109", 2) == "0.0.0.0:9111"
+    assert _controller_port(9109, 2) == 9111
+    assert _controller_port(0, 3) == 0
+
+
+def test_server_serves_and_stops_cleanly():
+    obs = RunObs(ObsConfig(level="basic", http_port=0), run_id="serve-live")
+    assert obs.http is not None
+    url = obs.http.url
+    obs.counter("trials.completed").inc(4)
+    obs.gauge("best_loss").set(0.25)
+    text = _get(url + "/metrics")
+    assert validate_scrape.validate_metrics_text(text) == []
+    snap = json.loads(_get(url + "/snapshot"))
+    assert validate_scrape.validate_snapshot(snap) == []
+    assert snap["run_id"] == "serve-live"
+    assert snap["best_loss"] == 0.25
+    assert snap["trials_completed"] == 4
+    assert _get(url + "/").startswith("hyperopt_tpu obs")
+    obs.finish()
+    # the listener is gone after finish()
+    with pytest.raises(Exception):
+        _get(url + "/metrics", timeout=1)
+
+
+def test_server_closes_on_flight_shutdown_path():
+    """The fatal-signal path (flight recorder shutdown hooks) closes a
+    live listener, and the hook unregisters once the server stops."""
+    from hyperopt_tpu.obs import get_flight
+
+    fr = get_flight()
+    obs = RunObs(ObsConfig(level="basic", http_port=0), run_id="serve-sig")
+    url = obs.http.url
+    stop_hook = obs.http.stop
+    assert stop_hook in fr._shutdown_hooks
+    fr.run_shutdown_hooks()  # what _signal_handler / atexit invoke
+    with pytest.raises(Exception):
+        _get(url + "/metrics", timeout=1)
+    assert stop_hook not in fr._shutdown_hooks
+    obs.finish()  # idempotent on an already-stopped server
+
+
+def test_sse_events_stream_tails_spans():
+    obs = RunObs(ObsConfig(level="basic", http_port=0), run_id="serve-sse")
+    url = obs.http.url
+    got = {}
+
+    def reader():
+        req = urllib.request.urlopen(url + "/events", timeout=10)
+        buf = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            line = req.readline().decode()
+            if line.startswith("data: "):
+                buf.append(json.loads(line[len("data: "):]))
+                if any(r.get("name") == "marker_event" for r in buf):
+                    break
+        got["records"] = buf
+        req.close()
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    time.sleep(0.3)  # let the client subscribe before publishing
+    obs.event("marker_event", payload=1)
+    th.join(timeout=15)
+    assert any(r.get("name") == "marker_event"
+               for r in got.get("records", [])), got
+    obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# disarmed hot path untouched
+# ---------------------------------------------------------------------------
+
+
+def _tpe_run(seed=11, max_evals=10, **kw):
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=max_evals, trials=t,
+         rstate=np.random.default_rng(seed), show_progressbar=False, **kw)
+    return t
+
+
+def test_disarmed_run_starts_no_new_threads_and_proposals_identical():
+    t_plain = _tpe_run()
+    before = {th.name for th in threading.enumerate()}
+    t_again = _tpe_run()
+    after = {th.name for th in threading.enumerate()}
+    # no server/devmem thread appears on a disarmed run
+    assert not {n for n in after - before
+                if "obs-http" in n or "obs-devmem" in n}
+    # armed (server + devmem) proposals are bit-identical to disarmed
+    obs = ObsConfig(level="basic", http_port=0, devmem_period=30.0)
+    t_armed = _tpe_run(obs=obs)
+    assert t_plain.losses() == t_again.losses() == t_armed.losses()
+    for a, b in zip(t_plain.trials, t_armed.trials):
+        assert a["misc"]["vals"] == b["misc"]["vals"]
+
+
+# ---------------------------------------------------------------------------
+# shared serializer: /snapshot == report --format json (golden-pinned)
+# ---------------------------------------------------------------------------
+
+_GOLDEN_SECTIONS = {
+    "ask_pipeline": {
+        "blocked_sec": None,
+        "calls": 4,
+        "inflight": 1.0,
+        "queue_depth": 0,
+        "speculative": 2,
+    },
+    "health": {
+        "asks": 2,
+        "dup_rate": None,
+        "ei_p50": None,
+        "last_dup_rate": 0.25,
+        "last_ei_p50": 0.5,
+        "n_above": None,
+        "n_below": None,
+        "prior_fallbacks": 0,
+        "proposals": 8,
+    },
+    "report": {
+        "evaluate": {"count": 4, "frac": 0.75, "sec": 3.0},
+        "suggest": {"count": 4, "frac": 0.25, "sec": 1.0},
+    },
+    "utilization": {
+        "chunk": {
+            "achieved_flops_per_sec": 500.0,
+            "arithmetic_intensity": 12.5,
+            "bytes_per_dispatch": 8.0,
+            "dispatches": 2,
+            "execute_sec_total": 0.4,
+            "flops_per_dispatch": 100.0,
+        },
+    },
+}
+
+
+def _golden_inputs():
+    phases = {"suggest": {"sec": 1.0, "count": 4},
+              "evaluate": {"sec": 3.0, "count": 4}}
+    metrics = {"suggest.calls": 4, "suggest.speculative": 2,
+               "suggest.inflight": 1.0, "queue_depth": 0,
+               "health.asks": 2, "health.proposals": 8,
+               "health.last_ei_p50": 0.5, "health.last_dup_rate": 0.25}
+    device = {"chunk.flops": 100.0, "chunk.bytes": 8.0,
+              "chunk.execute_sec": {"count": 2, "sum": 0.4}}
+    return phases, metrics, device
+
+
+def test_headline_sections_golden():
+    phases, metrics, device = _golden_inputs()
+    got = headline_sections(phases, metrics, device)
+    assert got == _GOLDEN_SECTIONS
+
+
+def test_snapshot_and_format_json_share_serializer(tmp_path):
+    """A real armed run: the /snapshot sections and `obs.report --format
+    json` sections agree on everything a finished stream can know."""
+    path = str(tmp_path / "run.jsonl")
+    obs = RunObs(ObsConfig(level="trace", jsonl_path=path, http_port=0),
+                 run_id="serve-share")
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=8, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=obs)
+    # fmin finished the bundle (server stopped); rebuild sections offline
+    offline = json_report([("run.jsonl", read_jsonl(path))])
+    # live equivalent, re-derived from the SAME bundle's registries (the
+    # registry was released on finish; the bundle keeps its object)
+    phases = {k: {"sec": v["sec"], "count": v["count"]}
+              for k, v in obs.tracer.totals.items()}
+    from hyperopt_tpu.obs.metrics import get_metrics
+
+    live = headline_sections(phases,
+                             obs.metrics.snapshot()["metrics"],
+                             get_metrics("device").snapshot()["metrics"])
+    off = offline["sections"]
+    assert off["ask_pipeline"] == live["ask_pipeline"]
+    assert off["health"] == live["health"]
+    assert set(off["report"]) == set(live["report"])
+    for name, e in off["report"].items():
+        assert e["count"] == live["report"][name]["count"]
+        assert e["sec"] == pytest.approx(live["report"][name]["sec"],
+                                         rel=1e-6)
+
+
+def test_report_format_json_cli(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=6, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    assert report_main(["--format", "json", path]) == 0
+    out = json.loads(capsys.readouterr().out)
+    for section in ("report", "health", "utilization", "ask_pipeline"):
+        assert section in out["sections"]
+    assert out["sections"]["ask_pipeline"]["calls"] >= 6
+    # --format json + --postmortem is rejected loudly
+    assert report_main(["--format", "json", "--postmortem", path]) == 2
+
+
+# ---------------------------------------------------------------------------
+# devmem: CPU memory_stats-None path, gauges, census, OOM narrative
+# ---------------------------------------------------------------------------
+
+
+def test_memory_stats_guarded_on_cpu():
+    stats = memory_stats()
+    assert stats, "at least one device"
+    for entry in stats:
+        assert set(entry) == {"device", "platform", "bytes_in_use",
+                              "peak_bytes_in_use", "bytes_limit"}
+        # CPU backends may report None everywhere — that must be legal
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            assert entry[key] is None or isinstance(entry[key], int)
+
+
+def test_devmem_sampler_gauges_and_census(tmp_path):
+    import jax.numpy as jnp
+
+    register_owner("history", (4096,))
+    keepalive = jnp.zeros(4096, jnp.float32)  # a census-visible buffer
+    path = str(tmp_path / "run.jsonl")
+    obs = RunObs(ObsConfig(level="trace", jsonl_path=path,
+                           devmem_period=0.0), run_id="serve-devmem")
+    assert obs.devmem is not None
+    rec = obs.devmem.sample(reason="test")
+    assert rec["kind"] == "devmem" and rec["run_id"] == "serve-devmem"
+    census = rec["census"]
+    assert census["history"]["count"] >= 1
+    assert census["history"]["bytes"] >= keepalive.nbytes
+    m = obs.metrics.snapshot()["metrics"]
+    assert m["devmem.samples"] >= 1
+    assert m["devmem.history_bytes"] >= keepalive.nbytes
+    assert m["devmem.live_arrays"] >= 1
+    # the armed stream carries the record too
+    obs.finish()
+    recs = [r for r in read_jsonl(path) if r["kind"] == "devmem"]
+    assert recs and recs[-1]["reason"] == "finish"
+    del keepalive
+
+
+def test_devmem_rate_limited_on_span_boundaries():
+    obs = RunObs(ObsConfig(level="basic", devmem_period=3600.0),
+                 run_id="serve-ratelimit")
+    obs.devmem.maybe_sample()
+    n1 = obs.metrics.snapshot()["metrics"]["devmem.samples"]
+    for _ in range(50):
+        obs.devmem_sample()  # all inside the period: no extra samples
+    n2 = obs.metrics.snapshot()["metrics"]["devmem.samples"]
+    assert n1 == n2 == 1
+    obs.finish()
+
+
+def test_oom_dump_attaches_devmem_tail_and_census(tmp_path):
+    """A faked RESOURCE_EXHAUSTED through the flight excepthook leaves a
+    dump with the devmem tail + an at-death census — the memory
+    narrative — and the postmortem renders it."""
+    fr = FlightRecorder()
+    obs = RunObs(ObsConfig(level="basic", devmem_period=0.0),
+                 run_id="serve-oom")
+    for _ in range(3):
+        obs.devmem.sample(reason="ramp")
+    fr.devmem = obs.devmem
+    target = str(tmp_path / "oom.flight.jsonl")
+    fr.add_target(target)
+
+    class FakeOOM(RuntimeError):
+        pass
+
+    err = FakeOOM("RESOURCE_EXHAUSTED: Out of memory allocating 2147483648 "
+                  "bytes (HBM)")
+    # call the hook directly (installing the real excepthook would eat the
+    # test runner's); chain target is captured to keep stderr clean
+    fr._prev_excepthook = lambda *a: None
+    fr._excepthook(FakeOOM, err, None)
+
+    recs = read_jsonl(target)
+    kinds = {r["kind"] for r in recs}
+    assert "flight_dump" in kinds
+    devmem_recs = [r for r in recs if r["kind"] == "devmem"]
+    assert len(devmem_recs) >= 3  # the ramp tail rode the dump
+    # the excepthook took one FRESH sample at OOM time
+    assert any(r.get("reason") == "oom" for r in devmem_recs)
+    assert any(r["kind"] == "devmem_census" for r in recs)
+    out = render_postmortem(recs, name="oom.flight.jsonl")
+    assert "device memory (HBM)" in out
+    assert "at-death census" in out
+    obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# real-subprocess scrape of a running fmin
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_scrape_of_running_fmin(tmp_path):
+    child = os.path.join(os.path.dirname(__file__), "_serve_child.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    url_file = str(tmp_path / "url")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (repo_root + os.pathsep
+                         + os.environ.get("PYTHONPATH", ""))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen([sys.executable, child, url_file], env=env,
+                            cwd=repo_root, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(url_file):
+            assert proc.poll() is None, proc.communicate()[1][-2000:]
+            assert time.time() < deadline, "child never served"
+            time.sleep(0.05)
+        with open(url_file) as f:
+            url = f.read().strip()
+        assert url.startswith("http://"), url
+        # wait until the first trial landed (the url is written DURING the
+        # first evaluation, before any counter increments)
+        while True:
+            snap = json.loads(_get(url + "/snapshot"))
+            if snap.get("trials_completed", 0) >= 1:
+                break
+            assert time.time() < deadline, "no trial ever completed"
+            time.sleep(0.05)
+        assert validate_scrape.validate_snapshot(snap) == []
+        text1 = _get(url + "/metrics")
+        assert validate_scrape.validate_metrics_text(text1) == []
+        time.sleep(0.4)
+        s1 = validate_scrape.parse_samples(text1)
+        s2 = validate_scrape.parse_samples(_get(url + "/metrics"))
+        completed = ("hyperopt_tpu_trials_completed_total",
+                     'namespace="run-1"')
+        assert s2[completed] > s1[completed]  # genuinely mid-run
+        out, err = proc.communicate(timeout=120)
+        assert "CHILD_DONE" in out, err[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# obs.top: frame rendering (URL-shaped and file-shaped sources)
+# ---------------------------------------------------------------------------
+
+
+def test_top_render_frame_live_and_dead_sources():
+    snap = {
+        "run_id": "r", "best_loss": 0.125, "trials_completed": 42,
+        "sections": {
+            "report": {"suggest": {"sec": 1.0, "count": 42, "frac": 1.0}},
+            "health": {"asks": 5, "last_ei_p50": 0.4,
+                       "last_dup_rate": 0.1},
+            "utilization": {},
+            "ask_pipeline": {"calls": 42, "speculative": 0,
+                             "inflight": 2.0,
+                             "blocked_sec": {"count": 42, "p50": 0.003}},
+        },
+        "last_heartbeats": {"fmin.tick": {"age_sec": 0.5, "ts": 1.0}},
+        "inflight_trials": [{"tid": 41, "state": "claimed",
+                             "age_sec": 0.2}],
+        "devmem": {"devices": [{"bytes_in_use": 1 << 30,
+                                "bytes_limit": 2 << 30}]},
+    }
+    histories = {}
+    frame1 = top_mod.render_frame(
+        [("p0", snap), ("p1", {"error": "URLError: refused"})], histories)
+    assert "best 0.125" in frame1
+    assert "done 42" in frame1
+    assert "inflight 2" in frame1
+    assert "hbm 50%" in frame1
+    assert "DEAD" in frame1 and "refused" in frame1
+    assert "last beat fmin.tick" in frame1
+    # trends appear once two refreshes accumulated
+    snap2 = json.loads(json.dumps(snap))
+    snap2["sections"]["health"]["last_ei_p50"] = 0.6
+    frame2 = top_mod.render_frame([("p0", snap2)], histories)
+    assert "EI p50" in frame2
+
+
+def test_top_mid_run_stream_without_final_snapshot():
+    """A stream being tailed MID-RUN has no kind="metrics" record yet
+    (RunObs.finish() writes it): the dashboard derives the trial count
+    from lifecycle events and health gauges from live health records."""
+    records = [
+        {"kind": "span", "name": "suggest", "ts": 1.0, "wall_sec": 0.1},
+        {"kind": "trial_event", "event": "trial_new", "tid": 0, "ts": 1.0},
+        {"kind": "trial_event", "event": "trial_finished", "tid": 0,
+         "ts": 1.2},
+        {"kind": "trial_event", "event": "trial_finished", "tid": 1,
+         "ts": 1.4},
+        {"kind": "health", "algo": "tpe", "ts": 1.3, "ei_p50": 0.7,
+         "dup_rate": 0.05},
+    ]
+    snap = top_mod.snapshot_from_records(records)
+    assert snap["trials_completed"] == 2
+    assert snap["sections"]["health"]["asks"] == 1
+    assert snap["sections"]["health"]["last_ei_p50"] == 0.7
+    assert snap["sections"]["health"]["last_dup_rate"] == 0.05
+
+
+def test_top_once_over_recorded_stream(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=6, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False, obs=path)
+    assert top_mod.main(["--once", path]) == 0
+    out = capsys.readouterr().out
+    assert "run.jsonl" in out
+    assert "asks" in out
+    # directory mode expands to the stream
+    assert top_mod.main(["--once", str(tmp_path)]) == 0
+    assert "run.jsonl" in capsys.readouterr().out
