@@ -932,6 +932,148 @@ def bench_sharded_scaling():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+_SHARDED_SUGGEST_SNIPPET = r"""
+import json, os, sys, time
+import numpy as np
+import jax
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import tpe, rand
+from hyperopt_tpu.base import Domain, PaddedHistory
+
+space = {f"x{i}": hp.uniform(f"x{i}", -5, 5) for i in range(4)}
+def obj(d):
+    return sum((v - 1.0) ** 2 for v in d.values())
+
+def populated(n=24):
+    t = Trials()
+    fmin(obj, space, algo=rand.suggest, max_evals=n, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    return t
+
+B, n_cand, reps = 64, 256, 4
+out = {"batch": B, "n_EI_candidates": n_cand,
+       # the pre-round-6 candidate-sharded path proposed ONE winner per
+       # dispatch (n_cand candidates on one chip); the sharded fused batch
+       # proposes B at once, each over the distributed pool
+       "cand_batch_multiple": B}
+ref = None
+for shards in (1, 2, 4, 8):
+    os.environ["HYPEROPT_TPU_SHARD"] = str(shards)
+    t = populated()
+    dom = Domain(obj, space)
+    def ask(seed):
+        return tpe.suggest(t.new_trial_ids(B), dom, t, seed,
+                           n_startup_jobs=8, n_EI_candidates=n_cand,
+                           ei_select="softmax")
+    ask(0)  # compile + first (placement-copy) tick
+    t0 = time.perf_counter()
+    for r in range(1, reps + 1):
+        docs = ask(r)
+    dt = (time.perf_counter() - t0) / reps
+    vals = sorted((d["misc"]["vals"]["x0"][0] for d in docs))
+    if shards == 1:
+        ref = vals
+    out[f"shards_{shards}"] = {
+        "sharded_cand_per_sec": B * n_cand / dt,
+        "sec_per_ask": dt,
+        "proposals_identical_to_1shard": vals == ref,
+    }
+del os.environ["HYPEROPT_TPU_SHARD"]
+
+# bf16 compressed history: resident float bytes at the SAME cap
+labels = tuple(f"x{i}" for i in range(4))
+def hist_bytes(dtype):
+    ph = PaddedHistory(labels, hist_dtype=dtype)
+    for i in range(100):
+        ph.append({l: float(i % 7) for l in labels}, float(i))
+    dev = ph.device_view()
+    return int(sum(dev["vals"][l].nbytes for l in labels)
+               + dev["losses"].nbytes)
+f32b, bf16b = hist_bytes("float32"), hist_bytes("bfloat16")
+out["history_bytes_f32"] = f32b
+out["history_bytes_bf16"] = bf16b
+out["bf16_reduction_x"] = f32b / max(bf16b, 1)
+print(json.dumps(out))
+"""
+
+
+def bench_sharded_suggest():
+    """ISSUE 6 headline stage: the FUSED tell+ask program sharded over a
+    virtual 8-device CPU mesh at shard counts {1, 2, 4, 8} —
+    candidates/sec per shard count (``sharded_cand_per_sec``, gated
+    higher-is-better by scripts/bench_gate.py), a proposal batch 64× the
+    pre-round-6 one-winner dispatch, per-shard-count bit-equality against
+    the 1-shard program, and the bf16 compressed-history byte reduction at
+    unchanged cap.  CPU mesh: scaling SHAPE is meaningful, absolute
+    numbers are not (SURVEY.md §4)."""
+    import os
+    import subprocess
+    import sys as _sys
+
+    env = _forced_cpu_env(os.environ, n_devices=8)
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", _SHARDED_SUGGEST_SNIPPET],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return {"error": proc.stderr[-500:]}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # timeout/empty stdout must not kill the metric line
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def bench_pallas_ei(n=8192, reps=5, seed=0):
+    """jnp-vs-pallas crossover for the fused two-model EI score
+    (``pallas_ei.ei_diff``) by COMPONENT COUNT — the axis the MEASURED
+    VERDICT in pallas_ei.py says decides the winner (very large component
+    tables break XLA's fusion; small ones don't).  Keeps that verdict
+    current round over round: on a TPU backend both paths run; elsewhere
+    the jnp twin alone is recorded with ``pallas_available: false``."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu import pallas_ei
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.uniform(-3, 3, n).astype(np.float32))
+    avail = pallas_ei.pallas_available()
+    out = {"n_candidates": n, "pallas_available": bool(avail),
+           "by_components": {}}
+    crossover = None
+    for m in (8, 64, 256, 1024):
+        def mix():
+            w = rng.uniform(0.1, 1.0, m).astype(np.float32)
+            return (jnp.asarray(w / w.sum()),
+                    jnp.asarray(rng.uniform(-3, 3, m).astype(np.float32)),
+                    jnp.asarray(rng.uniform(0.1, 2.0, m).astype(np.float32)))
+
+        wb, mb, sb = mix()
+        wa, ma, sa = mix()
+        jnp_fn = jax.jit(pallas_ei.ei_diff_reference)
+
+        def timeit(fn):
+            jax.block_until_ready(fn(x, wb, mb, sb, wa, ma, sa))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                r = fn(x, wb, mb, sb, wa, ma, sa)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / reps
+
+        entry = {"jnp_sec": timeit(jnp_fn)}
+        if avail:
+            entry["pallas_sec"] = timeit(jax.jit(pallas_ei.ei_diff))
+            entry["pallas_speedup"] = entry["jnp_sec"] / max(
+                entry["pallas_sec"], 1e-12)
+            if crossover is None and entry["pallas_speedup"] > 1.0:
+                crossover = m
+        out["by_components"][str(m)] = entry
+    if avail:
+        out["crossover_components"] = crossover  # None: jnp won everywhere
+    return out
+
+
 # ---------------------------------------------------------------------------
 # hang-proof orchestration (see module docstring)
 # ---------------------------------------------------------------------------
@@ -970,6 +1112,9 @@ _JAX_STAGES = (
     ("parallel_trials_10k_tpe_hpob",
      lambda: bench_parallel_trials_tpe(domain="hpob_surrogate")),
     ("ml_cv", bench_ml_cv),
+    # jnp-vs-pallas EI crossover by component count (ISSUE 6 satellite):
+    # keeps pallas_ei.py's MEASURED VERDICT current; jnp-only off TPU
+    ("pallas_ei", bench_pallas_ei),
 )
 
 _PROBE_SNIPPET = (
@@ -1083,6 +1228,10 @@ def main():
         detail[name] = (rec["result"] if rec and rec.get("ok")
                         else {"error": (rec or {}).get("error", "not run")})
     detail["sharded_scaling_cpu_mesh"] = bench_sharded_scaling()
+    # the ISSUE 6 headline stage: fused tell+ask sharded over the 8-device
+    # CPU mesh — candidates/sec per shard count (bench_gate key
+    # ``sharded_cand_per_sec``), 64x candidate batches, bf16 history bytes
+    detail["sharded_suggest"] = bench_sharded_suggest()
     # device-utilization roll-up: achieved FLOP/s + busy fraction for every
     # stage that reported one, in one block — the bench_*_detail.txt
     # artifacts answer "how hard did the hardware work" without re-running
@@ -1158,6 +1307,18 @@ def main():
             k: rec["result"].get(k)
             for k in ("peak_hbm_bytes", "bytes_limit", "hbm_watermark_frac",
                       "history_bytes", "memory_stats_available")}
+    # the sharded fused suggest (ISSUE 6 tentpole) rides the headline line:
+    # candidates/sec by shard count, the 64x candidate-batch multiple, and
+    # the bf16 history byte reduction at unchanged cap
+    ss = detail.get("sharded_suggest") or {}
+    if "error" not in ss and ss:
+        obs_summary["sharded_suggest"] = {
+            "cand_per_sec_by_shards": {
+                k.split("_", 1)[1]: round(v["sharded_cand_per_sec"], 1)
+                for k, v in ss.items() if k.startswith("shards_")},
+            "cand_batch_multiple": ss.get("cand_batch_multiple"),
+            "bf16_reduction_x": ss.get("bf16_reduction_x"),
+        }
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact

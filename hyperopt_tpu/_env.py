@@ -20,6 +20,10 @@ __all__ = [
     "enable_persistent_compilation_cache",
     "parse_obs_http",
     "parse_devmem_period",
+    "parse_hist_dtype",
+    "parse_shard",
+    "parse_hist_shard_min",
+    "parse_pallas",
 ]
 
 logger = logging.getLogger(__name__)
@@ -85,6 +89,85 @@ def parse_devmem_period(env=None):
         _warn_once("HYPEROPT_TPU_DEVMEM", raw, "a positive sample period")
         return None
     return period
+
+# -- sharded-suggest / compressed-history knobs (ISSUE 6) -------------------
+# These follow the same warn-and-disable convention as the observability
+# vars: a bad value must never take down the run it would have tuned.
+
+def parse_hist_dtype(env=None):
+    """``HYPEROPT_TPU_HIST_DTYPE=bf16|f32`` → the DEVICE storage dtype name
+    for the padded-history mirror (``"bfloat16"`` or ``"float32"``, default
+    f32).  The host numpy arrays stay float32 and authoritative either way
+    — pickle/checkpoint never see the compressed form; kernels accumulate
+    in f32 after an on-read upcast (docs/DESIGN.md §13)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_HIST_DTYPE", "").strip().lower()
+    if raw in ("", "f32", "fp32", "float32"):
+        return "float32"
+    if raw in ("bf16", "bfloat16"):
+        return "bfloat16"
+    _warn_once("HYPEROPT_TPU_HIST_DTYPE", raw, "one of bf16|f32")
+    return "float32"
+
+
+def parse_shard(env=None):
+    """``HYPEROPT_TPU_SHARD`` → number of devices the fused tell+ask
+    program shards over, or None when disabled.  ``auto``/``on`` (or
+    ``all``) means "all local devices" (returned as ``-1``); an integer
+    ``k >= 1`` uses exactly the first ``k``.  Disabled (default) keeps the
+    single-chip program byte-identical to previous rounds."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SHARD", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return None
+    if raw in ("on", "true", "yes", "auto", "all"):
+        return -1  # all local devices
+    try:
+        k = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_SHARD", raw, "an integer device count "
+                   "(or auto/on/off)")
+        return None
+    if k < 1:
+        _warn_once("HYPEROPT_TPU_SHARD", raw, "a positive device count")
+        return None
+    return k
+
+
+# default per-chip history-capacity threshold above which the history AXIS
+# shards across the mesh (below it, history replicates: the Parzen fit
+# wants the whole history anyway and replication avoids the gather)
+DEFAULT_HIST_SHARD_MIN = 65536
+
+
+def parse_hist_shard_min(env=None):
+    """``HYPEROPT_TPU_HIST_SHARD_MIN=<cap>`` → capacity threshold at which
+    a sharded suggest program also shards the HISTORY axis (per-chip HBM
+    then holds ``cap / n_shards`` rows).  Default 65536."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_HIST_SHARD_MIN", "").strip()
+    if not raw:
+        return DEFAULT_HIST_SHARD_MIN
+    try:
+        v = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_HIST_SHARD_MIN", raw, "an integer capacity")
+        return DEFAULT_HIST_SHARD_MIN
+    if v < 1:
+        _warn_once("HYPEROPT_TPU_HIST_SHARD_MIN", raw, "a positive capacity")
+        return DEFAULT_HIST_SHARD_MIN
+    return v
+
+
+def parse_pallas(env=None):
+    """``HYPEROPT_TPU_PALLAS=1`` → route the un-quantized numeric EI score
+    through ``pallas_ei.ei_diff`` (opt-in; the large-component regime the
+    MEASURED VERDICT in pallas_ei.py identifies).  ``ei_diff`` itself falls
+    back to the jnp twin off-TPU, so arming this flag is always safe."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_PALLAS", "").strip().lower()
+    return raw not in ("", "0", "off", "false", "no")
+
 
 _CACHE_CONFIGURED = False
 _EXPLICIT_DIR = None  # the explicit dir currently configured, if any

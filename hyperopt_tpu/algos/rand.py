@@ -16,6 +16,7 @@ import jax
 from ..utils import LRUCache
 
 __all__ = ["suggest", "suggest_async", "suggest_batch", "AskHandle",
+           "pad_ids_to_multiple",
            "flat_to_new_trial_docs", "seed_to_key",
            "fold_ids", "pad_ids_pow2", "pad_ids_sticky"]
 
@@ -138,6 +139,20 @@ def pad_ids_pow2(new_ids, min_bucket=1):
     while B < max(len(ids), int(min_bucket)):
         B *= 2
     return np.asarray(ids + [ids[-1]] * (B - len(ids)), np.uint32)
+
+
+def pad_ids_to_multiple(ids, n):
+    """Pad an already-bucketed ``uint32`` id array up to a multiple of
+    ``n`` (a mesh's device count) by repeating the last id — sharded
+    programs need the batch axis divisible by the mesh; a tail queue batch
+    of 3 on an 8-device mesh would otherwise abort the run.  Extras are
+    discarded on host (``unpack_flats(..., n)``) and never change the kept
+    proposals: per-id keys derive from the id VALUE, not the position."""
+    n = int(n)
+    if n <= 1 or len(ids) % n == 0:
+        return ids
+    B = -(-len(ids) // n) * n
+    return np.concatenate([ids, np.full(B - len(ids), ids[-1], np.uint32)])
 
 
 def pad_ids_sticky(domain, new_ids):

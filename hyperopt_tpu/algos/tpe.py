@@ -62,6 +62,7 @@ __all__ = [
     "split_below_above",
     "build_propose",
     "build_propose_with_scores",
+    "build_propose_candidates",
 ]
 
 # -- reference defaults (hyperopt/tpe.py ≈L20-40, sym: _default_*) -----------
@@ -557,14 +558,45 @@ def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
     return x
 
 
+def _pallas_armed():
+    """``HYPEROPT_TPU_PALLAS=1`` routes the un-quantized numeric EI score
+    through the fused pallas kernel (``pallas_ei.ei_diff``) — opt-in for
+    the large-component regime where the jnp path's ``[m, n]``
+    intermediate stops fitting VMEM (see the MEASURED VERDICT in
+    pallas_ei.py).  Checked at TRACE time; callers that cache traced
+    programs must fold this flag into their cache key."""
+    from .._env import parse_pallas
+
+    return parse_pallas()
+
+
+def _ei_pallas(samples, log_space, wb, mb, sb, wa, ma, sa, low, high):
+    """EI = lpdf_below − lpdf_above via ``pallas_ei.ei_diff`` for the
+    un-quantized families.  The kernel computes the raw two-mixture
+    log-density difference; the truncation normalizers (``log p_accept``)
+    are scalars applied here, and the per-sample Jacobian of the log-space
+    density cancels in the difference — so this matches the jnp path's
+    math exactly (up to fp reassociation; tests pin 1e-4 agreement)."""
+    from .. import pallas_ei
+
+    x = jnp.log(jnp.maximum(samples, EPS)) if log_space else samples
+    _, _, _, pb = _trunc_masses(wb, mb, sb, low, high)
+    _, _, _, pa = _trunc_masses(wa, ma, sa, low, high)
+    return (pallas_ei.ei_diff(x, wb, mb, sb, wa, ma, sa)
+            - jnp.log(jnp.maximum(pb, EPS)) + jnp.log(jnp.maximum(pa, EPS)))
+
+
 def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg,
-                     diag=False):
+                     diag=False, raw=False):
     """Sample candidates from the below model, score EI = llik_below −
     llik_above, return ``(selected candidate, its EI)`` (tpe.py sym:
     broadcast_best; selection policy: ``_select_candidate``).  The EI score
     is what cross-shard argmax reductions consume (parallel/sharding.py).
     ``diag=True`` appends the per-label health stats vector
-    (``_diag_stats``) — same proposal, one extra output."""
+    (``_diag_stats``) — same proposal, one extra output.  ``raw=True``
+    returns the whole ``(samples, ei)`` candidate pool pre-selection —
+    what the sharded candidate axis pools across devices before its own
+    masked top-k select."""
     prior_mu, prior_sigma, low, high, q, log_space = _parzen_from(dist)
     obs = vals
     if log_space:
@@ -581,14 +613,19 @@ def _propose_numeric(key, dist, vals, below_mask, above_mask, cfg,
     n_cand = cfg["n_EI_candidates"]
     if log_space:
         samples = lgmm1_sample(key, wb, mb, sb, low, high, q, n_cand)
-        ll_b = lgmm1_lpdf(samples, wb, mb, sb, low, high, q)
-        ll_a = lgmm1_lpdf(samples, wa, ma, sa, low, high, q)
     else:
         samples = gmm1_sample(key, wb, mb, sb, low, high, q, n_cand)
-        ll_b = gmm1_lpdf(samples, wb, mb, sb, low, high, q)
-        ll_a = gmm1_lpdf(samples, wa, ma, sa, low, high, q)
-    ei = ll_b - ll_a
+    if q is None and _pallas_armed():
+        ei = _ei_pallas(samples, log_space, wb, mb, sb, wa, ma, sa, low,
+                        high)
+    else:
+        lpdf = lgmm1_lpdf if log_space else gmm1_lpdf
+        ll_b = lpdf(samples, wb, mb, sb, low, high, q)
+        ll_a = lpdf(samples, wa, ma, sa, low, high, q)
+        ei = ll_b - ll_a
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)  # -inf − -inf must never win
+    if raw:
+        return samples, ei
     val, ei_sel = _select_candidate(key, samples, ei, cfg)
     lpdf = lgmm1_lpdf if log_space else gmm1_lpdf
     out, ei_out, take = _mix_prior(
@@ -870,7 +907,7 @@ def _prior_draw_discrete(kp, prior_p):
 
 
 def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg,
-                      diag=False):
+                      diag=False, raw=False):
     prior_p = jnp.asarray(_prior_probs(dist))
     offset = 0
     if dist.family == "randint":
@@ -899,6 +936,8 @@ def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg,
     )
     ei = logs[:, 0] - logs[:, 1]
     ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
+    if raw:
+        return samples + offset, ei
     val, ei_sel = _select_candidate(key, samples, ei, cfg)
     val, ei_out, take = _mix_prior(
         key, cfg, val, ei_sel,
@@ -987,7 +1026,11 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
             numeric_groups.append((ls, quantized, bounded, has_log, statics))
 
     def propose(history, key):
-        losses = jnp.asarray(history["losses"])
+        # f32 accumulation boundary: the resident history may be stored in
+        # a compressed dtype (HYPEROPT_TPU_HIST_DTYPE=bf16); every kernel
+        # consumes it upcast to float32 so the Parzen fit / EI math is
+        # unchanged — only the HBM-resident bytes shrink
+        losses = jnp.asarray(history["losses"]).astype(jnp.float32)
         has_loss = jnp.asarray(history["has_loss"])
         below, above = split_below_above(losses, has_loss, cfg["gamma"], cfg["LF"])
         out = {}
@@ -997,7 +1040,8 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
             keys = jnp.stack([
                 jax.random.fold_in(key, label_hash(l)) for l in ls
             ])
-            obs = jnp.stack([jnp.asarray(history["vals"][l]) for l in ls])
+            obs = jnp.stack([jnp.asarray(history["vals"][l]).astype(
+                jnp.float32) for l in ls])
             act = jnp.stack([jnp.asarray(history["active"][l]) for l in ls])
             return keys, obs, below[None, :] & act, above[None, :] & act
 
@@ -1020,7 +1064,7 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
             if label in grouped:
                 continue
             info = cs.params[label]
-            vals = jnp.asarray(history["vals"][label])
+            vals = jnp.asarray(history["vals"][label]).astype(jnp.float32)
             active = jnp.asarray(history["active"][label])
             k = jax.random.fold_in(key, label_hash(label))
             b = below & active
@@ -1061,6 +1105,44 @@ def build_propose(cs, cfg, group=True):
     return propose
 
 
+def build_propose_candidates(cs, cfg):
+    """Compile the RAW candidate pool: ``propose(history, key) -> {label:
+    (samples[n_EI_candidates], ei[n_EI_candidates])}`` — the
+    selection-free variant of :func:`build_propose_with_scores`.
+
+    This is what the sharded candidate axis consumes
+    (``parallel/sharding.py``): each device draws and scores a LOCAL pool
+    with this kernel, then masks padding candidates and selects across
+    devices AFTER an all-gather of per-shard top-k — the select cannot
+    live inside the per-device kernel.  Per-label kernels (not the grouped
+    pipeline): the sharded path runs few labels against very wide
+    candidate axes, the regime where per-label trace size is irrelevant
+    and the pallas EI opt-in (``HYPEROPT_TPU_PALLAS=1``) applies."""
+
+    def propose(history, key):
+        losses = jnp.asarray(history["losses"]).astype(jnp.float32)
+        has_loss = jnp.asarray(history["has_loss"])
+        below, above = split_below_above(losses, has_loss, cfg["gamma"],
+                                         cfg["LF"])
+        out = {}
+        for label in cs.labels:
+            info = cs.params[label]
+            vals = jnp.asarray(history["vals"][label]).astype(jnp.float32)
+            active = jnp.asarray(history["active"][label])
+            k = jax.random.fold_in(key, label_hash(label))
+            b = below & active
+            a = above & active
+            if info.dist.family in ("categorical", "randint"):
+                out[label] = _propose_discrete(k, info.dist, vals, b, a,
+                                               cfg, raw=True)
+            else:
+                out[label] = _propose_numeric(k, info.dist, vals, b, a,
+                                              cfg, raw=True)
+        return out
+
+    return propose
+
+
 # (space signature, cfg) -> fused tell+ask program; LRU-bounded — every
 # entry pins a compiled XLA executable
 _suggest_jit_cache = LRUCache(32)
@@ -1076,16 +1158,20 @@ def _apply_rows(labels, history, rows):
     tell+ask program compiles exactly once per space."""
     L = len(labels)
     idx = rows[:, 2 * L + 2].astype(jnp.int32)  # [K]
+    # .astype(leaf dtype): rows arrive f32; a compressed (bf16) resident
+    # history takes the scatter in its own storage dtype
     return {
         "vals": {
-            l: history["vals"][l].at[idx].set(rows[:, j], mode="drop")
+            l: history["vals"][l].at[idx].set(
+                rows[:, j].astype(history["vals"][l].dtype), mode="drop")
             for j, l in enumerate(labels)
         },
         "active": {
             l: history["active"][l].at[idx].set(rows[:, L + j] > 0.5, mode="drop")
             for j, l in enumerate(labels)
         },
-        "losses": history["losses"].at[idx].set(rows[:, 2 * L], mode="drop"),
+        "losses": history["losses"].at[idx].set(
+            rows[:, 2 * L].astype(history["losses"].dtype), mode="drop"),
         "has_loss": history["has_loss"].at[idx].set(rows[:, 2 * L + 1] > 0.5,
                                                     mode="drop"),
     }
@@ -1103,7 +1189,8 @@ def _donation_enabled():
                           "").strip().lower() in ("", "0", "false", "no")
 
 
-def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True):
+def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
+                     mesh=None, shard_history=False):
     """The fused tell+ask program:
     ``run(history, rows, seed_words[2], ids[B]) -> (history', packed[B, L])``.
 
@@ -1126,12 +1213,32 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True):
     the padded history (callers MUST thread the returned history handle
     forward — ``PaddedHistory.device_state(donate=True)`` /
     ``commit_device`` enforce that with a stale-handle guard).
+
+    ``mesh`` (a ``sharding.suggest_mesh``) compiles the SAME traced
+    program with explicit ``NamedSharding``s from the partition-rule table
+    (``sharding.suggest_shardings``): the proposal batch axis (``ids``,
+    ``packed``, diagnostics) shards over the mesh always; the history axis
+    shards too when ``shard_history=True`` (``hist_cap`` past the per-chip
+    threshold).  ``donate_argnums`` is preserved, so the no-cap-copy
+    invariant (``DONATION_GATE``) holds on the sharded path — the in-place
+    scatter aliases per-shard buffers.  Per-proposal math is device-local
+    under batch sharding, so sharded proposals are BIT-IDENTICAL to the
+    single-chip program at the same seed (pinned across mesh shapes
+    {1, 2, 4, 8}).
     """
     cs = domain.cs
     key = ((cs.signature(), cfg_key, "health") if diag
            else (cs.signature(), cfg_key))
     if not donate:
         key = key + ("nodonate",)
+    if _pallas_armed():
+        # the pallas opt-in changes the traced program: its cache entry
+        # must not shadow (or be shadowed by) the jnp build
+        key = key + ("pallas",)
+    if mesh is not None:
+        geom = (tuple(mesh.shape.items()),
+                tuple(d.id for d in mesh.devices.flat))
+        key = key + ("mesh", geom, bool(shard_history))
     fn = _suggest_jit_cache.get(key)
     if fn is None:
         if diag:
@@ -1166,7 +1273,25 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True):
                 out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
                 return hist, rand.pack_labels(cs, out)
 
-        fn = jax.jit(run, donate_argnums=(0,)) if donate else jax.jit(run)
+        donate_kw = {"donate_argnums": (0,)} if donate else {}
+        if mesh is None:
+            fn = jax.jit(run, **donate_kw)
+        else:
+            from ..parallel import sharding as _sh
+
+            in_sh, out_sh = _sh.suggest_shardings(
+                mesh, cs.labels, shard_history=shard_history, diag=diag)
+            try:
+                fn = jax.jit(run, in_shardings=in_sh,
+                             out_shardings=out_sh, **donate_kw)
+            except TypeError:  # pragma: no cover - ancient jax builds
+                # explicit-shardings jit unavailable: shard_map fallback
+                # (SNIPPETS.md [3] doctrine — map-style data parallelism
+                # over the batch axis, history replicated; donation is
+                # best-effort through the outer jit)
+                fn = jax.jit(_sh.shard_map_suggest_fallback(run, mesh,
+                                                            diag=diag),
+                             **donate_kw)
         _suggest_jit_cache.put(key, fn)
     return fn
 
@@ -1241,10 +1366,41 @@ def suggest_async(
     # same single readback as before the health layer existed.
     health = getattr(trials, "obs_health", None)
     donate = _donation_enabled()
+    # HYPEROPT_TPU_SHARD arms the mesh-sharded fused program: the proposal
+    # batch shards over local devices (history too, past the per-chip cap
+    # threshold) — unset, the single-chip program is byte-identical to
+    # previous rounds
+    from .._env import parse_shard
+
+    n_shard = parse_shard()
+    mesh = None
+    shard_hist = False
+    if n_shard is not None:
+        from ..parallel import sharding as _sh
+
+        mesh = _sh.suggest_mesh(n_shard)
+        shard_hist = _sh.should_shard_history(ph.cap, mesh)
     run = _get_suggest_jit(domain, cfg_key, cfg, diag=health is not None,
-                           donate=donate)
+                           donate=donate, mesh=mesh,
+                           shard_history=shard_hist)
     ids = rand.pad_ids_sticky(domain, new_ids)
     dev, rows = ph.device_state(donate=donate)
+    if mesh is not None:
+        n_dev = int(mesh.devices.size)
+        # the batch axis must divide the mesh; pad with the last id
+        # (extras discarded on host, per-id keys make pads harmless)
+        ids = rand.pad_ids_to_multiple(ids, n_dev)
+        # steady state this is a no-op (committed handles already carry
+        # the mesh layout and device_put short-circuits); the first
+        # sharded tick — or a post-growth re-upload — pays one placement
+        # copy, after which donation aliases per-shard buffers in place
+        dev = _sh.place_history(dev, mesh, shard_history=shard_hist)
+        m = getattr(trials, "obs_metrics", None)
+        if m is not None:
+            m.gauge("suggest.shards").set(n_dev)
+            m.gauge("suggest.cand_per_shard").set(
+                (len(ids) // n_dev) * cfg["n_EI_candidates"])
+            m.gauge("suggest.hist_sharded").set(int(shard_hist))
     args = (dev, rows, _seed_words(seed), ids)
     if health is not None:
         from ..obs import health as _health_mod
@@ -1333,10 +1489,13 @@ def suggest_sharded(
     * queue batches (``len(new_ids) > 1``) shard the TRIAL axis — each
       device proposes for its slice of the batch (ids pad to a power of
       two, then up to a multiple of the mesh's device count, so tail
-      batches always shard evenly).
+      batches always shard evenly).  With ``n_cand_shards > 1`` the whole
+      batch additionally scores over the DISTRIBUTED candidate pool
+      (``sharding.propose_sharded_candidates(batch=B)``: per-shard top-k
+      all-gathered, pooled select).
     * single proposals with ``n_cand_shards > 1`` shard the CANDIDATE axis
-      via ``shard_map`` + all-gather EI argmax (`n_EI_candidates` split
-      across devices).
+      via ``shard_map`` + all-gather top-k select (`n_EI_candidates` split
+      across devices; counts that do not divide pad and mask).
 
     ``mesh=None`` builds a mesh over all visible devices at first use (so
     the factory can be called before jax initializes).  ``ei_select``
@@ -1385,10 +1544,31 @@ def suggest_sharded(
         }
         cs = domain.cs
         geom = (tuple(m.shape.items()), tuple(d.id for d in m.devices.flat))
-        cache_key = (cs.signature(), tuple(sorted(cfg.items())), geom, batched)
+        # batched + candidate shards: every proposal in the batch scores
+        # over the DISTRIBUTED candidate pool (round-6
+        # propose_sharded_candidates growth) — the program is specialized
+        # on the padded batch width, so that width joins the cache key
+        cand_batched = batched and int(m.shape[_sh.CAND_AXIS]) > 1
+        padded = None
+        if batched:
+            # pad to a power of two, then up to a multiple of the mesh's
+            # device count: in_shardings require the batch axis divisible
+            # by the mesh (a tail queue batch of 3 on an 8-device mesh
+            # would otherwise abort the run)
+            n_dev = int(np.prod(list(m.shape.values())))
+            padded = rand.pad_ids_to_multiple(
+                rand.pad_ids_sticky(domain, new_ids), n_dev)
+        # _pallas_armed() changes the traced program (build_propose_
+        # candidates' EI path), so the flag joins the cache key
+        cache_key = (cs.signature(), tuple(sorted(cfg.items())), geom,
+                     batched, len(padded) if cand_batched else None,
+                     _pallas_armed())
         fn = _sharded_jit_cache.get(cache_key)
         if fn is None:
-            if batched:
+            if cand_batched:
+                fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True,
+                                                    batch=len(padded))
+            elif batched:
                 fn = _sh.suggest_batch_sharded(cs, cfg, m, packed=True)
             else:
                 fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True)
@@ -1400,16 +1580,6 @@ def suggest_sharded(
         hist_dev = _sh.replicate_history(hist, m)
         base = rand.seed_to_key(seed)
         if batched:
-            # pad to a power of two, then up to a multiple of the mesh's
-            # device count: in_shardings require the batch axis divisible
-            # by the mesh (a tail queue batch of 3 on an 8-device mesh
-            # would otherwise abort the run)
-            n_dev = int(np.prod(list(m.shape.values())))
-            padded = rand.pad_ids_sticky(domain, new_ids)
-            if len(padded) % n_dev:
-                B = ((len(padded) + n_dev - 1) // n_dev) * n_dev
-                padded = np.concatenate(
-                    [padded, np.full(B - len(padded), padded[-1], np.uint32)])
             keys = rand.fold_ids(base, padded)
             mat = fn(hist_dev, keys)  # [B_pad, L] packed, batch-sharded
             flats = rand.unpack_flats(cs, np.asarray(mat), len(new_ids))

@@ -191,32 +191,35 @@ def _bucket_cap(n: int) -> int:
     return cap
 
 
-_updater_cache = {}  # (labels, cap) -> jitted single-row history update
+_updater_cache = {}  # (labels, cap, dtype) -> jitted single-row history update
 
 
-def _get_history_updater(labels, cap):
+def _get_history_updater(labels, cap, dtype="float32"):
     """One jitted program that folds a packed trial row into every device
     array of the history — ONE dispatch per completed trial instead of
     2·L+2 separate ``.at[]`` updates (which each cost a host↔device round
-    trip over a tunneled accelerator)."""
-    key = (labels, cap)
+    trip over a tunneled accelerator).  ``dtype`` is the mirror's float
+    STORAGE dtype (``HYPEROPT_TPU_HIST_DTYPE``); rows arrive f32 and cast
+    on the scatter."""
+    key = (labels, cap, str(dtype))
     fn = _updater_cache.get(key)
     if fn is None:
         L = len(labels)
+        dt = jnp.dtype(dtype)
 
         def update(dev, row):
             # row layout: [vals(L), active(L), loss, has_loss, index]
             i = row[2 * L + 2].astype(jnp.int32)
             return {
                 "vals": {
-                    l: dev["vals"][l].at[i].set(row[j])
+                    l: dev["vals"][l].at[i].set(row[j].astype(dt))
                     for j, l in enumerate(labels)
                 },
                 "active": {
                     l: dev["active"][l].at[i].set(row[L + j] > 0.5)
                     for j, l in enumerate(labels)
                 },
-                "losses": dev["losses"].at[i].set(row[2 * L]),
+                "losses": dev["losses"].at[i].set(row[2 * L].astype(dt)),
                 "has_loss": dev["has_loss"].at[i].set(row[2 * L + 1] > 0.5),
             }
 
@@ -238,10 +241,21 @@ class PaddedHistory:
     cost is one incremental update dispatch, not a re-upload of every array
     (the round-2 host-loop bottleneck: ~2·L+2 transfers per proposal over
     the TPU tunnel).
+
+    ``HYPEROPT_TPU_HIST_DTYPE=bf16`` compresses the DEVICE mirror's float
+    arrays (``vals``, ``losses``) to bfloat16 — half the resident HBM at
+    unchanged ``cap``; kernels upcast to f32 on read (docs/DESIGN.md §13).
+    The host numpy arrays stay float32 and authoritative, so
+    pickle/checkpoint/resume never see the compressed form; the dtype is
+    captured at construction and travels through pickle, so a resumed run
+    keeps proposing bit-identically to the uninterrupted one.
     """
 
-    def __init__(self, labels):
+    def __init__(self, labels, hist_dtype=None):
+        from ._env import parse_hist_dtype
+
         self.labels = tuple(labels)
+        self.hist_dtype = str(hist_dtype) if hist_dtype else parse_hist_dtype()
         self.n = 0
         self.cap = _MIN_CAP
         self._vals = {l: np.zeros(self.cap, np.float32) for l in self.labels}
@@ -307,10 +321,12 @@ class PaddedHistory:
         from .obs.devmem import register_owner
 
         register_owner("history", (self.cap,))
+        dt = jnp.dtype(self.hist_dtype)
         self._dev = {
-            "vals": {l: jnp.asarray(self._vals[l]) for l in self.labels},
+            "vals": {l: jnp.asarray(self._vals[l]).astype(dt)
+                     for l in self.labels},
             "active": {l: jnp.asarray(self._active[l]) for l in self.labels},
-            "losses": jnp.asarray(self._losses),
+            "losses": jnp.asarray(self._losses).astype(dt),
             "has_loss": jnp.asarray(self._has_loss),
         }
         self._dev_synced = self.n
@@ -405,6 +421,12 @@ class PaddedHistory:
         state["_donated"] = False
         return state
 
+    def __setstate__(self, state):
+        # pickles from before the storage-dtype round carry no hist_dtype;
+        # they were f32 by construction
+        state.setdefault("hist_dtype", "float32")
+        self.__dict__.update(state)
+
     def device_view(self):
         """Device-resident arrays for the jitted kernels, synced incrementally
         (one fused update dispatch per new row; full upload only on capacity
@@ -418,7 +440,8 @@ class PaddedHistory:
                 # many rows landed at once (batch eval): re-upload wholesale
                 self._dev = None
                 return self.device_view()
-            update = _get_history_updater(self.labels, self.cap)
+            update = _get_history_updater(self.labels, self.cap,
+                                          self.hist_dtype)
             for i in range(self._dev_synced, self.n):
                 self._dev = update(self._dev, self._pack_row(i))
             self._dev_synced = self.n

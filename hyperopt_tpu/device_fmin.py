@@ -210,18 +210,54 @@ class DeviceLoopRunner:
     CHUNK = 10
 
     def __init__(self, domain, cfg, n_startup, cap, obs=None):
+        from ._env import parse_hist_dtype, parse_shard
+
         cs = domain.cs
         self.cs = cs
         self.cap = int(cap)
         self.labels = cs.labels
         self._obs = obs
         L = len(cs.labels)
+        # loop-state storage dtype (HYPEROPT_TPU_HIST_DTYPE): the cap-sized
+        # carry holds vals/losses compressed; kernels upcast on read
+        self.hist_dtype = parse_hist_dtype()
+        # HYPEROPT_TPU_SHARD + a cap past the per-chip threshold: the chunk
+        # program compiles with explicit NamedShardings from the
+        # partition-rule table, the history axis sharded over the mesh
+        self._mesh = None
+        if parse_shard() is not None:
+            from .parallel import sharding as _sh
+
+            mesh = _sh.suggest_mesh(parse_shard())
+            if _sh.should_shard_history(self.cap, mesh):
+                self._mesh = mesh
+        geom = (None if self._mesh is None
+                else tuple(d.id for d in self._mesh.devices.flat))
+        # the cap-sized loop state's layout, derived ONCE from the
+        # partition-rule table — the compile below and init_state's
+        # initial placement both read this, so they cannot diverge
+        self._state_sh = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+            from .parallel import sharding as _sh
+
+            rules = _sh.suggest_partition_rules(shard_history=True)
+            hist_specs = _sh.match_partition_rules(
+                rules, {"hist": _sh._hist_skeleton(cs.labels)})["hist"]
+            ns = lambda s: NamedSharding(self._mesh, s)  # noqa: E731
+            self._state_sh = (jax.tree.map(ns, hist_specs["vals"]),
+                              jax.tree.map(ns, hist_specs["active"]),
+                              ns(hist_specs["losses"]),
+                              ns(hist_specs["has_loss"]))
         # the jitted chunk program is cached across runner instances (the
         # shared LRU with fmin_device): a warm re-run of the same
         # (space, objective, cap, cfg) must not recompile
         donate = tpe._donation_enabled()
+        # tpe._pallas_armed() changes the traced proposal: fold it in so an
+        # env toggle mid-process cannot serve a stale program from the LRU
         cache_key = ("chunk", cs.expr, domain.fn, self.cap, int(n_startup),
-                     tuple(sorted(cfg.items())), self.CHUNK, donate)
+                     tuple(sorted(cfg.items())), self.CHUNK, donate,
+                     self.hist_dtype, geom, tpe._pallas_armed())
         cached = _RUN_CACHE.get(cache_key)
         _record_cache_stats()
         if cached is not None:
@@ -265,15 +301,17 @@ class DeviceLoopRunner:
                 # steps past `limit` still trace (static chunk) but fold
                 # nowhere: index cap is dropped by mode='drop'
                 idx = jnp.where(i < limit, i, cap_i)
-                vals = {l: vals[l].at[idx].set(flat[l], mode="drop")
+                vals = {l: vals[l].at[idx].set(
+                            flat[l].astype(vals[l].dtype), mode="drop")
                         for l in cs.labels}
                 active = {
                     l: active[l].at[idx].set(jnp.asarray(act[l], bool),
                                              mode="drop")
                     for l in cs.labels
                 }
-                losses = losses.at[idx].set(jnp.where(ok, loss, jnp.inf),
-                                            mode="drop")
+                losses = losses.at[idx].set(
+                    jnp.where(ok, loss, jnp.inf).astype(losses.dtype),
+                    mode="drop")
                 has_loss = has_loss.at[idx].set(ok, mode="drop")
                 row = jnp.concatenate([
                     jnp.stack([flat[l] for l in cs.labels]),
@@ -288,8 +326,23 @@ class DeviceLoopRunner:
                 jnp.arange(chunk, dtype=jnp.int32))
             return state, rows
 
-        run_chunk = (jax.jit(run_chunk, donate_argnums=(0,)) if donate
-                     else jax.jit(run_chunk))
+        donate_kw = {"donate_argnums": (0,)} if donate else {}
+        if self._mesh is None:
+            run_chunk = jax.jit(run_chunk, **donate_kw)
+        else:
+            # explicit NamedShardings from the partition-rule table
+            # (self._state_sh, computed once in __init__): the cap-sized
+            # loop state shards its capacity axis over the mesh (per-chip
+            # HBM holds cap / n_shards rows); scalars and the
+            # [CHUNK, 2L+1] readback replicate.  donate_argnums preserved:
+            # the chunk's scatters stay in-place on per-shard buffers.
+            from jax.sharding import NamedSharding
+
+            rep = NamedSharding(self._mesh, jax.sharding.PartitionSpec())
+            run_chunk = jax.jit(
+                run_chunk,
+                in_shardings=(self._state_sh, rep, rep, rep),
+                out_shardings=(self._state_sh, rep), **donate_kw)
 
         self._holder = {"jit": run_chunk, "compiled": None}
         self._L = L
@@ -303,12 +356,21 @@ class DeviceLoopRunner:
         from .obs.devmem import register_owner
 
         register_owner("history", (cap,))
-        return (
-            {l: jnp.zeros(cap, jnp.float32) for l in self.labels},
+        dt = jnp.dtype(self.hist_dtype)
+        state = (
+            {l: jnp.zeros(cap, dt) for l in self.labels},
             {l: jnp.zeros(cap, bool) for l in self.labels},
-            jnp.full(cap, jnp.inf, jnp.float32),
+            jnp.full(cap, jnp.inf, dt),
             jnp.zeros(cap, bool),
         )
+        if self._state_sh is not None:
+            # place the initial state with the SAME table-derived specs
+            # the chunk program compiled against, so the very first
+            # chunk's donation aliases (no resharding copy)
+            state = tuple(
+                jax.tree.map(jax.device_put, part, sh_part)
+                for part, sh_part in zip(state, self._state_sh))
+        return state
 
     def run_chunk(self, state, start, limit, seed):
         """Run one chunk; returns ``(state', rows[limit-start, 2L+1])`` with
@@ -375,7 +437,8 @@ def fmin_device(
         "LF": int(linear_forgetting),
     }
 
-    cache_key = (cs.expr, fn, cap, int(n_startup_jobs), tuple(sorted(cfg.items())))
+    cache_key = (cs.expr, fn, cap, int(n_startup_jobs),
+                 tuple(sorted(cfg.items())), tpe._pallas_armed())
     holder = _RUN_CACHE.get(cache_key)
     _record_cache_stats()
     if holder is None:

@@ -99,14 +99,22 @@ def memory_stats():
     return out
 
 
-def live_array_census():
+def live_array_census(per_device=False):
     """Bucket every live jax array by shape-owner:
     ``{owner: {"count", "bytes"}}`` plus a ``"total"`` roll-up.  Only
     arrays a Python reference keeps alive are visible — which is exactly
-    the leak surface (in-trace temporaries free themselves)."""
+    the leak surface (in-trace temporaries free themselves).
+
+    ``per_device=True`` returns ``(buckets, per_device)`` where the second
+    element attributes each array's ADDRESSABLE shard bytes to the device
+    holding them (``{device: {owner: {"count", "bytes"}}}``) — on a
+    sharded suggest mesh this is the breakdown that shows where the
+    candidate/history axes actually landed (a replicated leaf charges
+    every device its full size; a sharded one charges ``1/n_shards``)."""
     import jax
 
     buckets = {}
+    by_dev = {}
     total_n = total_b = 0
     for a in jax.live_arrays():
         try:
@@ -119,7 +127,23 @@ def live_array_census():
         b["bytes"] += nbytes
         total_n += 1
         total_b += nbytes
+        if per_device:
+            try:
+                shards = a.addressable_shards
+            except Exception:
+                continue
+            for s in shards:
+                try:
+                    dev, sb = str(s.device), int(s.data.nbytes)
+                except Exception:
+                    continue
+                d = by_dev.setdefault(dev, {})
+                e = d.setdefault(owner, {"count": 0, "bytes": 0})
+                e["count"] += 1
+                e["bytes"] += sb
     buckets["total"] = {"count": total_n, "bytes": total_b}
+    if per_device:
+        return buckets, by_dev
     return buckets
 
 
@@ -192,7 +216,13 @@ class DevMemSampler:
     def _sample(self, reason):
         self._last_mono = time.monotonic()
         devices = memory_stats()
-        census = live_array_census()
+        # per-device owner attribution only when there is more than one
+        # device to attribute to (the sharded-suggest breakdown); the
+        # single-chip walk stays exactly as cheap as before
+        if len(devices) > 1:
+            census, per_device = live_array_census(per_device=True)
+        else:
+            census, per_device = live_array_census(), None
         mx_use, mx_peak, mx_lim, frac = roll_up(devices)
         obs = self.obs
         m = obs.metrics
@@ -211,6 +241,8 @@ class DevMemSampler:
         m.gauge("devmem.live_bytes").set(census["total"]["bytes"])
         rec = {"kind": "devmem", "ts": time.time(), "reason": reason,
                "run_id": obs.run_id, "devices": devices, "census": census}
+        if per_device:
+            rec["per_device"] = per_device
         with self._lock:
             self._tail.append(rec)
         sink = getattr(obs, "sink", None)

@@ -276,6 +276,16 @@ def _pipeline_section(spans, metrics, out):
         if name in agg:
             sec, count = agg[name]
             out.append(f"  {name:<18} wall {_fmt_sec(sec):>8}  x{count}")
+    shards = metrics.get("suggest.shards")
+    if shards:
+        line = f"  sharded over {int(shards)} device(s)"
+        cps = metrics.get("suggest.cand_per_shard")
+        if cps:
+            line += f"  cand/shard {int(cps)}"
+        line += ("  history axis: sharded"
+                 if metrics.get("suggest.hist_sharded")
+                 else "  history axis: replicated")
+        out.append(line)
     spec = metrics.get("suggest.speculative", 0)
     blocked = metrics.get("ask.blocked_sec") or {}
     if blocked.get("count"):
@@ -336,6 +346,17 @@ def _devmem_section(devmem_recs, out):
         out.append("  live arrays (last census): " + "  ".join(parts)
                    + (f"  | total {_fmt_bytes(tot.get('bytes', 0))} "
                       f"(x{tot.get('count', 0)})" if tot else ""))
+    per_device = devmem_recs[-1].get("per_device") or {}
+    if per_device:
+        # the sharded-suggest breakdown: where each owner's bytes actually
+        # landed, device by device (a sharded axis shows up as 1/n-sized
+        # slices; a replicated leaf charges every device in full)
+        out.append("  per-shard breakdown (last census):")
+        for dev in sorted(per_device):
+            owners = per_device[dev]
+            parts = [f"{o} {_fmt_bytes(owners[o]['bytes'])}"
+                     for o in sorted(owners) if o != "total"]
+            out.append(f"    {dev}: " + "  ".join(parts))
 
 
 def _fmt_bytes(n):

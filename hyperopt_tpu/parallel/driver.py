@@ -309,9 +309,16 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     # global-mesh sharded program otherwise (bitwise-identical outputs —
     # the mesh test asserts it)
     from . import sharding
+    from .._env import parse_hist_dtype
 
+    # device-resident history storage dtype (HYPEROPT_TPU_HIST_DTYPE):
+    # bf16 halves the resident bytes; kernels upcast on read and the fold
+    # accumulates in f32, so the checkpoint (host numpy, always f32) and
+    # the digest are unaffected
+    hist_dtype = parse_hist_dtype()
     if single:
         mesh = None
+        shard_hist = False
         propose_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg),
                                       in_axes=(None, 0)))
         sample_fn = jax.jit(jax.vmap(cs.sample_flat))
@@ -319,11 +326,17 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         from . import multihost
 
         mesh = multihost.global_mesh()
+        # past the per-chip threshold the history axis shards over the
+        # global mesh — each chip then holds cap / n_devices rows instead
+        # of a full replicated copy (ROADMAP item 2: the HBM wall)
+        shard_hist = sharding.should_shard_history(cap, mesh)
         # packed=True: one [batch, L] buffer -> ONE cross-host collective
         # per generation instead of one per label
-        propose_sharded = sharding.suggest_batch_sharded(cs, cfg, mesh,
-                                                         packed=True)
+        propose_sharded = sharding.suggest_batch_sharded(
+            cs, cfg, mesh, packed=True, shard_history=shard_hist)
         sample_fn = jax.jit(jax.vmap(cs.sample_flat))
+        obs.gauge("suggest.shards").set(n_dev)
+        obs.gauge("suggest.hist_sharded").set(int(shard_hist))
 
     # DEVICE-RESIDENT history mirror: built once (replicated on the global
     # mesh in multihost mode), then advanced per generation by a DONATED
@@ -357,7 +370,11 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 args = tuple(multihost.replicate_global(a, mesh)
                              for a in args)
             try:
-                mirror["dev"] = sharding.build_history_fold(labels)(
+                # mesh-aware fold: the scatter lands directly in the
+                # (possibly capacity-sharded) resident layout — never via
+                # an intermediate replicated cap-sized copy
+                mirror["dev"] = sharding.build_history_fold(
+                    labels, mesh=mesh, shard_history=shard_hist)(
                     mirror["dev"], *args)
                 mirror["synced"] = e
                 obs.counter("mirror.incremental_folds").inc()
@@ -365,9 +382,20 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 # the donated input is gone either way; rebuild from host
                 mirror["dev"] = None
         if mirror["dev"] is None:
-            mirror["dev"] = (multihost.replicate_global(hist, mesh)
-                             if not single
-                             else jax.tree.map(jnp.asarray, hist))
+            if single:
+                dt = jnp.dtype(hist_dtype)
+                mirror["dev"] = jax.tree.map(
+                    lambda x: (jnp.asarray(x).astype(dt)
+                               if np.issubdtype(np.asarray(x).dtype,
+                                                np.floating)
+                               else jnp.asarray(x)), hist)
+            else:
+                from jax.sharding import PartitionSpec as _P
+
+                spec = (_P((sharding.TRIALS_AXIS, sharding.CAND_AXIS))
+                        if shard_hist else None)
+                mirror["dev"] = multihost.replicate_global(
+                    hist, mesh, spec=spec, dtype=jnp.dtype(hist_dtype))
             mirror["synced"] = n_now
             obs.counter("mirror.full_uploads").inc()
         return mirror["dev"]

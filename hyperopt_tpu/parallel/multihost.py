@@ -98,23 +98,29 @@ def global_mesh(n_cand_shards=1):
     return sharding.make_mesh(len(jax.devices()), n_cand_shards=n_cand_shards)
 
 
-def replicate_global(tree, mesh):
-    """Replicate a host-value pytree onto every device of a (possibly
-    multi-process) global mesh.  The value must be identical on every
-    process — true by construction for trial history, which every
+def replicate_global(tree, mesh, spec=None, dtype=None):
+    """Place a host-value pytree onto every device of a (possibly
+    multi-process) global mesh — replicated by default, or laid out per
+    ``spec`` (a ``PartitionSpec``; the driver passes the capacity-axis
+    spec when the resident history shards).  The value must be identical
+    on every process — true by construction for trial history, which every
     controller folds deterministically.  ``jax.make_array_from_callback``
     assembles the global array from each process's addressable shards, the
-    multi-controller-safe equivalent of ``sharding.replicate_history``'s
-    single-process ``device_put``."""
+    multi-controller-safe equivalent of ``sharding.place_history``'s
+    single-process ``device_put``.  ``dtype`` compresses float leaves to
+    the storage dtype on the way (the bf16 resident-history path)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..obs.watchdog import beat as _wd_beat
 
-    rep = NamedSharding(mesh, P())
+    sh = NamedSharding(mesh, P() if spec is None else spec)
+    dt = np.dtype(dtype) if dtype is not None else None
 
     def put(x):
         x = np.asarray(x)
-        return jax.make_array_from_callback(x.shape, rep, lambda idx: x[idx])
+        if dt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(dt)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
 
     # liveness mark before handing the history to the runtime: device_put
     # onto a multi-process mesh can block on a peer that never arrives

@@ -9,15 +9,28 @@ Two axes scale in an HPO workload (SURVEY.md §5 "long-context" row):
   (fixed at 24 in the reference).
 
 ``suggest_batch_sharded`` shards the first over a mesh axis (pure data
-parallelism: per-trial RNG keys are split across devices, history is
-replicated, no cross-device traffic).  ``propose_sharded_candidates`` shards
-the second with ``jax.shard_map``: each device draws and EI-scores a local
-candidate slice, then an ``all_gather`` of per-device (best EI, best value)
-pairs resolves the global argmax — collectives ride ICI, the dense analog of
-a sequence-parallel reduction.
+parallelism: per-trial RNG keys are split across devices, history replicated
+— or, past :func:`hist_shard_threshold`, sharded along the capacity axis so
+per-chip HBM holds ``cap / n_shards`` rows).  ``propose_sharded_candidates``
+shards the second with ``shard_map``: each device draws and EI-scores a
+local candidate slice, contributes its top-k (EI, value) pairs to an
+``all_gather``, and a global top-k/softmax select resolves each proposal —
+collectives ride ICI, the dense analog of a sequence-parallel reduction.
+
+The **partition-rule table** (:data:`SUGGEST_PARTITION_RULES`, applied by
+:func:`match_partition_rules` — the regex → PartitionSpec pytree pattern) is
+the single source of truth for how every leaf of the fused tell+ask
+program's arguments lands on the mesh; ``tpe._get_suggest_jit`` and the
+driver's history fold both compile against it via ``jit`` with explicit
+``NamedSharding``s (``shard_map`` fallback on jax builds without
+``in_shardings`` support), with ``donate_argnums`` preserved so the PR-4
+zero-copy invariants hold on the sharded path.
 """
 
 from __future__ import annotations
+
+import logging
+import re
 
 import numpy as np
 
@@ -25,27 +38,173 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 exports it at top level
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: the experimental home
+    from jax.experimental.shard_map import shard_map
+
 from ..algos import tpe
 
 __all__ = [
     "make_mesh",
+    "suggest_mesh",
     "suggest_batch_sharded",
     "propose_sharded_candidates",
     "replicate_history",
+    "place_history",
     "build_history_fold",
+    "match_partition_rules",
+    "suggest_partition_rules",
+    "suggest_shardings",
+    "hist_shard_threshold",
+    "should_shard_history",
 ]
+
+logger = logging.getLogger(__name__)
 
 TRIALS_AXIS = "trials"
 CAND_AXIS = "cand"
 
-# labels tuple -> donated jitted generation fold (shape specialization is
-# jit's own cache; bounded because spaces are few per process)
+# (labels, mesh geometry, shard_history, dtypes) -> donated jitted
+# generation fold (shape specialization is jit's own cache; bounded because
+# spaces are few per process)
 _fold_cache = {}
 
 
-def build_history_fold(labels):
+# ---------------------------------------------------------------------------
+# partition-rule table (SNIPPETS.md [1]: regex over leaf paths -> spec)
+# ---------------------------------------------------------------------------
+
+
+def _leaf_path_str(path):
+    """``jax.tree_util`` key path -> a "/"-joined name regexes match on."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Map every leaf of ``tree`` to the PartitionSpec of the first rule
+    whose regex matches its "/"-joined key path (the
+    ``match_partition_rules`` pattern of SNIPPETS.md [1]).  Leaf VALUES are
+    ignored — only the tree structure and key names matter — so callers
+    hand in a cheap name-shaped skeleton, not real arrays.  An unmatched
+    leaf raises: a silently-replicated buffer is exactly the HBM-wall bug
+    this table exists to prevent."""
+    def spec_for(path, _leaf):
+        name = _leaf_path_str(path)
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches leaf {name!r}")
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def suggest_partition_rules(shard_history=False, axes=None):
+    """The rule table for the fused tell+ask program (and the generation
+    fold): leaf path regex → PartitionSpec.
+
+    * the candidate/proposal batch axis (``ids``, ``packed``, diagnostics)
+      ALWAYS shards over the mesh ``axes`` (default :data:`CAND_AXIS` —
+      the 1-D suggest mesh; the driver's 2-D global mesh passes both);
+    * every ``PaddedHistory`` leaf (``vals/*``, ``active/*``, ``losses``,
+      ``has_loss``) replicates below :func:`hist_shard_threshold` and
+      shards its capacity axis above it;
+    * scalar-ish side inputs (``rows``, ``seed_words``, fold row buffers)
+      replicate — they are O(batch), not O(cap).
+    """
+    axes = (CAND_AXIS,) if axes is None else tuple(axes)
+    batch = P(axes)
+    hist = P(axes) if shard_history else P()
+    return (
+        (r"^hist/(vals|active)/", hist),
+        (r"^hist/(losses|has_loss)$", hist),
+        (r"^(rows|seed_words)$", P()),
+        (r"^(vals_rows|active_rows|fold_losses|fold_has|fold_idx)$", P()),
+        (r"^ids$", batch),
+        (r"^(packed|stats|splits)$", batch),
+    )
+
+
+def _hist_skeleton(labels):
+    """Name-shaped skeleton of the padded-history pytree (leaf values are
+    placeholders; only paths matter to the rule table)."""
+    return {
+        "losses": 0, "has_loss": 0,
+        "vals": {l: 0 for l in labels},
+        "active": {l: 0 for l in labels},
+    }
+
+
+def suggest_shardings(mesh, labels, shard_history=False, diag=False):
+    """``(in_shardings, out_shardings)`` for the fused tell+ask program
+    ``run(history, rows, seed_words, ids) -> (history', packed[, stats,
+    splits])``, built from :func:`suggest_partition_rules` via
+    :func:`match_partition_rules`."""
+    rules = suggest_partition_rules(shard_history)
+    hist = _hist_skeleton(labels)
+    in_tree = {"hist": hist, "rows": 0, "seed_words": 0, "ids": 0}
+    out_tree = {"hist": hist, "packed": 0}
+    if diag:
+        out_tree.update(stats=0, splits=0)
+    in_specs = match_partition_rules(rules, in_tree)
+    out_specs = match_partition_rules(rules, out_tree)
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    in_sh = (jax.tree.map(ns, in_specs["hist"]), ns(in_specs["rows"]),
+             ns(in_specs["seed_words"]), ns(in_specs["ids"]))
+    outs = [jax.tree.map(ns, out_specs["hist"]), ns(out_specs["packed"])]
+    if diag:
+        outs += [ns(out_specs["stats"]), ns(out_specs["splits"])]
+    return in_sh, tuple(outs)
+
+
+def shard_map_suggest_fallback(run, mesh, diag=False):
+    """``shard_map`` expression of the fused tell+ask program for jax
+    builds whose ``jit`` lacks ``in_shardings`` (SNIPPETS.md [3]: prefer
+    pjit with explicit shardings, fall back to map-style ``shard_map``).
+    History and rows replicate; the batch axis (``ids``/outputs) maps over
+    :data:`CAND_AXIS`.  Every shard applies the same row fold, so the
+    replicated history output is shard-invariant by construction
+    (``check_rep=False``: the scatter's replication is not provable to the
+    rep checker)."""
+    out_specs = (P(), P(CAND_AXIS))
+    if diag:
+        out_specs = out_specs + (P(CAND_AXIS), P(CAND_AXIS))
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(), P(), P(), P(CAND_AXIS)),
+                     out_specs=out_specs, check_rep=False)
+
+
+def hist_shard_threshold():
+    """Capacity at which the history axis starts sharding (env-tunable:
+    ``HYPEROPT_TPU_HIST_SHARD_MIN``)."""
+    from .._env import parse_hist_shard_min
+
+    return parse_hist_shard_min()
+
+
+def should_shard_history(cap, mesh):
+    """True when ``cap`` crosses the per-chip threshold AND divides the
+    mesh evenly (power-of-two caps over power-of-two meshes always do)."""
+    n = int(np.prod(list(mesh.shape.values())))
+    return n > 1 and cap >= hist_shard_threshold() and cap % n == 0
+
+
+# ---------------------------------------------------------------------------
+# history placement + the donated generation fold
+# ---------------------------------------------------------------------------
+
+
+def build_history_fold(labels, mesh=None, shard_history=False):
     """One DONATED device program scattering a generation's rows into the
-    replicated history pytree **in place**:
+    history pytree **in place**:
 
         fold(hist, vals_rows[W, L], active_rows[W, L], losses[W], has[W],
              idx[W]) -> hist'
@@ -59,18 +218,28 @@ def build_history_fold(labels):
     the batch width.  Callers must thread the RETURNED pytree forward —
     the donated argument is invalid after dispatch (same contract as
     ``PaddedHistory.device_state(donate=True)``).
+
+    With ``mesh`` the fold compiles with explicit ``NamedSharding``s from
+    the partition-rule table: the scatter lands directly in the SHARDED
+    layout (``shard_history=True``) or the mesh-replicated one — never via
+    an intermediate replicated copy of the cap-sized pytree.
     """
     labels = tuple(labels)
-    fn = _fold_cache.get(labels)
+    geom = (None if mesh is None else
+            (tuple(mesh.shape.items()), tuple(d.id for d in mesh.devices.flat)))
+    key = (labels, geom, bool(shard_history))
+    fn = _fold_cache.get(key)
     if fn is None:
 
         def fold(hist, vals_rows, active_rows, losses, has, idx):
             return {
-                "losses": hist["losses"].at[idx].set(losses, mode="drop"),
+                "losses": hist["losses"].at[idx].set(
+                    losses.astype(hist["losses"].dtype), mode="drop"),
                 "has_loss": hist["has_loss"].at[idx].set(has, mode="drop"),
                 "vals": {
-                    l: hist["vals"][l].at[idx].set(vals_rows[:, j],
-                                                   mode="drop")
+                    l: hist["vals"][l].at[idx].set(
+                        vals_rows[:, j].astype(hist["vals"][l].dtype),
+                        mode="drop")
                     for j, l in enumerate(labels)
                 },
                 "active": {
@@ -80,7 +249,27 @@ def build_history_fold(labels):
                 },
             }
 
-        fn = _fold_cache[labels] = jax.jit(fold, donate_argnums=(0,))
+        if mesh is None:
+            fn = jax.jit(fold, donate_argnums=(0,))
+        else:
+            rules = suggest_partition_rules(shard_history,
+                                            axes=mesh.axis_names)
+            tree = {"hist": _hist_skeleton(labels), "vals_rows": 0,
+                    "active_rows": 0, "fold_losses": 0, "fold_has": 0,
+                    "fold_idx": 0}
+            specs = match_partition_rules(rules, tree)
+            ns = lambda s: NamedSharding(mesh, s)  # noqa: E731
+            hist_sh = jax.tree.map(ns, specs["hist"])
+            fn = jax.jit(
+                fold,
+                in_shardings=(hist_sh, ns(specs["vals_rows"]),
+                              ns(specs["active_rows"]),
+                              ns(specs["fold_losses"]),
+                              ns(specs["fold_has"]), ns(specs["fold_idx"])),
+                out_shardings=hist_sh,
+                donate_argnums=(0,),
+            )
+        _fold_cache[key] = fn
     return fn
 
 
@@ -98,20 +287,60 @@ def make_mesh(n_devices=None, n_cand_shards=1):
     return Mesh(arr, (TRIALS_AXIS, CAND_AXIS))
 
 
+# geometry -> 1-D suggest mesh (meshes hash by device objects; cache keeps
+# the fused program's jit cache key stable across asks)
+_suggest_mesh_cache = {}
+
+
+def suggest_mesh(n_devices=None):
+    """A flat 1-D ``(cand,)`` mesh over the first ``n_devices`` local
+    devices — the mesh the FUSED tell+ask program shards over (its one
+    batch axis is the candidate/proposal batch).  ``n_devices=None`` or
+    ``-1`` means all local devices; cached per geometry."""
+    devs = jax.devices()
+    n = len(devs) if n_devices in (None, -1) else min(int(n_devices),
+                                                      len(devs))
+    key = tuple(d.id for d in devs[:n])
+    m = _suggest_mesh_cache.get(key)
+    if m is None:
+        m = _suggest_mesh_cache[key] = Mesh(np.array(devs[:n]), (CAND_AXIS,))
+    return m
+
+
+def place_history(history, mesh, shard_history=False, dtype=None):
+    """Place the padded-history pytree on ``mesh`` per the partition-rule
+    table: replicated by default, capacity-axis sharded with
+    ``shard_history=True``.  ``dtype`` (a jnp float dtype) compresses the
+    float leaves (``vals``, ``losses``) to the storage dtype on the way —
+    the bf16 resident-history path; bool masks stay bool."""
+    rules = suggest_partition_rules(shard_history, axes=mesh.axis_names)
+    specs = match_partition_rules(rules, {"hist": _hist_skeleton(
+        list(history["vals"]))})["hist"]
+
+    def put(x, spec):
+        x = jnp.asarray(x)
+        if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(dtype)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, dict(history), specs)
+
+
 def replicate_history(history, mesh):
     """Place the padded-history pytree fully replicated on the mesh."""
-    rep = NamedSharding(mesh, P())
-    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), rep), history)
+    return place_history(history, mesh, shard_history=False)
 
 
-def suggest_batch_sharded(cs, cfg, mesh, packed=False):
+def suggest_batch_sharded(cs, cfg, mesh, packed=False, shard_history=False):
     """Data-parallel batched proposal: keys sharded over every mesh device,
-    history replicated.  Returns ``fn(history, keys) -> {label: [batch]}``
-    — or, with ``packed=True``, ``-> [batch, L]`` (``rand.pack_labels``
-    order), the one-buffer form: a multi-controller driver can then
-    exchange a whole generation with a SINGLE cross-host collective instead
-    of one per label (collective launch latency dominates [batch]-sized
-    transfers over DCN).
+    history replicated — or capacity-axis sharded with
+    ``shard_history=True`` (per-chip HBM then holds ``cap / n_devices``
+    rows; XLA inserts the gathers the Parzen fit needs).  Returns
+    ``fn(history, keys) -> {label: [batch]}`` — or, with ``packed=True``,
+    ``-> [batch, L]`` (``rand.pack_labels`` order), the one-buffer form: a
+    multi-controller driver can then exchange a whole generation with a
+    SINGLE cross-host collective instead of one per label (collective
+    launch latency dominates [batch]-sized transfers over DCN).
 
     Mathematically identical to the unsharded ``vmap`` (each proposal is
     independent), so results match a single-device run bitwise — the dryrun
@@ -121,12 +350,9 @@ def suggest_batch_sharded(cs, cfg, mesh, packed=False):
 
     propose = jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0))
     key_sharding = NamedSharding(mesh, P((TRIALS_AXIS, CAND_AXIS)))
-    rep = NamedSharding(mesh, P())
-    hist_shardings = jax.tree.map(lambda _: rep, {
-        "losses": 0, "has_loss": 0,
-        "vals": {l: 0 for l in cs.labels},
-        "active": {l: 0 for l in cs.labels},
-    })
+    hist_spec = (P((TRIALS_AXIS, CAND_AXIS)) if shard_history else P())
+    rep = NamedSharding(mesh, hist_spec)
+    hist_shardings = jax.tree.map(lambda _: rep, _hist_skeleton(cs.labels))
     if packed:
         fn = lambda h, k: rand.pack_labels(cs, propose(h, k))  # noqa: E731
         out_sharding = key_sharding  # [batch, L]: batch axis sharded
@@ -140,51 +366,141 @@ def suggest_batch_sharded(cs, cfg, mesh, packed=False):
     )
 
 
-def propose_sharded_candidates(cs, cfg, mesh, packed=False):
-    """One proposal with the candidate axis sharded over ``mesh``'s ``cand``
-    axis via ``shard_map``.  ``packed=True`` returns a ``[1, L]`` buffer
-    (``rand.pack_labels`` order) so the host fetches ONE transfer instead
-    of one per label.
+def propose_sharded_candidates(cs, cfg, mesh, packed=False, batch=None,
+                               topk=4):
+    """Proposals with the CANDIDATE axis sharded over ``mesh``'s ``cand``
+    axis via ``shard_map``.  ``batch=None`` keeps the legacy one-proposal
+    signature ``fn(history, key) -> {label: scalar}`` (``[1, L]`` packed);
+    ``batch=B`` returns ``fn(history, keys[B]) -> {label: [B]}``
+    (``[B, L]`` packed) — a full sharded batch of proposals, each scored
+    over the whole distributed candidate pool.
 
-    Each device fits the same below/above Parzen models (history replicated),
-    draws ``n_EI_candidates / n_shards`` candidates with a device-folded key,
-    EI-scores them locally, and contributes its (best EI, best value) to an
-    ``all_gather``; the global argmax picks the winner.  Scales
-    ``n_EI_candidates`` past single-chip memory/latency limits (the
-    sequence-parallel analog for HPO: SURVEY.md §2.2 last row).
+    Each device fits the same below/above Parzen models, draws
+    ``ceil(n_EI_candidates / n_shards)`` candidates with a device-folded
+    key, EI-scores them locally, and contributes its top-``k`` (EI, value)
+    pairs to an ``all_gather``; the final select over the gathered
+    ``n_shards * k`` pool follows ``cfg["ei_select"]`` — hard argmax
+    (exactly the global argmax: the winner is necessarily some shard's
+    local top-1) or Gumbel-max softmax over the pooled top candidates (the
+    batch-diversity policy of ``tpe._select_candidate``, here restricted
+    to the gathered pool).  Scales ``n_EI_candidates`` past single-chip
+    memory/latency limits (the sequence-parallel analog for HPO:
+    SURVEY.md §2.2 last row).
+
+    ``n_EI_candidates`` need NOT divide the shard count: the local batch
+    pads up to the next multiple and padded candidates score ``-inf`` EI,
+    so they never win (ISSUE 6 satellite — this used to raise
+    ``ValueError``).
     """
+    from ..spaces import label_hash
+
     n_shards = mesh.shape[CAND_AXIS]
-    n_cand = cfg["n_EI_candidates"]
-    if n_cand % n_shards:
-        raise ValueError(f"n_EI_candidates={n_cand} not divisible by {n_shards} shards")
-    local_cfg = dict(cfg, n_EI_candidates=n_cand // n_shards)
-    scored = tpe.build_propose_with_scores(cs, local_cfg)
+    n_cand = int(cfg["n_EI_candidates"])
+    n_local = -(-n_cand // n_shards)  # ceil: pad instead of erroring
+    k = int(min(topk, n_local))
+    local_cfg = dict(cfg, n_EI_candidates=n_local)
+    scored = tpe.build_propose_candidates(cs, local_cfg)
+    single = batch is None
+    B = 1 if single else int(batch)
+    neg_inf = jnp.float32(-jnp.inf)
 
-    def local_best(history, key):
-        """Per-device: local candidates + local EI max (runs inside shard_map).
-        Reuses the shared scored-proposal kernel (incl. its grouped uniform
-        pipeline) with a shard-folded key — the only sharding-specific code
-        is the fold and the [1]-shaped packaging for the all-gather."""
+    # per-label prior draws for the ε-prior mix (the same exploration
+    # floor _mix_prior gives the single-chip kernels: with prob prior_eps
+    # a proposal is replaced by a fresh search-space draw, so the batch
+    # never collapses onto posterior modes once the posterior sharpens)
+    eps = float(cfg.get("prior_eps", 0.0))
+    prior_draws = {}
+    for _l in cs.labels:
+        _dist = cs.params[_l].dist
+        if _dist.family in ("categorical", "randint"):
+            _pp = jnp.asarray(tpe._prior_probs(_dist))
+            _off = (int(_dist.params[0]) if _dist.family == "randint" else 0)
+            prior_draws[_l] = (
+                lambda kp, pp=_pp, off=_off:
+                (tpe._prior_draw_discrete(kp, pp) + off).astype(jnp.float32))
+        else:
+            _parz = tpe._parzen_from(_dist)
+            prior_draws[_l] = (
+                lambda kp, parz=_parz: tpe._prior_draw_numeric(kp, *parz))
+
+    def local_topk(history, keys):
+        """Per-device: local candidates + local top-k (runs inside
+        shard_map).  Reuses the shared raw-candidate kernel with a
+        shard-folded key; candidates whose GLOBAL index falls past
+        ``n_EI_candidates`` are padding — their EI masks to -inf before
+        the top-k so the pad never wins."""
         shard = jax.lax.axis_index(CAND_AXIS)
-        key = jax.random.fold_in(key, shard)
-        out = scored(history, key)
-        best_ei = {l: ei[None] for l, (_, ei) in out.items()}
-        best_val = {l: val[None] for l, (val, _) in out.items()}
-        return best_ei, best_val
+        valid = (shard * n_local + jnp.arange(n_local)) < n_cand
 
-    def propose(history, key):
-        ei_g, val_g = jax.shard_map(
-            local_best,
+        def one(key):
+            out = scored(history, jax.random.fold_in(key, shard))
+            ei_k, val_k = {}, {}
+            for l, (samples, ei) in out.items():
+                ei = jnp.where(valid, ei, neg_inf)
+                top_ei, top_i = jax.lax.top_k(ei, k)
+                onehot = (top_i[:, None]
+                          == jnp.arange(n_local)[None, :]).astype(jnp.float32)
+                ei_k[l] = top_ei
+                val_k[l] = onehot @ samples.astype(jnp.float32)
+            return ei_k, val_k
+
+        return jax.vmap(one)(keys)  # {label: [B, k]} pairs
+
+    # batched mode shards the PROPOSAL axis over the mesh's trials axis
+    # too (each trials-group handles its B / n_trial_shards slice) —
+    # replicating the whole batch across trials-groups would redo the same
+    # proposals n_trial_shards times over.  The caller pads B to a
+    # multiple of the full device count, which divides by construction.
+    n_trial_shards = dict(mesh.shape).get(TRIALS_AXIS, 1)
+    if not single and B % max(n_trial_shards, 1):
+        raise ValueError(
+            f"batch={B} not divisible by the mesh's {n_trial_shards} "
+            f"trial shards (pad with rand.pad_ids_to_multiple)")
+    batch_spec = (P(TRIALS_AXIS)
+                  if (not single and n_trial_shards > 1) else P())
+    out_row = batch_spec[0] if len(batch_spec) else None
+
+    def propose(history, keys):
+        if single:
+            keys = keys[None]
+        ei_g, val_g = shard_map(
+            local_topk,
             mesh=mesh,
-            in_specs=(P(), P()),
-            out_specs=(P(CAND_AXIS), P(CAND_AXIS)),
-        )(history, key)
-        # ei_g/val_g: [n_shards] per label; global argmax over shards
-        out = {l: val_g[l][jnp.argmax(ei_g[l])] for l in cs.labels}
+            in_specs=(P(), batch_spec),
+            out_specs=(P(out_row, CAND_AXIS), P(out_row, CAND_AXIS)),
+        )(history, keys)
+        # ei_g/val_g: [B, n_shards * k] per label; global select per
+        # proposal over the pooled shard top-k.  Keys fold per label
+        # (label_hash, the single-chip kernels' contract) so softmax
+        # Gumbel noise stays independent across labels, and the ε-prior
+        # mix reuses _mix_prior's fold constants (0x9B10B draw, 0xE9510
+        # gate) so the exploration floor matches the unsharded policy.
+        def select(key, ei_b, val_b):
+            out_b = {}
+            for l in cs.labels:
+                k_l = jax.random.fold_in(key, label_hash(l))
+                v = tpe._select_candidate(k_l, val_b[l], ei_b[l], cfg)[0]
+                if eps > 0.0:
+                    xp = prior_draws[l](jax.random.fold_in(k_l, 0x9B10B))
+                    take = jax.random.uniform(
+                        jax.random.fold_in(k_l, 0xE9510), ()) < eps
+                    v = jnp.where(take, jnp.asarray(xp, v.dtype), v)
+                out_b[l] = v
+            return out_b
+
+        out = jax.vmap(select)(keys, ei_g, val_g)
+        if single:
+            out = {l: out[l][0] for l in cs.labels}
+            if packed:
+                from ..algos import rand
+
+                return rand.pack_labels(cs, {l: out[l][None]
+                                             for l in cs.labels})
+            return out
         if packed:
             from ..algos import rand
 
-            return rand.pack_labels(cs, {l: out[l][None] for l in cs.labels})
+            return rand.pack_labels(cs, out)
         return out
 
     return jax.jit(propose)

@@ -16,6 +16,10 @@
 # server on a short real fmin, scrapes /metrics + /snapshot MID-RUN and
 # validates the exposition-format / snapshot-shape invariants
 # (scripts/validate_scrape.py --self-test).
+# Opt-in shard gate: SHARD_GATE=1 additionally runs the forced-8-device
+# sharded-equivalence suite (mesh shapes {1,2,4,8} bit-identical to
+# single-chip, replicated AND capacity-sharded history) plus the scaling
+# smoke (scripts/shard_smoke.py).
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -26,10 +30,18 @@ if [ "${TRACE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --self-test || exit 1
 fi
 if [ "${DONATION_GATE:-0}" = "1" ]; then
+    # tests/test_shard_suggest.py -k donation pins the SHARDED path too:
+    # per-shard buffer pointers stable across ticks, stale-handle guard
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DONATION_GATE=1 \
-        python -m pytest tests/test_pipeline.py -q -k donation || exit 1
+        python -m pytest tests/test_pipeline.py tests/test_shard_suggest.py \
+        -q -k donation || exit 1
 fi
 if [ "${SERVE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_scrape.py --self-test || exit 1
+fi
+if [ "${SHARD_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_sharding.py tests/test_shard_suggest.py -q || exit 1
+    python scripts/shard_smoke.py || exit 1
 fi
 exit 0

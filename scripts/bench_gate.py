@@ -47,6 +47,10 @@ DEFAULT_THRESHOLDS = {
     "trials_per_sec": 0.20,
     "candidates_per_sec": 0.20,
     "cv_fits_per_sec": 0.20,
+    # the sharded fused tell+ask (bench.py sharded_suggest stage): one
+    # occurrence per shard count {1,2,4,8}, compared positionally; a
+    # regression here means the mesh path stopped scaling
+    "sharded_cand_per_sec": 0.20,
     # per-ask wall latency (bench.py ask_latency stage): shared contended
     # hardware makes tails noisy — p50 gates tightest, p99 loosest
     "ask_p50_ms": 0.35,
@@ -60,6 +64,7 @@ DEFAULT_THRESHOLDS = {
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
+                 "sharded_cand_per_sec",
                  "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                  "peak_hbm_bytes", "history_bytes")
 

@@ -1,0 +1,118 @@
+"""SHARD_GATE smoke: forced-8-device sharded-equivalence + scaling check.
+
+Run via ``SHARD_GATE=1 ./run_tests.sh`` (or directly).  Re-executes itself
+in a clean subprocess pinned to 8 virtual CPU devices (the ambient env may
+carry a TPU-tunnel plugin whose broken backend-init hangs uncatchably —
+``hyperopt_tpu._env.forced_cpu_env``), then checks, end to end through the
+public ``tpe.suggest`` path:
+
+1. **Equivalence pin** — at the same seed, mesh-sharded proposals are
+   BIT-IDENTICAL to the single-chip program for mesh shapes {1, 2, 4, 8},
+   with the history axis both replicated and force-sharded
+   (``HYPEROPT_TPU_HIST_SHARD_MIN`` driven below cap).
+2. **Scaling smoke** — the 8-shard fused program completes a wide
+   candidate batch and its measured candidates/sec is printed (shape, not
+   absolute perf: CPU mesh).
+
+Exit 0 on success; any mismatch prints the differing proposals and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def _child():
+    import time
+
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import rand, tpe
+    from hyperopt_tpu.base import Domain
+
+    space = {"x": hp.uniform("x", -5, 5),
+             "lr": hp.loguniform("lr", -4, 0),
+             "k": hp.randint("k", 4)}
+
+    def obj(d):
+        return (d["x"] - 1.0) ** 2 + d["lr"]
+
+    def populated(n=10):
+        t = Trials()
+        fmin(obj, space, algo=rand.suggest, max_evals=n, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        return t
+
+    def proposals(n_ids=8):
+        t = populated()
+        dom = Domain(obj, space)
+        docs = tpe.suggest(t.new_trial_ids(n_ids), dom, t, 42,
+                           n_startup_jobs=5, n_EI_candidates=64)
+        return [d["misc"]["vals"] for d in docs]
+
+    os.environ.pop("HYPEROPT_TPU_SHARD", None)
+    ref = proposals()
+    failures = 0
+    for shards in (1, 2, 4, 8):
+        for hist_min in (None, "128"):  # replicated / force-sharded history
+            os.environ["HYPEROPT_TPU_SHARD"] = str(shards)
+            if hist_min is None:
+                os.environ.pop("HYPEROPT_TPU_HIST_SHARD_MIN", None)
+            else:
+                os.environ["HYPEROPT_TPU_HIST_SHARD_MIN"] = hist_min
+            got = proposals()
+            tag = (f"shards={shards} "
+                   f"hist={'sharded' if hist_min else 'replicated'}")
+            if got == ref:
+                print(f"  OK  {tag}: bit-identical to single-chip")
+            else:
+                failures += 1
+                print(f"  FAIL {tag}: proposals diverged\n"
+                      f"    ref {ref[0]}\n    got {got[0]}")
+    os.environ.pop("HYPEROPT_TPU_HIST_SHARD_MIN", None)
+
+    # scaling smoke: a wide sharded candidate batch completes and reports
+    os.environ["HYPEROPT_TPU_SHARD"] = "8"
+    t = populated()
+    dom = Domain(obj, space)
+    B, n_cand = 64, 256
+    tpe.suggest(t.new_trial_ids(B), dom, t, 1, n_startup_jobs=5,
+                n_EI_candidates=n_cand, ei_select="softmax")  # compile
+    t0 = time.perf_counter()
+    tpe.suggest(t.new_trial_ids(B), dom, t, 2, n_startup_jobs=5,
+                n_EI_candidates=n_cand, ei_select="softmax")
+    dt = time.perf_counter() - t0
+    print(json.dumps({"smoke": "sharded_suggest", "shards": 8, "batch": B,
+                      "n_EI_candidates": n_cand,
+                      "sharded_cand_per_sec": B * n_cand / dt}))
+    if failures:
+        print(f"shard smoke: {failures} equivalence failure(s)")
+        return 1
+    print("shard smoke: ok")
+    return 0
+
+
+def main():
+    if os.environ.get("_SHARD_SMOKE_CHILD") == "1":
+        return _child()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from hyperopt_tpu._env import forced_cpu_env
+
+    env = forced_cpu_env(os.environ, n_devices=8)
+    env["_SHARD_SMOKE_CHILD"] = "1"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, timeout=900,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,350 @@
+"""ISSUE 6: mesh-sharded fused suggest + compressed device history.
+
+The equivalence doctrine: sharding is a LAYOUT change, not an algorithm
+change — at the same seed the sharded fused tell+ask program must propose
+bit-identically to the single-chip one, for every mesh shape and for both
+history layouts (replicated and capacity-sharded).  bf16 history is a
+STORAGE change with an f32 accumulation contract: proposals may differ
+from the f32 run (values quantize) but must be deterministic and
+round-trip pickle/resume bitwise against an uninterrupted bf16 run.
+"""
+
+import functools
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu._env import (parse_hist_dtype, parse_hist_shard_min,
+                               parse_pallas, parse_shard)
+from hyperopt_tpu.algos import rand, tpe
+from hyperopt_tpu.base import Domain, PaddedHistory
+from hyperopt_tpu.exceptions import StaleHistoryError
+from hyperopt_tpu.fmin import FMinIter
+from hyperopt_tpu.parallel import sharding
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -4, 0),
+    "k": hp.randint("k", 4),
+}
+
+
+def obj(d):
+    return (d["x"] - 1.0) ** 2 + d["lr"]
+
+
+def _populated(n=10):
+    t = Trials()
+    fmin(obj, SPACE, algo=rand.suggest, max_evals=n, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    return t
+
+
+def _proposals(n_ids=8, seed=42):
+    t = _populated()
+    dom = Domain(obj, SPACE)
+    docs = tpe.suggest(t.new_trial_ids(n_ids), dom, t, seed,
+                       n_startup_jobs=5, n_EI_candidates=64)
+    return [d["misc"]["vals"] for d in docs]
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+
+def test_env_knob_parsing():
+    assert parse_shard({}) is None
+    assert parse_shard({"HYPEROPT_TPU_SHARD": "0"}) is None
+    assert parse_shard({"HYPEROPT_TPU_SHARD": "auto"}) == -1
+    assert parse_shard({"HYPEROPT_TPU_SHARD": "4"}) == 4
+    assert parse_shard({"HYPEROPT_TPU_SHARD": "1"}) == 1
+    assert parse_shard({"HYPEROPT_TPU_SHARD": "soon"}) is None  # warn+off
+    assert parse_hist_dtype({}) == "float32"
+    assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "bf16"}) == "bfloat16"
+    assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "f64"}) == "float32"
+    assert parse_hist_shard_min({}) == 65536
+    assert parse_hist_shard_min({"HYPEROPT_TPU_HIST_SHARD_MIN": "128"}) == 128
+    assert parse_pallas({}) is False
+    assert parse_pallas({"HYPEROPT_TPU_PALLAS": "1"}) is True
+
+
+# ---------------------------------------------------------------------------
+# partition-rule table
+# ---------------------------------------------------------------------------
+
+
+def test_match_partition_rules_maps_history_leaves():
+    from jax.sharding import PartitionSpec as P
+
+    rules = sharding.suggest_partition_rules(shard_history=True)
+    tree = {"hist": {"losses": 0, "has_loss": 0,
+                     "vals": {"x": 0}, "active": {"x": 0}},
+            "ids": 0, "rows": 0, "seed_words": 0, "packed": 0}
+    specs = sharding.match_partition_rules(rules, tree)
+    assert specs["hist"]["losses"] == P((sharding.CAND_AXIS,))
+    assert specs["hist"]["vals"]["x"] == P((sharding.CAND_AXIS,))
+    assert specs["ids"] == P((sharding.CAND_AXIS,))
+    assert specs["rows"] == P()
+    # replicated history below the threshold
+    rules_rep = sharding.suggest_partition_rules(shard_history=False)
+    specs_rep = sharding.match_partition_rules(rules_rep, tree)
+    assert specs_rep["hist"]["losses"] == P()
+    assert specs_rep["ids"] == P((sharding.CAND_AXIS,))
+
+
+def test_match_partition_rules_unmatched_leaf_raises():
+    with pytest.raises(ValueError, match="no partition rule"):
+        sharding.match_partition_rules(
+            sharding.suggest_partition_rules(), {"mystery_leaf": 0})
+
+
+def test_should_shard_history_threshold(monkeypatch):
+    mesh = sharding.suggest_mesh(8)
+    assert not sharding.should_shard_history(128, mesh)  # below default
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_SHARD_MIN", "128")
+    assert sharding.should_shard_history(128, mesh)
+    assert not sharding.should_shard_history(127, mesh)  # indivisible
+
+
+# ---------------------------------------------------------------------------
+# the equivalence pin: sharded == single-chip, bitwise, mesh {1, 2, 4, 8}
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_suggest_bit_identical_across_mesh_shapes(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_SHARD", raising=False)
+    ref = _proposals()
+    for shards in (1, 2, 4, 8):
+        monkeypatch.setenv("HYPEROPT_TPU_SHARD", str(shards))
+        assert _proposals() == ref, f"mesh shape {shards} diverged"
+
+
+def test_sharded_suggest_bit_identical_with_sharded_history(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_SHARD", raising=False)
+    ref = _proposals()
+    # force the history axis to shard (cap=128 >> threshold=128)
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_SHARD_MIN", "128")
+    for shards in (2, 8):
+        monkeypatch.setenv("HYPEROPT_TPU_SHARD", str(shards))
+        t = _populated()
+        dom = Domain(obj, SPACE)
+        docs = tpe.suggest(t.new_trial_ids(8), dom, t, 42,
+                           n_startup_jobs=5, n_EI_candidates=64)
+        assert [d["misc"]["vals"] for d in docs] == ref
+        # the resident layout really is capacity-sharded
+        ph = t.history_object(dom.cs.labels)
+        shard_shape = ph._dev["losses"].addressable_shards[0].data.shape
+        assert shard_shape == (ph.cap // shards,)
+
+
+def test_sharded_donation_in_place_and_stale_guard(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "8")
+    t = _populated()
+    dom = Domain(obj, SPACE)
+    ph = t.history_object(dom.cs.labels)
+    # two asks: the first places the mirror in the mesh layout, the second
+    # commits a mesh-resident handle whose buffers steady-state donation
+    # then reuses in place
+    tpe.suggest(t.new_trial_ids(1), dom, t, 17, n_startup_jobs=5)
+    tpe.suggest(t.new_trial_ids(1), dom, t, 18, n_startup_jobs=5)
+    old = ph._dev
+
+    def shard_ptrs(a):
+        return tuple(s.data.unsafe_buffer_pointer()
+                     for s in a.addressable_shards)
+
+    ptrs = shard_ptrs(old["losses"])
+    tpe.suggest(t.new_trial_ids(1), dom, t, 19, n_startup_jobs=5)
+    assert old["losses"].is_deleted()  # donated (consumed), not copied
+    assert shard_ptrs(ph._dev["losses"]) == ptrs  # aliased in place
+    assert len(ph._dev["losses"].sharding.device_set) == 8
+
+    # StaleHistoryError still guards the sharded donated path
+    dev, rows = ph.device_state(donate=True)
+    with pytest.raises(StaleHistoryError, match="donated"):
+        ph.device_view()
+    ph.commit_device(dev)
+
+
+def test_sharded_suggest_gauges(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "4")
+    t = Trials()
+    fmin(obj, SPACE, algo=functools.partial(tpe.suggest, n_startup_jobs=6),
+         max_evals=12, trials=t, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    snap = t.obs_metrics.snapshot()["metrics"]
+    assert snap.get("suggest.shards") == 4
+    assert snap.get("suggest.cand_per_shard", 0) > 0
+    assert snap.get("suggest.hist_sharded") == 0
+
+
+def test_indivisible_batch_pads_to_mesh(monkeypatch):
+    # 8-wide mesh, 3 queued ids: the batch pads to a mesh multiple instead
+    # of aborting, extras are discarded on host
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "8")
+    t = _populated()
+    dom = Domain(obj, SPACE)
+    docs = tpe.suggest(t.new_trial_ids(3), dom, t, 7, n_startup_jobs=5)
+    assert len(docs) == 3
+
+
+# ---------------------------------------------------------------------------
+# bf16 compressed history
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_history_halves_resident_bytes(monkeypatch):
+    labels = ("a", "b")
+
+    def resident_bytes(dtype):
+        ph = PaddedHistory(labels, hist_dtype=dtype)
+        for i in range(20):
+            ph.append({l: float(i) for l in labels}, float(i))
+        dev = ph.device_view()
+        return sum(dev["vals"][l].nbytes for l in labels) + dev["losses"].nbytes
+
+    assert resident_bytes("float32") == 2 * resident_bytes("bfloat16")
+
+
+def test_bf16_history_deterministic_and_valid(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", "bf16")
+    a, b = _proposals(seed=9), _proposals(seed=9)
+    assert a == b
+    for v in a:
+        assert -5 <= v["x"][0] <= 5
+        assert np.exp(-4) - 1e-5 <= v["lr"][0] <= 1 + 1e-5
+        assert v["k"][0] in range(4)
+
+
+def test_bf16_pickle_midrun_resumes_bitwise(monkeypatch):
+    # the round-trip pin: pickling Trials mid-run with the compressed
+    # mirror live and resuming must reproduce the uninterrupted bf16 run
+    # (host numpy stays f32 authoritative; the dtype travels in the pickle)
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", "bf16")
+    algo = functools.partial(tpe.suggest, n_startup_jobs=6)
+
+    def make_iter(trials, rng):
+        return FMinIter(algo, Domain(obj, SPACE), trials, rstate=rng,
+                        max_evals=20, show_progressbar=False)
+
+    t_full = Trials()
+    make_iter(t_full, np.random.default_rng(3)).run(20)
+
+    rng = np.random.default_rng(3)
+    t_a = Trials()
+    make_iter(t_a, rng).run(12)
+    labels = Domain(obj, SPACE).cs.labels
+    ph = t_a.history_object(labels)
+    assert ph._dev is not None and ph._dev["losses"].dtype == jnp.bfloat16
+    t_b = pickle.loads(pickle.dumps(t_a))
+    assert t_b._history is None  # device state never traveled
+    make_iter(t_b, rng).run(8)
+    assert [d["misc"]["vals"] for d in t_b.trials] == \
+        [d["misc"]["vals"] for d in t_full.trials]
+    np.testing.assert_array_equal(t_b.losses(), t_full.losses())
+    # host arrays (the pickle payload) stayed f32
+    assert t_b.history_object(labels)._losses.dtype == np.float32
+
+
+def test_bf16_checkpoint_resume_multihost_single(tmp_path, monkeypatch):
+    # driver checkpoint/resume with the compressed device mirror: the
+    # checkpoint pickles the f32 host fold, so a resumed bf16 run replays
+    # to the same checksum as an uninterrupted one
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", "bf16")
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    f = lambda d: float(dom.objective(d))  # noqa: E731
+    ck = str(tmp_path / "ck.pkl")
+    full = fmin_multihost(f, dom.space, max_evals=24, batch=8, seed=0,
+                          _force_single=True)
+    fmin_multihost(f, dom.space, max_evals=16, batch=8, seed=0,
+                   checkpoint_file=ck, _force_single=True)
+    resumed = fmin_multihost(f, dom.space, max_evals=24, batch=8, seed=0,
+                             checkpoint_file=ck, _force_single=True)
+    assert resumed.checksum == full.checksum
+    np.testing.assert_array_equal(resumed.losses, full.losses)
+
+
+def test_device_loop_chunk_sharded_state(monkeypatch):
+    # the device-loop chunk program compiles with explicit NamedShardings
+    # when armed past the threshold: cap-axis-sharded loop state, the run
+    # still completes and optimizes
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "8")
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_SHARD_MIN", "128")
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    t = Trials()
+    fmin(dom.objective, dom.space, max_evals=40, trials=t, device_loop=True,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(t) == 40
+    assert min(l for l in t.losses() if l is not None) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# pallas EI opt-in
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_optin_matches_default_path(monkeypatch):
+    # CPU: ei_diff falls back to the jnp twin — same math as the default
+    # lpdf difference up to fp reassociation; proposals must agree closely
+    # and be deterministic.  The DEFAULT (flag off) path is byte-untouched:
+    # same kernels as before this round (covered by every other test).
+    monkeypatch.delenv("HYPEROPT_TPU_PALLAS", raising=False)
+    t = _populated()
+    hist = t.history_object(Domain(obj, SPACE).cs.labels).device_view()
+    hist = {k: hist[k] for k in ("losses", "has_loss", "vals", "active")}
+    cs = Domain(obj, SPACE).cs
+    cfg = {"prior_weight": 1.0, "n_EI_candidates": 64, "gamma": 0.25,
+           "LF": 25}
+    key = jax.random.PRNGKey(11)
+    raw_off = tpe.build_propose_candidates(cs, cfg)(hist, key)
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS", "1")
+    raw_on = tpe.build_propose_candidates(cs, cfg)(hist, key)
+    for label in cs.labels:
+        s_off, ei_off = raw_off[label]
+        s_on, ei_on = raw_on[label]
+        np.testing.assert_array_equal(np.asarray(s_off), np.asarray(s_on))
+        fin = np.isfinite(np.asarray(ei_off))
+        np.testing.assert_allclose(np.asarray(ei_on)[fin],
+                                   np.asarray(ei_off)[fin],
+                                   rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# per-shard devmem breakdown
+# ---------------------------------------------------------------------------
+
+
+def test_devmem_per_device_breakdown(monkeypatch):
+    from hyperopt_tpu.obs import ObsConfig, RunObs
+    from hyperopt_tpu.obs.devmem import DevMemSampler
+
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "8")
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_SHARD_MIN", "128")
+    t = _populated()
+    dom = Domain(obj, SPACE)
+    tpe.suggest(t.new_trial_ids(8), dom, t, 3, n_startup_jobs=5)
+    obs = RunObs(ObsConfig(level="basic"), run_id="shard-devmem")
+    sampler = DevMemSampler(obs, period=0.0)
+    rec = sampler.sample(reason="test")
+    obs.finish()
+    assert rec is not None and "per_device" in rec
+    # history bytes are attributed across all 8 devices
+    devs_with_history = [d for d, owners in rec["per_device"].items()
+                         if "history" in owners]
+    assert len(devs_with_history) == 8

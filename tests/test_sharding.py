@@ -96,13 +96,46 @@ def test_propose_sharded_candidates_valid_and_deterministic():
     assert out1["k"] in range(4)
 
 
-def test_propose_sharded_candidates_rejects_indivisible():
+def test_propose_sharded_candidates_pads_indivisible():
+    # ISSUE 6 satellite: a candidate count that does not divide the shard
+    # count used to raise ValueError; now the local batch pads up to the
+    # next multiple and padded candidates' EI masks to -inf (they can
+    # never win), so the call just works
     cs = compile_space(SPACE)
+    hist = _history(cs)
     mesh = sharding.make_mesh(8, n_cand_shards=2)
-    with pytest.raises(ValueError):
-        sharding.propose_sharded_candidates(
-            cs, dict(CFG, n_EI_candidates=63), mesh
-        )
+    hist_dev = sharding.replicate_history(hist, mesh)
+    fn = sharding.propose_sharded_candidates(
+        cs, dict(CFG, n_EI_candidates=63), mesh
+    )
+    out = jax.tree.map(np.asarray, fn(hist_dev, jax.random.PRNGKey(5)))
+    assert -5 <= out["x"] <= 5
+    assert np.exp(-4) - 1e-5 <= out["lr"] <= 1 + 1e-5
+    assert out["k"] in range(4)
+    out2 = jax.tree.map(np.asarray, fn(hist_dev, jax.random.PRNGKey(5)))
+    for label in cs.labels:
+        np.testing.assert_array_equal(out[label], out2[label])
+
+
+def test_propose_sharded_candidates_batched():
+    # the round-6 growth: full sharded BATCHES of proposals (each scored
+    # over the distributed candidate pool), not one winner per dispatch
+    cs = compile_space(SPACE)
+    hist = _history(cs)
+    mesh = sharding.make_mesh(8, n_cand_shards=2)
+    hist_dev = sharding.replicate_history(hist, mesh)
+    fn = sharding.propose_sharded_candidates(
+        cs, dict(CFG, ei_select="softmax"), mesh, packed=True, batch=16
+    )
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(3), i))(
+        jnp.arange(16, dtype=jnp.uint32)
+    )
+    mat = np.asarray(fn(hist_dev, keys))
+    assert mat.shape == (16, len(cs.labels))
+    xj = list(cs.labels).index("x")
+    assert ((mat[:, xj] >= -5) & (mat[:, xj] <= 5)).all()
+    # per-proposal keys: a wide batch must not collapse onto one point
+    assert len(np.unique(mat[:, xj])) > 1
 
 
 def test_graft_entry_single_chip_and_multichip():
@@ -133,6 +166,50 @@ def test_suggest_sharded_fmin_end_to_end():
     assert len(t) == 64
     best = min(l for l in t.losses() if l is not None)
     assert best < 2.0, best
+
+
+def test_propose_sharded_candidates_prior_eps_engages():
+    # review regression pin: the candidate-sharded path must honor
+    # cfg["prior_eps"] (the exploration floor) — with eps=1.0 EVERY
+    # proposal is a fresh prior draw, so a batch cannot collapse onto the
+    # pooled EI winner
+    cs = compile_space(SPACE)
+    hist = _history(cs)
+    mesh = sharding.make_mesh(8, n_cand_shards=2)
+    hist_dev = sharding.replicate_history(hist, mesh)
+    keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.PRNGKey(7), i))(
+        jnp.arange(32, dtype=jnp.uint32)
+    )
+    base = dict(CFG, ei_select="argmax")
+    off = sharding.propose_sharded_candidates(
+        cs, base, mesh, packed=True, batch=32)(hist_dev, keys)
+    on = sharding.propose_sharded_candidates(
+        cs, dict(base, prior_eps=1.0), mesh, packed=True, batch=32)(
+        hist_dev, keys)
+    xj = list(cs.labels).index("x")
+    off_x, on_x = np.asarray(off)[:, xj], np.asarray(on)[:, xj]
+    assert not np.array_equal(off_x, on_x)
+    # eps=1.0 draws spread like the prior instead of stacking on one mode
+    assert len(np.unique(on_x)) == 32
+    assert ((on_x >= -5) & (on_x <= 5)).all()
+
+
+def test_suggest_sharded_batched_candidate_axis_fmin():
+    # queue batches AND n_cand_shards > 1: the round-6 path — every
+    # proposal in the batch scored over the distributed candidate pool
+    import numpy as np
+
+    from hyperopt_tpu import Trials, fmin, hp
+    from hyperopt_tpu.algos import tpe
+
+    t = Trials()
+    algo = tpe.suggest_sharded(n_cand_shards=2, n_startup_jobs=12,
+                               n_EI_candidates=48)
+    fmin(lambda d: (d["x"] - 2.0) ** 2, {"x": hp.uniform("x", -5, 5)},
+         algo=algo, max_evals=36, trials=t, max_queue_len=4,
+         rstate=np.random.default_rng(2), show_progressbar=False)
+    assert len(t) == 36
+    assert min(l for l in t.losses() if l is not None) < 1.0
 
 
 def test_suggest_sharded_candidate_axis_fmin():
