@@ -767,6 +767,7 @@ class Trials:
         device_loop=False,
         obs=None,
         obs_http=None,
+        profile=None,
         lookahead=0,
         compile_cache=None,
     ):
@@ -793,6 +794,7 @@ class Trials:
             device_loop=device_loop,
             obs=obs,
             obs_http=obs_http,
+            profile=profile,
             lookahead=lookahead,
             compile_cache=compile_cache,
         )
@@ -810,6 +812,7 @@ class Trials:
         # open sink) is a per-run handle, not run state: drop it from
         # checkpoints; fmin re-installs one on resume
         state.pop("obs_health", None)
+        state.pop("obs_profiler", None)  # holds the capture lock
         attachments = dict(state.get("attachments", {}))
         dom = attachments.get("FMinIter_Domain")
         if dom is not None and not isinstance(dom, (bytes, bytearray)):

@@ -18,6 +18,7 @@ jitted TPE proposal under ``lax.cond``.
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import numpy as np
@@ -42,6 +43,9 @@ __all__ = ["fmin_device", "DeviceLoopRunner", "objective_is_traceable"]
 # measured "compile" half of the obs split — is shared across runner
 # instances exactly like the program itself.
 _RUN_CACHE = LRUCache(16)
+
+# shared null context for un-annotated dispatches (no per-chunk allocation)
+_nullcontext = contextlib.nullcontext()
 
 # compile/execute split + cache hit rates live in the process-global
 # "device" metrics namespace: the cache itself is process-global, so its
@@ -393,9 +397,16 @@ class DeviceLoopRunner:
         # never reaching "post" is a hung device program / dead readback
         _wd_beat("device.execute", stage="chunk", start=int(start),
                  mark="pre")
+        # device-timeline annotation (obs/profiler.py): a profiler capture
+        # overlapping this dispatch shows the chunk program attributed to
+        # its trial range; disarmed runs get the shared null context
+        ann = (self._obs.annotate("device.chunk", step=int(start),
+                                  start=int(start), limit=int(limit))
+               if self._obs is not None else _nullcontext)
         t0 = time.perf_counter()
-        state, rows = fn(*args)
-        rows = np.asarray(rows)[: limit - start]  # the blocking readback
+        with ann:
+            state, rows = fn(*args)
+            rows = np.asarray(rows)[: limit - start]  # the blocking readback
         _METRICS.histogram("chunk.execute_sec").observe(
             time.perf_counter() - t0)
         _METRICS.counter("chunk.dispatches").inc()

@@ -126,6 +126,7 @@ class FMinIter:
         device_loop=False,
         obs=None,
         obs_http=None,
+        profile=None,
         lookahead=0,
         compile_cache=None,
     ):
@@ -216,19 +217,32 @@ class FMinIter:
         # obs_http=<port|"host:port"> arms the live scrape server on top of
         # whatever the obs config says (0 = ephemeral port; see
         # obs/serve.py — validation happens there, fail-open)
-        if obs_http is not None:
+        if obs_http is not None or profile is not None:
             if isinstance(obs, obs_mod.RunObs):
-                # a pre-built bundle already decided its server config —
-                # rebuilding it here would double-arm; say so instead of
-                # silently dropping the kwarg
+                # a pre-built bundle already decided its server/profiler
+                # config — rebuilding it here would double-arm; say so
+                # instead of silently dropping the kwargs
                 logger.warning(
-                    "obs_http=%r ignored: obs= is a pre-built RunObs "
-                    "(set http_port on its ObsConfig instead)", obs_http)
+                    "obs_http=%r / profile=%r ignored: obs= is a pre-built "
+                    "RunObs (set http_port/profile_dir on its ObsConfig "
+                    "instead)", obs_http, profile)
             else:
                 import dataclasses as _dc
 
+                overrides = {}
+                if obs_http is not None:
+                    overrides["http_port"] = obs_http
+                if profile is not None:
+                    # profile=<dir> arms the bounded-capture plane
+                    # (obs/profiler.py); "full:<dir>" keeps the legacy
+                    # whole-run trace, same grammar as the env var
+                    from .obs.profiler import split_profile_mode
+
+                    cap_dir, full_dir = split_profile_mode(str(profile))
+                    overrides["profile_dir"] = cap_dir
+                    overrides["profile_full"] = full_dir
                 obs = _dc.replace(obs_mod.ObsConfig.resolve(obs),
-                                  http_port=obs_http)
+                                  **overrides)
         self.obs = obs_mod.RunObs.resolve(obs, totals=trials.phase_timings)
         trials.obs_run_id = self.obs.run_id
         trials.obs_metrics = self.obs.metrics  # direct post-run handle
@@ -236,6 +250,11 @@ class FMinIter:
         # disarmed or failed open) — the ephemeral-port discovery handle
         trials.obs_http_url = (self.obs.http.url
                                if self.obs.http is not None else None)
+        # the bounded device-capture plane (None when profile= is
+        # disarmed): the advertised programmatic trigger is
+        # ``trials.obs_profiler.capture(sec)``.  Dropped on pickle (holds
+        # a lock); re-set here on every resume.
+        trials.obs_profiler = self.obs.profiler
         # armed runs hand the bundle to the suggesters through the trials
         # object (the suggest plugin signature has no obs channel): tpe
         # switches to its health-instrumented kernel, rand/anneal record
@@ -599,6 +618,7 @@ class FMinIter:
         stopped = False
         initial_n_done = get_n_done()
         n_reported = initial_n_done
+        tick = 0  # ask→tell tick ordinal: the device-timeline step id
         with progress_mod.get_progress_callback(self.show_progressbar)(
             initial=initial_n_done, total=self.max_evals
         ) as progress_ctx:
@@ -626,6 +646,7 @@ class FMinIter:
             while n_queued < N or (block_until_done and not all_trials_complete):
                 # one beat per ask→tell tick: the stall watchdog's quiet
                 # period measures from here when the host loop wedges
+                tick += 1
                 self.obs.heartbeat("fmin.tick", n_queued=n_queued)
                 self.obs.devmem_sample()  # tick-boundary HBM watermark
                 qlen = get_queue_len()
@@ -652,7 +673,13 @@ class FMinIter:
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     self.trials.refresh()
                     t_ask = time.perf_counter()
-                    with self._timed("suggest"):
+                    # step annotation (obs/profiler.py): a device capture
+                    # overlapping this ask shows its kernels attributed to
+                    # the tick ordinal and the trial ids it proposed
+                    with self.obs.annotate(
+                            "fmin.tick", step=tick,
+                            tid=new_ids[0] if len(new_ids) else -1,
+                            n=len(new_ids)), self._timed("suggest"):
                         if async_algo is not None:
                             # same computation as the plain call, but the
                             # dispatch/readback split is visible as child
@@ -687,7 +714,11 @@ class FMinIter:
                         # the landing readback next tick carries the one
                         # "suggest" span for this ask, so phase counts stay
                         # one-per-ask in both pipelined and sync modes
-                        with self._timed("suggest.dispatch"):
+                        with self.obs.annotate(
+                                "fmin.tick.speculative", step=tick,
+                                tid=new_ids[0] if len(new_ids) else -1,
+                                n=len(new_ids)), \
+                                self._timed("suggest.dispatch"):
                             inflight.append(async_algo(
                                 new_ids, self.domain, trials, next_seed()))
                         self.obs.counter("suggest.speculative").inc()
@@ -805,6 +836,7 @@ def fmin(
     device_loop=False,
     obs=None,
     obs_http=None,
+    profile=None,
     lookahead=0,
     compile_cache=None,
 ):
@@ -834,6 +866,15 @@ def fmin(
     ``HYPEROPT_TPU_OBS_HTTP``.  Watch live with
     ``python -m hyperopt_tpu.obs.top <url>``.  Fail-open: an occupied
     port logs one warning and disables the server, never the run.
+
+    ``profile`` (TPU extension): directory arming the bounded
+    device-capture plane (``hyperopt_tpu/obs/profiler.py``) — on-demand
+    ``GET /profile?sec=N`` captures on the scrape server, programmatic
+    ``trials.obs_profiler.capture(sec)``, one automatic bounded capture
+    on a watchdog stall, and ``TraceAnnotation`` trial/generation ids on
+    the device timeline.  ``"full:<dir>"`` keeps the legacy whole-run
+    ``jax.profiler.trace`` wrapper instead.  Defaults to
+    ``HYPEROPT_TPU_PROFILE`` (same grammar).
 
     ``lookahead`` (TPU extension): keep up to N speculative asks in flight
     — the next batch's fused tell+ask program dispatches before the
@@ -906,6 +947,7 @@ def fmin(
             device_loop=device_loop,
             obs=obs,
             obs_http=obs_http,
+            profile=profile,
             lookahead=lookahead,
             compile_cache=compile_cache,
         )
@@ -928,6 +970,7 @@ def fmin(
         device_loop=device_loop,
         obs=obs,
         obs_http=obs_http,
+        profile=profile,
         lookahead=lookahead,
         compile_cache=compile_cache,
     )

@@ -37,6 +37,7 @@ import dataclasses
 import itertools
 import logging
 import os
+import time
 
 from . import events as events_mod
 from . import flight as flight_mod
@@ -44,6 +45,7 @@ from . import watchdog as watchdog_mod
 from .events import EventLog
 from .flight import FlightRecorder, flight_path_for, get_flight
 from .metrics import MetricsRegistry, adopt_metrics, get_metrics, reset_metrics
+from .profiler import annotation_ctx
 from .trace import JsonlSink, PhaseTimings, Tracer, iter_jsonl, read_jsonl
 from .watchdog import Watchdog, get_watchdog
 
@@ -84,9 +86,15 @@ class ObsConfig:
     * ``"trace"`` — additionally stream every span/event/metric snapshot to
       ``jsonl_path``.
 
-    ``profile_dir`` routes the ``jax.profiler`` trace hook (previously the
-    free-floating ``HYPEROPT_TPU_PROFILE`` check in ``fmin``) through the
-    same object, so one config arms the whole stack.
+    ``profile_dir`` arms the bounded device-capture plane
+    (:mod:`~hyperopt_tpu.obs.profiler`): programmatic / ``/profile?sec=N``
+    / stall-escalation ``jax.profiler`` captures land under this
+    directory, and the fmin tick, device chunk and driver generation get
+    ``TraceAnnotation`` ids on the device timeline.  ``profile_full``
+    keeps the legacy whole-run ``jax.profiler.trace`` wrapper instead
+    (``HYPEROPT_TPU_PROFILE=full:<dir>``) — the two are exclusive per run
+    because jax allows one trace session per process, and a whole-run
+    session would starve every bounded capture.
 
     ``flight_path`` pins the flight-recorder crash-dump path explicitly
     (``HYPEROPT_TPU_FLIGHT=<path>``); left None it derives from
@@ -109,7 +117,8 @@ class ObsConfig:
 
     level: str = "basic"
     jsonl_path: str | None = None
-    profile_dir: str | None = None
+    profile_dir: str | None = None  # bounded-capture plane (obs/profiler.py)
+    profile_full: str | None = None  # legacy whole-run jax.profiler.trace
     run_id: str | None = None
     flight_path: str | None = None
     http_port: int | str | None = None  # port, or "host:port"
@@ -118,10 +127,12 @@ class ObsConfig:
     @classmethod
     def from_env(cls, env=None):
         from .._env import parse_devmem_period, parse_obs_http
+        from .profiler import split_profile_mode
 
         env = os.environ if env is None else env
         raw = env.get("HYPEROPT_TPU_OBS", "").strip()
-        profile_dir = env.get("HYPEROPT_TPU_PROFILE", "") or None
+        profile_dir, profile_full = split_profile_mode(
+            env.get("HYPEROPT_TPU_PROFILE", ""))
         raw_flight = env.get("HYPEROPT_TPU_FLIGHT", "").strip()
         # "0"/"off" (handled by flight.get_flight) and bare "1" are not
         # paths; anything else names the dump file
@@ -134,7 +145,8 @@ class ObsConfig:
         else:  # a path arms the full trace stream
             level, jsonl_path = "trace", raw
         return cls(level=level, jsonl_path=jsonl_path,
-                   profile_dir=profile_dir, flight_path=flight_path,
+                   profile_dir=profile_dir, profile_full=profile_full,
+                   flight_path=flight_path,
                    http_port=parse_obs_http(env),
                    devmem_period=parse_devmem_period(env))
 
@@ -151,6 +163,7 @@ class ObsConfig:
             env_cfg = cls.from_env()
             return cls(level="trace", jsonl_path=str(obs),
                        profile_dir=env_cfg.profile_dir,
+                       profile_full=env_cfg.profile_full,
                        flight_path=env_cfg.flight_path,
                        http_port=env_cfg.http_port,
                        devmem_period=env_cfg.devmem_period)
@@ -198,6 +211,21 @@ class RunObs:
             if self.sink is not None:
                 # armed runs stream stall records next to their spans
                 self.watchdog.attach_sink(self.sink)
+        # device-profiling plane (obs/profiler.py): arm-optional and
+        # thread-free — the DeviceProfiler is a directory + a lock, and a
+        # capture runs on whichever thread asked for it (HTTP handler /
+        # watchdog).  Armed runs register the once-per-run stall
+        # escalation so a hang dies with a device trace next to the
+        # flight dump; disarmed runs construct nothing here (profiler.py
+        # itself must stay stdlib-only at import time — jax imports live
+        # inside capture/annotation calls).
+        self.profiler = None
+        if self.config.profile_dir:
+            from .profiler import DeviceProfiler
+
+            self.profiler = DeviceProfiler(self.config.profile_dir, obs=self)
+            if self.watchdog is not None:
+                self.watchdog.add_escalation(self.profiler.capture_on_stall)
         # live observability plane (obs/serve.py, obs/devmem.py): both are
         # arm-optional — a disarmed run imports neither module, starts no
         # thread, and its hot path stays exactly the pre-serve code
@@ -258,11 +286,24 @@ class RunObs:
     def histogram(self, name):
         return self.metrics.histogram(name)
 
+    def annotate(self, name, **ids):
+        """A device-timeline ``TraceAnnotation`` for one loop boundary
+        (fmin tick / device chunk / driver generation) when the capture
+        plane is armed; a shared null context otherwise — the disarmed
+        call sites pay one attribute check, nothing else.  An integer
+        ``step=`` id maps to ``StepTraceAnnotation`` (TensorBoard's
+        step-time view); every other id becomes a timeline arg, which is
+        how captured kernels are attributed to trial/generation ids."""
+        return annotation_ctx(self.profiler, name, **ids)
+
     def profiler_ctx(self):
-        """``jax.profiler.trace`` over the whole loop when ``profile_dir``
-        is armed (the old ``HYPEROPT_TPU_PROFILE`` hook, now config-routed).
-        """
-        pdir = self.config.profile_dir
+        """``jax.profiler.trace`` over the whole loop when the LEGACY
+        full-trace mode is armed (``HYPEROPT_TPU_PROFILE=full:<dir>``).
+        The bare ``<dir>`` form arms the bounded-capture plane instead
+        (``self.profiler``; obs/profiler.py) and leaves the loop
+        unwrapped, so on-demand ``/profile`` and stall captures can open
+        their own — exclusive — trace sessions."""
+        pdir = self.config.profile_full
         if not pdir:
             return contextlib.nullcontext()
         import jax
@@ -301,12 +342,18 @@ class RunObs:
         if self.http is not None:
             self.http.stop()
         if self.sink is not None:
+            # ts is load-bearing: the Perfetto export drops ts-less
+            # records, and this snapshot is what feeds the roofline
+            # counter tracks (obs/export.py)
             self.sink.write({"kind": "metrics", "run_id": self.run_id,
+                             "ts": time.time(),
                              "snapshot": self.snapshot()})
             if self.watchdog is not None:
                 self.watchdog.detach_sink(self.sink)
             self.sink.close()
         if self.watchdog is not None and not self._finished:
+            if self.profiler is not None:
+                self.watchdog.remove_escalation(self.profiler.capture_on_stall)
             self.watchdog.release()
         if self._flight_target is not None:
             # the run survived: drop its derived dump target so a clean
@@ -332,6 +379,12 @@ class RunObs:
                 self.watchdog.retain()
                 if self.sink is not None:
                     self.watchdog.attach_sink(self.sink)
+                if self.profiler is not None:
+                    # a hang in this new leg must still get its (one)
+                    # device trace — the budget is per leg, not per object
+                    self.profiler.reset_stall_budget()
+                    self.watchdog.add_escalation(
+                        self.profiler.capture_on_stall)
             if self.devmem is not None:
                 self.devmem.start()  # restart the sampler thread
             if self.config.http_port is not None:

@@ -24,8 +24,25 @@ process track group, named after the stream):
   on a ``forensics`` track, stacks and heartbeats in ``args``.
 * ``kind="health"`` → ``C`` counter tracks (``ei_p50``, ``dup_rate``) so
   search health plots right under the span timeline.
-* metric snapshots are skipped (they are end-of-run aggregates, not
-  timeline points).
+* ``kind="profile"`` → instant events on the ``forensics`` track (the
+  postmortem pointer to a device capture), and the capture's own
+  ``*.trace.json.gz`` artifact merges in as additional *device* process
+  track groups (see below).
+* metric snapshots → ``C`` counter points for the per-program roofline
+  (``roofline.<program>`` achieved GFLOP/s, joining the captured
+  ``cost_analysis()`` gauges with the measured execute spans) at the
+  snapshot's timestamp; everything else in a snapshot stays an
+  end-of-run aggregate and is skipped.
+
+**Device captures.**  A ``jax.profiler`` capture (obs/profiler.py) writes
+its own trace-event JSON with profiler-relative microsecond timestamps
+and arbitrary pids.  :func:`device_trace_events` folds one such artifact
+into the merged export: pids are remapped into a reserved range (1000+)
+so they can never collide with the host streams, process names get a
+``device:`` prefix, and every timestamp is shifted by the capture's
+recorded wall-clock epoch so host spans and device kernels align on one
+timeline.  ``obs.report --export-trace`` does this automatically for
+every ``kind="profile"`` record whose artifact still exists.
 
 Events are emitted sorted by ``(pid, tid, ts)`` with metadata (``M``)
 records first — the invariant ``scripts/validate_trace.py`` checks.
@@ -37,9 +54,15 @@ controller streams align on real time.
 
 from __future__ import annotations
 
+import gzip
 import json
 
-__all__ = ["to_trace_events", "export_trace", "write_trace"]
+__all__ = ["to_trace_events", "export_trace", "write_trace",
+           "device_trace_events"]
+
+#: device-capture track groups are remapped to pids >= this, far above any
+#: realistic host-stream count, so the two namespaces can never collide
+DEVICE_PID_BASE = 1000
 
 # reserved per-stream tids; real recording threads allocate upward from 10
 _TID_MAIN = 0
@@ -137,6 +160,30 @@ def to_trace_events(records, pid=0, name=None):
                         "tid": _TID_COUNTERS, "cat": "health",
                         "args": {stat: float(v)},
                     })
+        elif kind == "profile":
+            instant(_TID_FORENSICS, f"profile:{r.get('reason', '?')}", ts,
+                    "forensics", {"ok": r.get("ok"), "dir": r.get("dir"),
+                                  "trace_json": r.get("trace_json"),
+                                  "sec": r.get("sec")})
+        elif kind == "metrics":
+            # per-program roofline counters: one point per embedded
+            # snapshot (a multi-run() stream plots a real series).  The
+            # join itself lives in health.roofline_table — the single
+            # formula behind /snapshot, obs.report and these counters.
+            from .health import roofline_table
+
+            dev = (((r.get("snapshot") or {}).get("shared") or {})
+                   .get("device") or {}).get("metrics", {})
+            for st, row in roofline_table(dev).items():
+                flops_per_sec = row.get("achieved_flops_per_sec")
+                if flops_per_sec is None:
+                    continue  # cost captured but no execute spans yet
+                used_tracks.add(_TID_COUNTERS)
+                events.append({
+                    "name": f"roofline.{st}", "ph": "C", "ts": _us(ts),
+                    "pid": pid, "tid": _TID_COUNTERS, "cat": "roofline",
+                    "args": {"gflops": flops_per_sec / 1e9},
+                })
 
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": name or f"stream-{pid}"}}]
@@ -153,23 +200,106 @@ def to_trace_events(records, pid=0, name=None):
     return meta + events
 
 
-def export_trace(streams):
+_DEVICE_PH_KEEP = {"X", "i", "I", "C", "M"}
+
+
+def device_trace_events(path, pid_base, name=None, epoch_offset_sec=None):
+    """One ``jax.profiler`` capture artifact (``*.trace.json.gz`` or plain
+    ``.json``) → ``(events, n_pids)`` ready to merge into the host export.
+
+    * original pids remap densely onto ``pid_base + i`` (the reserved
+      device range — host streams can never collide);
+    * ``process_name`` metadata gets a ``device:<capture name>:`` prefix,
+      and any pid the capture left unnamed gets one synthesized (the
+      merged-artifact lint requires every track group named);
+    * non-metadata timestamps shift by ``epoch_offset_sec`` (the capture's
+      recorded wall-clock start) so device kernels line up with the
+      host spans' absolute-epoch microseconds; negative timestamps clamp
+      to the capture start;
+    * only viewer-meaningful phases survive (``X i I C M``); ``X`` events
+      missing a duration get ``dur=0``.
+    """
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    raw = data.get("traceEvents") if isinstance(data, dict) else data
+    if not isinstance(raw, list):
+        return [], 0
+    off_us = float(epoch_offset_sec) * 1e6 if epoch_offset_sec else 0.0
+    pid_map = {}
+    named = set()
+    meta, events = [], []
+    for e in raw:
+        if not isinstance(e, dict) or e.get("ph") not in _DEVICE_PH_KEEP:
+            continue
+        orig_pid = e.get("pid")
+        if not isinstance(orig_pid, int):
+            continue
+        pid = pid_map.setdefault(orig_pid, pid_base + len(pid_map))
+        if e["ph"] == "M":
+            m = dict(e)
+            m["pid"] = pid
+            m.setdefault("tid", 0)
+            if m.get("name") == "process_name":
+                orig = (m.get("args") or {}).get("name", orig_pid)
+                m["args"] = {"name": f"device:{name or 'capture'}:{orig}"}
+                named.add(pid)
+            meta.append(m)
+            continue
+        ts = e.get("ts")
+        tid = e.get("tid")
+        if not isinstance(ts, (int, float)) or not isinstance(tid, int):
+            continue
+        out = dict(e)
+        out["pid"] = pid
+        out["ts"] = max(0.0, float(ts)) + off_us
+        if e["ph"] == "X" and not isinstance(e.get("dur"), (int, float)):
+            out["dur"] = 0.0
+        events.append(out)
+    for orig_pid, pid in pid_map.items():
+        if pid not in named:
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0,
+                         "args": {"name": f"device:{name or 'capture'}:"
+                                          f"{orig_pid}"}})
+    return meta + events, len(pid_map)
+
+
+def export_trace(streams, device_traces=()):
     """``[(name, records-iterable)]`` → a trace-event JSON object.  Each
     stream becomes its own ``pid`` track group (the multi-controller merge
-    view); events are sorted ``(pid, tid, ts)``, metadata first — the
-    layout ``scripts/validate_trace.py`` pins."""
+    view); ``device_traces`` — ``[(name, artifact path, epoch t0), ...]``
+    from ``kind="profile"`` records — merge in as device track groups in
+    the reserved pid range.  Events are sorted ``(pid, tid, ts)``,
+    metadata first — the layout ``scripts/validate_trace.py`` pins."""
     meta, events = [], []
     for pid, (name, records) in enumerate(streams):
         for e in to_trace_events(records, pid=pid, name=name):
+            (meta if e["ph"] == "M" else events).append(e)
+    pid_base = DEVICE_PID_BASE
+    for name, path, t0 in device_traces:
+        try:
+            merged, n_pids = device_trace_events(
+                path, pid_base, name=name, epoch_offset_sec=t0)
+        except (OSError, ValueError) as e:
+            # a vanished/corrupt capture artifact degrades to a skipped
+            # track group, never a failed export of the host spans
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "skipping device capture %s: %s", path, e)
+            continue
+        pid_base += n_pids
+        for e in merged:
             (meta if e["ph"] == "M" else events).append(e)
     events.sort(key=lambda e: (e["pid"], e["tid"], e["ts"]))
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
-def write_trace(path, streams):
-    """Export ``streams`` and write the trace JSON to ``path``; returns the
-    event count."""
-    trace = export_trace(streams)
+def write_trace(path, streams, device_traces=()):
+    """Export ``streams`` (+ any device captures) and write the trace JSON
+    to ``path``; returns the event count."""
+    trace = export_trace(streams, device_traces=device_traces)
     with open(path, "w") as f:
         json.dump(trace, f)
     return len(trace["traceEvents"])

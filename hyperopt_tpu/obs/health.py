@@ -71,6 +71,7 @@ __all__ = [
     "capture_jit_cost",
     "utilization_snapshot",
     "utilization_from_metrics",
+    "roofline_table",
     "controller_stream_path",
 ]
 
@@ -329,6 +330,49 @@ def utilization_from_metrics(dev, wall_sec=None,
     if costs:
         out["program_costs"] = costs
     return out
+
+
+def roofline_table(device_metrics, phases=None, ask_sec=None):
+    """Per-program roofline rows: every captured ``cost_analysis()`` cost
+    joined with its measured execute spans.
+
+    ``{program: {flops_per_dispatch, bytes_per_dispatch, dispatches,
+    execute_sec_total, achieved_flops_per_sec, arithmetic_intensity,
+    pct_of_ask}}`` — ``pct_of_ask`` is the program's execute total as a
+    fraction of the run's ``suggest`` phase wall clock (``ask_sec``
+    overrides; ``phases`` is the ``{name: {"sec", "count"}}`` dict the
+    tracer/report already carry), answering "which program actually owns
+    the ask latency" from the artifacts alone.  Programs with a captured
+    cost but no execute spans yet report the static half only — every
+    gauge keeps a reader.  Arithmetic intensity is FLOPs per byte
+    accessed: with the measured FLOP/s this is everything a roofline plot
+    needs."""
+    if ask_sec is None and phases:
+        ask_sec = (phases.get("suggest") or {}).get("sec")
+    rows = {}
+    for key, fl in device_metrics.items():
+        if not (isinstance(key, str) and key.endswith(".flops")):
+            continue
+        st = key[: -len(".flops")]
+        by = float(device_metrics.get(f"{st}.bytes") or 0.0)
+        row = {
+            "flops_per_dispatch": float(fl),
+            "bytes_per_dispatch": by,
+            "arithmetic_intensity": (float(fl) / by) if by else None,
+        }
+        ex = device_metrics.get(f"{st}.execute_sec")
+        if isinstance(ex, dict) and ex.get("count"):
+            sec, n = float(ex["sum"]), int(ex["count"])
+            row.update(
+                dispatches=n,
+                execute_sec_total=sec,
+                achieved_flops_per_sec=(float(fl) * n / sec) if sec > 0
+                else 0.0,
+            )
+            if ask_sec:
+                row["pct_of_ask"] = min(1.0, sec / float(ask_sec))
+        rows[st] = row
+    return rows
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,324 @@
+"""Programmatic, bounded ``jax.profiler`` device captures.
+
+The span/metric pillars see *host* time; ``cost_analysis()`` sees *static*
+FLOP estimates.  What neither sees is the actual device timeline — which
+XLA program ran when, for how long, overlapped with what.  This module is
+the device-time half of the observability plane: bounded, on-demand
+profiler captures that can be triggered three ways, all riding one armed
+config (``HYPEROPT_TPU_PROFILE=<dir>`` / ``fmin(profile=<dir>)``):
+
+* **programmatically** — ``RunObs.profiler.capture(sec)`` from any thread;
+* **on demand over HTTP** — ``GET /profile?sec=N`` on the live scrape
+  server (``obs/serve.py``) starts a capture, blocks for its bounded
+  duration, and answers with the artifact paths as JSON;
+* **automatically on a stall** — the watchdog's escalation hook takes ONE
+  bounded capture per run when the process goes quiet, so a hang dies
+  with a device trace next to the flight dump instead of only host
+  stacks.
+
+Every capture is **bounded** (``sec`` clamps to ``max_capture_sec``) and
+**exclusive** (``jax.profiler`` supports one trace session per process; a
+concurrent request fails open with a busy error instead of raising into
+the run).  Captures run on the *caller's* thread — the HTTP handler or
+watchdog thread that asked — so a disarmed run starts zero new threads
+and an armed-but-idle one starts none either.
+
+Each capture lands in its own ``capture-<n>-<reason>`` directory under
+the armed profile dir and is recorded as a ``kind="profile"`` JSONL
+record (+ flight-ring event) carrying the located ``*.trace.json.gz``
+trace-event artifact, the capture's wall-clock epoch, and the trigger
+reason.  ``obs.report --export-trace`` folds referenced captures into the
+merged Perfetto export next to the host spans (``obs/export.py``), with
+the capture's epoch aligning the two timelines.
+
+**Timeline annotations.**  :func:`annotation_ctx` wraps
+``jax.profiler.TraceAnnotation`` / ``StepTraceAnnotation`` so the fmin
+tick, the device-loop chunk, and the driver generation show up *named*
+(with trial/generation/study ids) inside any capture that overlaps them.
+Disarmed runs get a shared null context — one attribute check, no jax
+import, proposals bit-identical (pinned by tests/test_profiler.py).
+
+Legacy whole-run traces: ``HYPEROPT_TPU_PROFILE=full:<dir>`` keeps the
+old trace-the-entire-loop behavior (``RunObs.profiler_ctx``); the bare
+``<dir>`` form now arms the bounded capture plane, because a whole-run
+trace session would block every on-demand and stall capture for the
+run's entire lifetime (one session per process).  docs/MIGRATION.md
+documents the switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import logging
+import os
+import threading
+import time
+
+__all__ = ["DeviceProfiler", "find_capture_artifact", "annotation_ctx",
+           "split_profile_mode"]
+
+logger = logging.getLogger(__name__)
+
+#: hard ceiling on one capture's duration — a typo'd ``/profile?sec=3600``
+#: must not profile (and slow) an hour of the run it observes
+DEFAULT_MAX_CAPTURE_SEC = 30.0
+
+#: bounded duration of the automatic stall-escalation capture
+DEFAULT_STALL_CAPTURE_SEC = 5.0
+
+#: retained completed-capture records (a /profile poller against a
+#: multi-day run must not grow the process)
+CAPTURES_KEEP = 256
+
+#: failed captures streamed to the sink/flight ring before going quiet —
+#: a postmortem needs the first failures, not a poller's millionth retry
+FAILURE_STREAM_MAX = 20
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def split_profile_mode(raw):
+    """``HYPEROPT_TPU_PROFILE`` value → ``(capture_dir, full_trace_dir)``.
+
+    ``<dir>`` arms the bounded capture plane; ``full:<dir>`` keeps the
+    legacy whole-run ``jax.profiler.trace`` wrapper instead (the two are
+    mutually exclusive per run: a whole-run session would starve every
+    bounded capture).  Empty/unset → ``(None, None)``.
+    """
+    raw = (raw or "").strip()
+    if not raw:
+        return None, None
+    if raw.startswith("full:"):
+        full = raw[len("full:"):].strip()
+        return None, (full or None)
+    return raw, None
+
+
+def find_capture_artifact(capture_dir):
+    """Newest ``*.trace.json.gz`` under one capture's directory tree, or
+    None.  ``jax.profiler`` writes
+    ``<dir>/plugins/profile/<stamp>/<host>.trace.json.gz`` — the
+    trace-event JSON every Chrome-lineage viewer (and our Perfetto merge)
+    loads — next to the ``.xplane.pb`` TensorBoard artifact."""
+    hits = glob.glob(os.path.join(
+        str(capture_dir), "**", "*.trace.json.gz"), recursive=True)
+    return max(hits, key=os.path.getmtime) if hits else None
+
+
+def annotation_ctx(profiler, name, **ids):
+    """A ``jax.profiler.TraceAnnotation`` for the named loop boundary when
+    the capture plane is armed, a shared null context otherwise.
+
+    The call sites (fmin tick, device chunk, driver generation) run this
+    every iteration, so the disarmed cost must be one ``is None`` check —
+    no jax import, no object construction.  Annotation args become the
+    ``args`` of the device timeline's X event, which is how a capture's
+    kernels are attributed back to trial/generation/study ids
+    (``scripts/validate_trace.py`` lints their presence in merged
+    artifacts)."""
+    if profiler is None:
+        return _NULL_CTX
+    return profiler.annotation(name, **ids)
+
+
+class DeviceProfiler:
+    """Bounded, exclusive, fail-open ``jax.profiler`` capture manager.
+
+    Construction is cheap and thread-free: the profiler holds a directory,
+    a lock and counters.  A capture runs synchronously on the calling
+    thread (HTTP handler / watchdog / test) — ``start_trace``, a bounded
+    sleep, ``stop_trace`` — then locates the trace-event artifact and
+    records a ``kind="profile"`` record to the run's sink and the flight
+    ring.  Any backend error (no profiler support, a session already
+    active, an unwritable dir) degrades to a once-logged warning and an
+    ``{"ok": False}`` result: profiling must never take down the run it
+    observes.
+    """
+
+    def __init__(self, out_dir, obs=None,
+                 max_capture_sec=DEFAULT_MAX_CAPTURE_SEC,
+                 stall_capture_sec=DEFAULT_STALL_CAPTURE_SEC,
+                 clock=time.sleep):
+        self.out_dir = str(out_dir)
+        self.obs = obs  # RunObs (or anything with .sink/.run_id), optional
+        self.max_capture_sec = float(max_capture_sec)
+        self.stall_capture_sec = float(stall_capture_sec)
+        self._sleep = clock  # injectable for tests (no real waiting)
+        self._lock = threading.Lock()  # one trace session per process
+        self._count = 0
+        self._stall_captured = False  # once-per-run bound
+        self._warned_unsupported = False
+        self._failures_streamed = 0
+        self.captures = []  # capture records, oldest first, bounded
+
+    # -- annotations -------------------------------------------------------
+
+    def annotation(self, name, **ids):
+        """``TraceAnnotation`` carrying ``ids`` as timeline args; the
+        ``step`` id (fmin tick / driver generation number) additionally
+        makes TensorBoard's step-time view work via
+        ``StepTraceAnnotation``.  Fail-open: a backend without profiler
+        support degrades to the null context."""
+        try:
+            import jax.profiler as jp
+
+            if "step" in ids:
+                step = ids.pop("step")
+                return jp.StepTraceAnnotation(name, step_num=int(step),
+                                              **_str_args(ids))
+            return jp.TraceAnnotation(name, **_str_args(ids))
+        except Exception:
+            return _NULL_CTX
+
+    # -- captures ----------------------------------------------------------
+
+    def capture(self, sec, reason="ondemand"):
+        """One bounded capture: returns the ``kind="profile"`` record
+        (``ok=True`` with artifact paths) or an ``ok=False`` record naming
+        why (busy / unsupported / bad duration).  Never raises.  Failure
+        records stream to the sink/flight ring too — a postmortem must
+        show that a stall capture was ATTEMPTED and why it failed, not
+        just silently lack one."""
+        try:
+            sec = float(sec)
+        except (TypeError, ValueError):
+            return self._record({
+                "kind": "profile", "ok": False, "ts": time.time(),
+                "reason": str(reason),
+                "error": f"bad capture duration {sec!r}"})
+        if not sec > 0:
+            return self._record({
+                "kind": "profile", "ok": False, "ts": time.time(),
+                "reason": str(reason),
+                "error": f"capture duration must be > 0, got {sec}"})
+        sec = min(sec, self.max_capture_sec)
+        if not self._lock.acquire(blocking=False):
+            # jax supports one profiler session per process: a concurrent
+            # request reports busy instead of raising into the run
+            return self._record({
+                "kind": "profile", "ok": False, "ts": time.time(),
+                "reason": str(reason), "busy": True,
+                "error": "capture already in progress"})
+        try:
+            return self._capture_locked(sec, reason)
+        finally:
+            self._lock.release()
+
+    def _capture_locked(self, sec, reason):
+        self._count += 1
+        cap_dir = os.path.join(self.out_dir,
+                               f"capture-{self._count}-{reason}")
+        t0 = time.time()
+        rec = {"kind": "profile", "reason": str(reason), "ts": t0,
+               "sec": sec, "dir": cap_dir}
+        try:
+            import jax.profiler as jp
+
+            os.makedirs(cap_dir, exist_ok=True)
+            jp.start_trace(cap_dir)
+        except Exception as e:
+            if "already" in str(e).lower():
+                # a FOREIGN in-process session (another run's profiler, a
+                # user's own jax.profiler.trace) — our lock only covers
+                # this instance, jax's limit is process-wide.  Transient,
+                # so report busy (retryable: a stall escalation keeps its
+                # once-per-run budget), not unsupported (which latches).
+                rec.update(ok=False, busy=True,
+                           error=f"{type(e).__name__}: {e}")
+                return self._record(rec)
+            # fail-open: CPU backends support this, but a backend/build
+            # without profiler hooks must degrade to a warning, not an
+            # exception into the run
+            if not self._warned_unsupported:
+                self._warned_unsupported = True
+                logger.warning(
+                    "device profiler capture unavailable (%s: %s); "
+                    "/profile and stall captures degrade to errors for "
+                    "this run — spans, metrics and the flight ring are "
+                    "unaffected", type(e).__name__, e)
+            rec.update(ok=False, error=f"{type(e).__name__}: {e}")
+            return self._record(rec)
+        try:
+            self._sleep(sec)
+        finally:
+            t1 = time.time()
+            try:
+                jp.stop_trace()
+            except Exception as e:
+                rec.update(ok=False, error=f"{type(e).__name__}: {e}")
+                return self._record(rec)
+        rec.update(ok=True, t0=t0, t1=t1, wall_sec=t1 - t0,
+                   trace_json=find_capture_artifact(cap_dir))
+        return self._record(rec)
+
+    def capture_on_stall(self, stall_rec=None):
+        """The watchdog escalation hook: ONE bounded capture per run, so a
+        6-hour hang produces one device trace, not 72.  The capture runs on
+        the watchdog's own thread — the stalled main thread may be wedged
+        inside the very device call the trace is meant to show.  A BUSY
+        miss (an in-flight /profile holds the session) does not consume
+        the once-per-run budget — the next stall period retries, so the
+        hang still dies with a trace; any other failure (unsupported
+        backend, unwritable dir) latches, because it would fail the same
+        way every period."""
+        if self._stall_captured:
+            return None
+        rec = self.capture(self.stall_capture_sec, reason="stall")
+        if not rec.get("busy"):
+            self._stall_captured = True
+        if rec.get("ok"):
+            logger.warning(
+                "stall escalation: captured %.1fs device trace to %s "
+                "(referenced from the flight dump)",
+                rec["wall_sec"], rec["dir"])
+        return rec
+
+    def reset_stall_budget(self):
+        """Re-open the once-per-run stall-capture budget.  Called by
+        ``RunObs.rearm()`` when the iterator protocol re-enters a finished
+        run — a hang in the second leg must still die with a device trace,
+        bounded at one capture per leg."""
+        self._stall_captured = False
+
+    @property
+    def capture_count(self):
+        return self._count
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _record(self, rec):
+        """Stream the capture record (success OR failure) next to the
+        run's spans and pin it in the flight ring — the postmortem's
+        pointer to the device trace, or to why there isn't one.  Returns
+        ``rec`` so every ``capture()`` exit path is one expression.
+
+        Bounded against pollers: ``captures`` keeps the newest
+        ``CAPTURES_KEEP`` records, and after ``FAILURE_STREAM_MAX``
+        streamed failures further ones only go back to the caller (an
+        unsupported backend fails the same way on every ``/profile``
+        retry — the sink needs the first screamful, not a multi-day
+        poller's worth)."""
+        self.captures.append(rec)
+        if len(self.captures) > CAPTURES_KEEP:
+            del self.captures[: len(self.captures) - CAPTURES_KEEP]
+        if not rec.get("ok"):
+            self._failures_streamed += 1
+            if self._failures_streamed > FAILURE_STREAM_MAX:
+                return rec
+        obs = self.obs
+        sink = getattr(obs, "sink", None)
+        if getattr(obs, "run_id", None) is not None:
+            rec.setdefault("run_id", obs.run_id)
+        from .flight import get_flight
+
+        get_flight().record(rec)
+        if sink is not None:
+            sink.write(rec)
+        return rec
+
+
+def _str_args(ids):
+    """TraceAnnotation metadata values must be TraceMe-encodable; str() is
+    the lowest common denominator and what the timeline shows anyway."""
+    return {k: str(v) for k, v in ids.items()}

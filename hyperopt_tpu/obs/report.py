@@ -6,6 +6,7 @@ Usage::
     python -m hyperopt_tpu.obs.report --merge run.p0.jsonl run.p1.jsonl ...
     python -m hyperopt_tpu.obs.report --postmortem run.flight.jsonl
     python -m hyperopt_tpu.obs.report --export-trace out.json run.jsonl ...
+    python -m hyperopt_tpu.obs.report --trend [.obs/trajectory.jsonl]
 
 Single-stream sections, matching the telemetry pillars:
 
@@ -37,7 +38,16 @@ stall reports, in-flight trials, and the tail of the record ring.
 
 ``--export-trace OUT`` converts the input stream(s) to Chrome/Perfetto
 trace-event JSON (``obs/export.py``; one process track group per stream)
-instead of rendering ASCII — load OUT in https://ui.perfetto.dev.
+instead of rendering ASCII — load OUT in https://ui.perfetto.dev.  Any
+``kind="profile"`` record in the inputs whose device-capture artifact
+(``*.trace.json.gz``, written by obs/profiler.py) still exists is merged
+in automatically as additional ``device:`` track groups, wall-clock
+aligned with the host spans.
+
+``--trend`` renders the append-only bench trajectory store
+(``.obs/trajectory.jsonl``, obs/trajectory.py) as per-key sparkline
+history — the answer to "did ``ask_p50_ms`` creep up over the last six
+PRs" from the committed artifacts alone.
 """
 
 from __future__ import annotations
@@ -57,7 +67,7 @@ from .events import (
 from .trace import iter_jsonl, read_jsonl  # noqa: F401  (read_jsonl re-export)
 
 __all__ = ["main", "render", "render_merged", "render_postmortem",
-           "headline_sections", "json_report"]
+           "render_trend", "headline_sections", "json_report"]
 
 _BAR_W = 30
 
@@ -369,6 +379,149 @@ def _fmt_bytes(n):
         n /= 1024
 
 
+def _fmt_flops(v):
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000 or unit == "P":
+            return f"{v:.1f}{unit}F/s"
+        v /= 1000
+
+
+def _roofline_section(records, spans, out):
+    """Per-program roofline (kernel attribution): the captured
+    cost_analysis() costs joined with measured execute spans, plus each
+    program's share of the suggest phase — from the final embedded
+    metrics snapshot, same join the live ``/snapshot`` serves."""
+    from .health import roofline_table
+
+    metric_recs = [r for r in records if r.get("kind") == "metrics"]
+    if not metric_recs:
+        return
+    snap = metric_recs[-1].get("snapshot") or {}
+    dev = ((snap.get("shared") or {}).get("device") or {}).get("metrics", {})
+    phases = {}
+    for s in spans:
+        if s.get("aggregate") is False:
+            continue
+        e = phases.setdefault(s["name"], {"sec": 0.0, "count": 0})
+        e["sec"] += s.get("wall_sec", 0.0)
+        e["count"] += 1
+    rows = roofline_table(dev, phases=phases)
+    if not rows:
+        return
+    out.append("")
+    out.append("== kernel roofline " + "=" * 45)
+    w = max(len(n) for n in rows)
+    for st, r in sorted(rows.items()):
+        ai = r.get("arithmetic_intensity")
+        if r.get("dispatches"):
+            line = (f"  {st:<{w}}  x{r['dispatches']:<6} "
+                    f"exec {_fmt_sec(r['execute_sec_total']):>8}  "
+                    f"achieved "
+                    f"{_fmt_flops(r.get('achieved_flops_per_sec')):>10}")
+            if ai is not None:
+                line += f"  AI {ai:.1f} F/B"
+            if r.get("pct_of_ask") is not None:
+                line += f"  {r['pct_of_ask'] * 100:.0f}% of ask"
+        else:
+            line = f"  {st:<{w}}  static cost captured"
+            if ai is not None:
+                line += f"  AI {ai:.1f} F/B"
+            line += "  (no execute spans yet)"
+        out.append(line)
+
+
+def render_trend(records, width=24):
+    """The bench trajectory store as per-key sparkline history.
+
+    ``records`` is the oldest-first output of
+    :func:`hyperopt_tpu.obs.trajectory.load`.  Every key any run ever
+    reported gets a row — gated keys (obs/trajectory.py
+    ``KEY_DIRECTIONS``) first, with their regression direction named, so
+    the reader knows which way "up" is before trusting a slope; keys the
+    gate doesn't know render too (marked ungated).  Runs missing a key
+    are skipped in that key's sparkline (the run count says how many
+    contributed).  Mixed-backend histories segment per backend (one
+    ``key [backend]`` row each): a tpu→cpu switch is a hardware change,
+    not a 1000x regression — the same reason the windowed gate
+    backend-matches its history."""
+    from .trajectory import KEY_DIRECTIONS
+
+    out = []
+    out.append("== bench trajectory " + "=" * 44)
+    if not records:
+        out.append("  (store is empty — run bench.py or "
+                   "`python -m hyperopt_tpu.obs.trajectory backfill`)")
+        return "\n".join(out) + "\n"
+    for r in records:
+        rd = r.get("round")
+        out.append(
+            f"  {('r%s' % rd) if rd is not None else 'live':<5} "
+            f"{r.get('source', '?'):<18} "
+            f"rev {r.get('git_rev') or '-':<9} "
+            f"backend {r.get('backend') or '?'}")
+    out.append("")
+    keys = []
+    for r in records:
+        for k in (r.get("keys") or {}):
+            if k not in keys:
+                keys.append(k)
+    ordered = ([k for k in KEY_DIRECTIONS if k in keys]
+               + [k for k in keys if k not in KEY_DIRECTIONS])
+    if not ordered:
+        out.append("  (no numeric keys recorded yet)")
+        return "\n".join(out) + "\n"
+    backends = []
+    for r in records:
+        b = r.get("backend") or "?"
+        if b not in backends:
+            backends.append(b)
+    multi = len(backends) > 1
+    w = max(len(k) for k in ordered)
+    if multi:
+        w += 3 + max(len(b) for b in backends)
+    for k in ordered:
+        meta = KEY_DIRECTIONS.get(k)
+        direction = {"higher": "higher=better",
+                     "lower": "lower=better"}.get(
+            (meta or {}).get("direction"), "ungated")
+        for b in backends:
+            recs = [r for r in records
+                    if (r.get("backend") or "?") == b] if multi else records
+            series = [(r.get("keys") or {}).get(k) for r in recs]
+            vals = [v for v in series if isinstance(v, (int, float))]
+            if not vals:
+                continue
+            label = f"{k} [{b}]" if multi else k
+            runs = f"{len(vals)}/{len(recs)} {b} runs" if multi else \
+                f"{len(vals)}/{len(recs)} runs"
+            out.append(
+                f"  {label:<{w}}  {_spark(series, width=width):<{width}}  "
+                f"{vals[0]:.6g} -> {vals[-1]:.6g}  ({direction}, {runs})")
+            if not multi:
+                break
+    return "\n".join(out) + "\n"
+
+
+def _profile_section(profile_recs, out):
+    """On-demand / stall device captures recorded by obs/profiler.py: the
+    pointers from this stream to its device-timeline artifacts."""
+    if not profile_recs:
+        return
+    out.append("")
+    out.append("== device captures " + "=" * 45)
+    for r in profile_recs:
+        if r.get("ok"):
+            out.append(f"  {r.get('reason', '?'):<10} "
+                       f"{_fmt_sec(r.get('wall_sec')):>8}  "
+                       f"{r.get('trace_json') or r.get('dir', '?')}")
+        else:
+            out.append(f"  {r.get('reason', '?'):<10} FAILED  "
+                       f"{r.get('error', '?')}")
+
+
 # ---------------------------------------------------------------------------
 # the shared headline serializer (``--format json`` == ``/snapshot``)
 # ---------------------------------------------------------------------------
@@ -388,7 +541,7 @@ def headline_sections(phases, metrics, device_metrics, wall_sec=None):
     ``device_metrics``: snapshotted metric dicts (the ``"metrics"`` value
     of ``MetricsRegistry.snapshot()``).
     """
-    from .health import utilization_from_metrics
+    from .health import roofline_table, utilization_from_metrics
 
     total = sum(e.get("sec", 0.0) for e in phases.values()) or 1.0
     report = {
@@ -424,6 +577,10 @@ def headline_sections(phases, metrics, device_metrics, wall_sec=None):
         "health": health,
         "utilization": utilization_from_metrics(device_metrics,
                                                 wall_sec=wall_sec),
+        # per-program roofline: static cost × measured execute spans, with
+        # each program's share of the suggest phase wall clock — the
+        # kernel-attribution view, live on /snapshot and offline here
+        "roofline": roofline_table(device_metrics, phases=phases),
         "ask_pipeline": ask_pipeline,
     }
 
@@ -470,12 +627,15 @@ def render(records, top=5):
     metric_recs = [r for r in records if r.get("kind") == "metrics"]
     health_recs = [r for r in records if r.get("kind") == "health"]
     devmem_recs = [r for r in records if r.get("kind") == "devmem"]
+    profile_recs = [r for r in records if r.get("kind") == "profile"]
     events = [r for r in records if r.get("kind") == "event"]
 
     out = []
     out.append("== phase-time breakdown " + "=" * 40)
     _phase_section(spans, out)
     _pipeline_section(spans, _last_snapshot_metrics(records), out)
+    _roofline_section(records, spans, out)
+    _profile_section(profile_recs, out)
     out.append("")
     out.append("== search health " + "=" * 47)
     _health_section(health_recs, out)
@@ -767,6 +927,11 @@ def render_postmortem(records, name=None):
     out.extend(inflight if inflight
                else ["  (none — no trial was mid-evaluation)"])
 
+    # device captures pinned in the flight ring (obs/profiler.py): the
+    # stall escalation's bounded trace — a hang's postmortem points at
+    # the device timeline artifact, not just host stacks
+    _profile_section([r for r in recs if r.get("kind") == "profile"], out)
+
     # the memory narrative (devmem tail + at-death census attached by the
     # flight recorder when the sampler was armed — OOMs die explained)
     devmem_recs = [r for r in recs if r.get("kind") == "devmem"]
@@ -789,9 +954,11 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.obs.report",
         description="Render a hyperopt_tpu obs JSONL stream.")
-    p.add_argument("jsonl", nargs="+",
+    p.add_argument("jsonl", nargs="*",
                    help="telemetry stream(s) written by an armed run, or "
-                        "flight dump(s) with --postmortem")
+                        "flight dump(s) with --postmortem, or the "
+                        "trajectory store with --trend (default: the "
+                        "repo's .obs/trajectory.jsonl)")
     p.add_argument("--top", type=int, default=5,
                    help="how many slowest trials to list (single-stream "
                         "report only)")
@@ -810,11 +977,44 @@ def main(argv=None):
                    help="json: machine-readable headline sections "
                         "(report/health/utilization/ask-pipeline) via the "
                         "same serializer the live /snapshot endpoint uses")
+    p.add_argument("--trend", action="store_true",
+                   help="render the bench trajectory store "
+                        "(.obs/trajectory.jsonl) as per-key sparkline "
+                        "history instead of a run report")
     args = p.parse_args(argv)
     if args.format == "json" and args.postmortem:
         print("error: --format json applies to the report/merge views, "
               "not --postmortem", file=sys.stderr)
         return 2
+    if args.trend:
+        if args.merge or args.postmortem or args.export_trace:
+            print("error: --trend is its own view; it does not combine "
+                  "with --merge/--postmortem/--export-trace",
+                  file=sys.stderr)
+            return 2
+        if args.format == "json":
+            # erroring beats a scripted consumer silently getting text:
+            # the store is already machine-readable JSONL
+            print("error: --trend renders text only; for machine-readable "
+                  "history use `python -m hyperopt_tpu.obs.trajectory "
+                  "show`", file=sys.stderr)
+            return 2
+        if len(args.jsonl) > 1:
+            print("error: --trend takes one trajectory store, got "
+                  f"{len(args.jsonl)} paths", file=sys.stderr)
+            return 2
+        from .trajectory import load, trajectory_path
+
+        path = args.jsonl[0] if args.jsonl else trajectory_path()
+        if not os.path.exists(path):
+            print(f"error: no trajectory store at {path} — run bench.py "
+                  "or `python -m hyperopt_tpu.obs.trajectory backfill`",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_trend(load(path)))
+        return 0
+    if not args.jsonl:
+        p.error("give telemetry stream(s), or --trend")
     for path in args.jsonl:
         if not os.path.exists(path):
             print(f"error: cannot read {path}: no such file",
@@ -823,13 +1023,47 @@ def main(argv=None):
     if args.export_trace:
         from .export import write_trace
 
+        # device captures referenced by kind="profile" records merge in
+        # automatically, collected DURING the single conversion pass (a
+        # vanished capture degrades to a skipped track group).  Safe
+        # because export_trace consumes every host stream before it reads
+        # device_traces, so the teed list is complete by then.
+        device_traces = []
+
+        def _tee_profiles(path):
+            # capture paths were recorded relative to the RUN's cwd; when
+            # the export runs elsewhere, retry them relative to the
+            # stream file (run.jsonl and prof/ usually share a directory)
+            base = os.path.dirname(os.path.abspath(path))
+            for r in iter_jsonl(path):
+                if (isinstance(r, dict) and r.get("kind") == "profile"
+                        and r.get("ok") and r.get("trace_json")):
+                    tj = r["trace_json"]
+                    if not os.path.exists(tj):
+                        alt = os.path.join(base, tj)
+                        tj = alt if os.path.exists(alt) else None
+                    if tj is None:
+                        print(f"warning: skipping device capture "
+                              f"{r.get('dir') or r['trace_json']}: artifact "
+                              f"{r['trace_json']} not found (moved? or "
+                              "export running from a different directory "
+                              "than the run)", file=sys.stderr)
+                    else:
+                        device_traces.append((
+                            os.path.basename(r.get("dir") or tj),
+                            tj, r.get("t0")))
+                yield r
+
         # iter_jsonl avoids holding the raw JSONL in memory; the converted
         # trace events themselves still accumulate for the final sort, so
         # peak memory is one event dict per record
         n = write_trace(args.export_trace,
-                        [(os.path.basename(path), iter_jsonl(path))
-                         for path in args.jsonl])
-        print(f"wrote {n} trace events to {args.export_trace} "
+                        [(os.path.basename(path), _tee_profiles(path))
+                         for path in args.jsonl],
+                        device_traces=device_traces)
+        merged = (f" (+{len(device_traces)} device capture(s) merged)"
+                  if device_traces else "")
+        print(f"wrote {n} trace events to {args.export_trace}{merged} "
               "(load in https://ui.perfetto.dev)")
         return 0
     if len(args.jsonl) > 1 and not (args.merge or args.postmortem):

@@ -20,6 +20,13 @@ JSONL:
   the flight recorder's record tap.  Each client gets a BOUNDED ring
   (drop-oldest on overflow, reported as a ``dropped`` field on the next
   event) so a slow or stalled scraper can never backpressure a span.
+* ``GET /profile?sec=N`` — one bounded on-demand ``jax.profiler`` device
+  capture (``obs/profiler.py``; requires the capture plane armed via
+  ``HYPEROPT_TPU_PROFILE=<dir>`` / ``fmin(profile=<dir>)``); blocks for
+  the bounded duration and answers the capture record — artifact paths
+  included — as JSON.  ``curl $url/profile?sec=1`` then load the
+  ``trace.json.gz`` (or the merged ``obs.report --export-trace``
+  artifact) in https://ui.perfetto.dev.
 
 Arming: ``HYPEROPT_TPU_OBS_HTTP=<port>`` or ``fmin(obs_http=<port>)``
 (``obs_http=0`` binds an ephemeral port — read it back from
@@ -392,7 +399,7 @@ def _make_handler(server):
             self.wfile.write(data)
 
         def do_GET(self):  # noqa: N802 (stdlib handler contract)
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             try:
                 if path == "/metrics":
                     self._send(prometheus_text(),
@@ -403,9 +410,12 @@ def _make_handler(server):
                                "application/json")
                 elif path == "/events":
                     self._sse()
+                elif path == "/profile":
+                    self._profile(query)
                 elif path == "/":
                     self._send(
-                        "hyperopt_tpu obs: /metrics /snapshot /events\n",
+                        "hyperopt_tpu obs: /metrics /snapshot /events "
+                        "/profile?sec=N\n",
                         "text/plain")
                 else:
                     self.send_error(404)
@@ -419,6 +429,30 @@ def _make_handler(server):
                     self.send_error(500)
                 except Exception:
                     pass
+
+        def _profile(self, query):
+            """``GET /profile?sec=N``: one bounded on-demand device capture
+            (obs/profiler.py), run synchronously on THIS handler thread —
+            the run keeps ticking while the profiler session records it.
+            Fail-open contract: a disarmed profiler plane, a busy session,
+            or a backend without profiler support all answer structured
+            JSON with ``ok: false`` (HTTP 200 — the failure is in-band so
+            ``curl | jq`` scripting stays one code path), never a raised
+            exception into the run."""
+            from urllib.parse import parse_qs
+
+            params = parse_qs(query or "")
+            sec = (params.get("sec") or ["3"])[0]
+            prof = getattr(server.obs, "profiler", None)
+            if prof is None:
+                body = {"ok": False,
+                        "error": "profiler plane not armed — set "
+                                 "HYPEROPT_TPU_PROFILE=<dir> or "
+                                 "fmin(profile=<dir>)"}
+            else:
+                body = prof.capture(sec, reason="http")
+            self._send(json.dumps(body, default=str, sort_keys=True),
+                       "application/json")
 
         def _sse(self):
             sub = _BROADCAST.subscribe()

@@ -234,6 +234,23 @@ def render_frame(sources, histories, now=None):
             elif in_use is not None:
                 line += f"  hbm {_fmt_bytes(in_use)}"
         out.append(line)
+        # the kernel-attribution headline: which program owns the ask —
+        # the hottest roofline row (by measured execute time) with its
+        # achieved FLOP/s and share of the suggest phase
+        roof = sections.get("roofline") or {}
+        hot = max((r for r in roof.items() if r[1].get("dispatches")),
+                  key=lambda r: r[1].get("execute_sec_total", 0.0),
+                  default=None)
+        if hot is not None:
+            st, r = hot
+            rline = (f"  {'':<{w}}  hot kernel {st} x{r['dispatches']}"
+                     f"  {_fmt_sec(r.get('execute_sec_total'))}")
+            gf = r.get("achieved_flops_per_sec")
+            if gf:
+                rline += f"  {gf / 1e9:.2f} GF/s"
+            if r.get("pct_of_ask") is not None:
+                rline += f"  {r['pct_of_ask'] * 100:.0f}% of ask"
+            out.append(rline)
         beats = snap.get("last_heartbeats") or {}
         if beats:
             newest = min(beats.values(),

@@ -1,0 +1,303 @@
+"""Append-only perf-trajectory store: the repo's bench history as data.
+
+``BENCH_r01..r05.json`` record what each PR's bench run printed, but as
+opaque blobs: the *trajectory* — did ``ask_p50_ms`` creep up over six
+PRs, did ``sharded_cand_per_sec`` keep scaling — was unanswerable without
+re-reading five JSON tails by hand.  This module gives every bench run a
+durable, machine-readable record:
+
+* ``.obs/trajectory.jsonl`` — one JSONL record per bench run (schema
+  below), append-only, committed to the repo so the history travels with
+  the code.  Torn final lines (a killed bench) are tolerated by every
+  reader via :func:`~hyperopt_tpu.obs.trace.iter_jsonl`.
+* :func:`record_from_bench_json` backfills the checked-in ``BENCH_r*``
+  artifacts; ``python -m hyperopt_tpu.obs.trajectory backfill`` seeds the
+  store from day one.
+* ``bench.py`` calls :func:`append` after every run, stamping the current
+  git revision and mesh/dtype config next to the headline keys.
+* ``python -m hyperopt_tpu.obs.report --trend`` renders the per-key
+  sparkline history; ``scripts/bench_gate.py`` gates new runs against the
+  windowed median of the stored history (direction-aware — see
+  :data:`KEY_DIRECTIONS`) instead of a single baseline file.
+
+Record schema (one line of ``.obs/trajectory.jsonl``)::
+
+    {"kind": "bench", "ts": <epoch>, "round": <int|None>,
+     "source": "BENCH_r04.json" | "bench.py",
+     "git_rev": "<short sha>|None", "backend": "tpu|cpu|...",
+     "config": {"n_devices": ..., "hist_dtype": ..., "shard": ...},
+     "keys": {<scalar metric>: <float>, ...},
+     "series": {<tail metric>: [<float>, ...], ...}}
+
+``keys`` holds one representative value per metric, and only TRUSTED
+ones: live bench runs name theirs exactly
+(``record_from_headline(keys_override=...)`` — bench.py knows which
+stage is the TPE loop); backfilled rounds keep tail metrics in
+``series`` only (a recorded tail's first occurrence can name a
+different stage, so promoting it to the shared key would poison the
+windowed median).  ``series`` keeps every occurrence for metrics that
+legitimately repeat (``sharded_cand_per_sec`` per shard count,
+``ask_p50_ms`` for tpe then rand), compared positionally by the gate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+import subprocess
+import sys
+import time
+
+from .trace import iter_jsonl
+
+__all__ = [
+    "KEY_DIRECTIONS",
+    "TRAJECTORY_PATH",
+    "append",
+    "load",
+    "git_rev",
+    "record_from_bench_json",
+    "record_from_headline",
+    "backfill",
+    "trajectory_path",
+]
+
+logger = logging.getLogger(__name__)
+
+#: repo-relative location of the store (one dir for every obs artifact the
+#: repo commits, so ``.obs/`` can grow siblings later)
+TRAJECTORY_PATH = os.path.join(".obs", "trajectory.jsonl")
+
+#: Direction metadata for every gated trajectory key: which way is a
+#: REGRESSION, and the default allowed relative change vs the windowed
+#: median (shared-hardware noise makes tails loose — see bench_gate.py).
+#: This table is the single source for ``scripts/bench_gate.py`` and the
+#: ``--trend`` renderer (an unknown key renders but never gates).
+KEY_DIRECTIONS = {
+    "value": {"direction": "higher", "threshold": 0.20},
+    "vs_baseline": {"direction": "higher", "threshold": 0.35},
+    "trials_per_sec": {"direction": "higher", "threshold": 0.20},
+    "candidates_per_sec": {"direction": "higher", "threshold": 0.20},
+    "cv_fits_per_sec": {"direction": "higher", "threshold": 0.20},
+    "sharded_cand_per_sec": {"direction": "higher", "threshold": 0.20},
+    "ask_p50_ms": {"direction": "lower", "threshold": 0.35},
+    "ask_p95_ms": {"direction": "lower", "threshold": 0.50},
+    "ask_p99_ms": {"direction": "lower", "threshold": 1.00},
+    "peak_hbm_bytes": {"direction": "lower", "threshold": 0.30},
+    "history_bytes": {"direction": "lower", "threshold": 0.10},
+    # armed-but-idle profiler plane vs off (bench.py profiler_overhead
+    # stage).  The bar catches a plane that stopped being idle (an
+    # accidental always-on session or capture thread costs tens of
+    # percent), not single-digit drift: the stage's min-of-3 wall clock
+    # swings ±15-20% run-to-run on shared/single-core hardware (the
+    # committed round measured -0.167), so anything tighter gates noise.
+    "profiler_overhead_frac": {"direction": "lower", "threshold": 0.35,
+                               "absolute": True},
+}
+
+#: metrics mined from a bench round's recorded output tail (the same
+#: regex bench_gate has always used — the JSON detail block is printed to
+#: stderr and only its tail survives in BENCH_r*.json)
+TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
+                "sharded_cand_per_sec",
+                "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
+                "peak_hbm_bytes", "history_bytes",
+                "profiler_overhead_frac")
+
+
+def trajectory_path(root=None):
+    """Absolute store path under ``root`` (default: the repo root, two
+    levels above this file)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, TRAJECTORY_PATH)
+
+
+def git_rev(root=None):
+    """Short git revision of ``root``, or None (a store consumer must
+    never require git to be present)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=root or os.getcwd(), capture_output=True, text=True,
+            timeout=10)
+    except Exception:
+        return None
+    rev = (out.stdout or "").strip()
+    return rev if out.returncode == 0 and rev else None
+
+
+def load(path=None):
+    """Every parseable ``kind="bench"`` record in the store, oldest
+    first.  Torn lines (a bench killed mid-append) warn and skip via
+    ``iter_jsonl`` — one partial record must never blind the gate to the
+    rest of the history.  Filtering by kind here keeps every consumer
+    (the gate, ``--trend``) sane when pointed at the wrong JSONL — a
+    telemetry stream renders as an empty store, not thousands of header
+    rows."""
+    path = path or trajectory_path()
+    if not os.path.exists(path):
+        return []
+    return [r for r in iter_jsonl(path)
+            if isinstance(r, dict) and r.get("kind") == "bench"]
+
+
+def append(record, path=None):
+    """Append one record (a single JSON line + flush) and return the path.
+    Append-only by design: the store is a history, and rewriting history
+    is exactly the failure mode a regression gate exists to prevent."""
+    path = path or trajectory_path()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record, default=float, sort_keys=True) + "\n")
+        f.flush()
+    return path
+
+
+def _mine_tail(tail):
+    """``{metric: [occurrences]}`` from a recorded output tail."""
+    series = {}
+    for name in TAIL_METRICS:
+        vals = re.findall(rf'"{name}":\s*(-?[0-9][0-9.eE+-]*)', tail or "")
+        if vals:
+            series[name] = [float(v) for v in vals]
+    return series
+
+
+def _split_keys(parsed, series, tail_fallback=True):
+    """Scalar key dict for a record: the parsed headline values, plus —
+    when ``tail_fallback`` — the first occurrence of each tail metric as
+    a provisional scalar view.  The fallback is ONLY safe for live
+    bench.py records, where ``keys_override`` replaces it with exactly
+    named figures before the record is stored; backfilled rounds must
+    NOT use it, because a recorded tail's first occurrence can name a
+    different stage than the live runs' representative (r02's first
+    ``candidates_per_sec`` is the numpy baseline) — storing it under the
+    same key would let a real TPE-loop regression hide behind a
+    baseline-level median."""
+    keys = {}
+    for k in ("value", "vs_baseline"):
+        v = (parsed or {}).get(k)
+        if isinstance(v, (int, float)):
+            keys[k] = float(v)
+    if tail_fallback:
+        for name, vals in series.items():
+            keys.setdefault(name, vals[0])
+    return keys
+
+
+def record_from_bench_json(path):
+    """A trajectory record backfilled from one checked-in ``BENCH_r*.json``
+    artifact (the driver's ``{n, cmd, rc, tail, parsed}`` shape).  Rounds
+    that crashed (``rc != 0``, ``parsed: null``) still record — an empty
+    round is part of the trajectory, and the gate skips keys it lacks."""
+    with open(path) as f:
+        rec = json.load(f)
+    m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    parsed = rec.get("parsed") or {}
+    series = _mine_tail(rec.get("tail"))
+    return {
+        "kind": "bench",
+        "ts": os.path.getmtime(path),
+        "round": int(m.group(1)) if m else None,
+        "source": os.path.basename(path),
+        "git_rev": None,  # the artifact predates the store; unknowable
+        "rc": rec.get("rc"),
+        "backend": parsed.get("backend"),
+        "config": {},
+        "keys": _split_keys(parsed, series, tail_fallback=False),
+        "series": series,
+    }
+
+
+def record_from_headline(headline, detail_tail=None, config=None, root=None,
+                         keys_override=None):
+    """The record ``bench.py`` appends after printing its headline line:
+    the parsed headline dict + metrics mined from the detail JSON it just
+    wrote to stderr, stamped with the live git revision and mesh/dtype
+    config.
+
+    ``keys_override`` replaces the first-tail-occurrence scalar view for
+    metrics the producer can name exactly — bench.py knows which stage is
+    the TPE loop, the regex miner only knows text order (its first
+    ``candidates_per_sec`` hit is the numpy baseline stage, not the
+    headline kernel).  The full ``series`` keeps every occurrence either
+    way."""
+    series = _mine_tail(detail_tail)
+    keys = _split_keys(headline, series)
+    for k, v in (keys_override or {}).items():
+        if isinstance(v, (int, float)):
+            keys[k] = float(v)
+    return {
+        "kind": "bench",
+        "ts": time.time(),
+        "round": None,
+        "source": "bench.py",
+        "git_rev": git_rev(root),
+        "rc": 0,
+        "backend": headline.get("backend"),
+        "config": dict(config or {}),
+        "keys": keys,
+        "series": series,
+    }
+
+
+def backfill(root=None, path=None, force=False):
+    """Seed the store from every ``BENCH_r*.json`` under ``root`` (round
+    order), skipping rounds already present unless ``force``.  Returns the
+    list of rounds appended."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    path = path or trajectory_path(root)
+    have = {r.get("round") for r in load(path)
+            if r.get("round") is not None} if not force else set()
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    appended = []
+    for bench_path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                             key=round_no):
+        rec = record_from_bench_json(bench_path)
+        if rec["round"] in have:
+            continue
+        append(rec, path)
+        appended.append(rec["round"])
+    return appended
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.obs.trajectory",
+        description="Manage the append-only bench trajectory store "
+                    "(.obs/trajectory.jsonl).")
+    p.add_argument("cmd", choices=("backfill", "show"),
+                   help="backfill: seed from BENCH_r*.json; show: dump "
+                        "the stored records")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--path", default=None, help="store path override")
+    p.add_argument("--force", action="store_true",
+                   help="backfill rounds even if already present")
+    args = p.parse_args(argv)
+    if args.cmd == "backfill":
+        rounds = backfill(root=args.root, path=args.path, force=args.force)
+        print(f"backfilled rounds: {rounds or 'none (all present)'}")
+        return 0
+    for rec in load(args.path or trajectory_path(args.root)):
+        print(json.dumps(rec, sort_keys=True, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

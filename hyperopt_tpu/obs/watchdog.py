@@ -78,6 +78,11 @@ class Watchdog:
         self.max_stack_frames = int(max_stack_frames)
         self._beats = {}  # component -> (mono ts, wall ts, detail dict|None)
         self._sinks = []
+        # stall-escalation hooks (obs/profiler.py registers a bounded
+        # once-per-run device capture): run AFTER the stall record is
+        # emitted, on the watchdog thread, each wrapped so an escalation
+        # failure can never take the detector down with it
+        self._escalations = []
         self._lock = threading.Lock()
         self._thread = None
         self._stop = threading.Event()
@@ -141,6 +146,23 @@ class Watchdog:
         with self._lock:
             if sink in self._sinks:
                 self._sinks.remove(sink)
+
+    # -- escalations -------------------------------------------------------
+
+    def add_escalation(self, fn):
+        """Register a callable run with the stall record after each stall
+        report — the profiler plane's hook for "hangs die with a device
+        trace".  Escalations run on the watchdog thread (the stalled main
+        thread may be wedged inside the very call being diagnosed) and are
+        individually exception-guarded."""
+        with self._lock:
+            if fn not in self._escalations:
+                self._escalations.append(fn)
+
+    def remove_escalation(self, fn):
+        with self._lock:
+            if fn in self._escalations:
+                self._escalations.remove(fn)
 
     # -- detection ---------------------------------------------------------
 
@@ -225,6 +247,13 @@ class Watchdog:
             f"{newest:.0f}s" if newest is not None else "?",
             newest_comp or "?",
             ", ".join(sorted(beats)) or "none", hint)
+        with self._lock:
+            escalations = list(self._escalations)
+        for fn in escalations:
+            try:
+                fn(rec)
+            except Exception:  # an escalation must never kill the detector
+                logger.exception("stall escalation %r failed", fn)
 
     # -- thread lifecycle --------------------------------------------------
 

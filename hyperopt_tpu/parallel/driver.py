@@ -510,7 +510,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         obs.devmem_sample()
         B = min(batch, max_evals - n_done)
         gseed = _gen_seed(seed, gen)
-        with obs.span("propose", gen=gen):
+        # generation annotation (obs/profiler.py): a device capture
+        # overlapping this generation's propose shows its kernels
+        # attributed to (generation, controller) on the device timeline
+        with obs.annotate("driver.gen", step=gen, gen=gen,
+                          n_done=n_done, pid=pid), \
+                obs.span("propose", gen=gen):
             if n_done < n_startup:
                 # deterministic in (gseed, index): every process computes
                 # the whole startup batch locally, no exchange needed

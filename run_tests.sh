@@ -20,11 +20,17 @@
 # sharded-equivalence suite (mesh shapes {1,2,4,8} bit-identical to
 # single-chip, replicated AND capacity-sharded history) plus the scaling
 # smoke (scripts/shard_smoke.py).
+# Opt-in profile gate: PROFILE_GATE=1 additionally runs the real
+# CPU-backend device-capture round trip — /profile?sec=1 against a live
+# run, merge the capture artifact with the host spans, validate the
+# merged trace (scripts/validate_trace.py --profile-self-test).
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
 if [ "${BENCH_GATE:-0}" = "1" ]; then
-    python scripts/bench_gate.py || exit 1
+    # windowed mode imports hyperopt_tpu (for the direction table), which
+    # can pull jax in — scrub the env like every other gate
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_gate.py || exit 1
 fi
 if [ "${TRACE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --self-test || exit 1
@@ -43,5 +49,8 @@ if [ "${SHARD_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
         python -m pytest tests/test_sharding.py tests/test_shard_suggest.py -q || exit 1
     python scripts/shard_smoke.py || exit 1
+fi
+if [ "${PROFILE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --profile-self-test || exit 1
 fi
 exit 0

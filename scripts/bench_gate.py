@@ -1,32 +1,36 @@
 """Perf-regression gate over the repo's bench trajectory.
 
-Compares the newest ``BENCH_r*.json`` against the previous one with
-per-metric relative thresholds and exits non-zero on a regression, so a PR
-that quietly slows the hot path fails loudly instead of shipping.  Opt in
-from the test runner with ``BENCH_GATE=1 ./run_tests.sh``.
+**Windowed mode (default when the trajectory store exists).**  The
+append-only store ``.obs/trajectory.jsonl`` (obs/trajectory.py — bench.py
+appends one record per run; ``python -m hyperopt_tpu.obs.trajectory
+backfill`` seeds it from the checked-in ``BENCH_r*.json``) holds one
+record per bench run.  The gate compares the NEWEST record against the
+**median of the previous K runs** (``--window``, default 5), per key,
+with explicit direction metadata from
+``hyperopt_tpu.obs.trajectory.KEY_DIRECTIONS`` — higher-is-better
+throughputs gate the allowed relative drop, lower-is-better
+latency/memory keys gate the allowed relative rise, and absolute keys
+(``profiler_overhead_frac``) gate the raw value against a FIXED bar
+(median-relative would ratchet).  A windowed median
+is robust to the single noisy round that a pairwise newest-vs-previous
+compare mistakes for a regression (or, worse, adopts as the new
+baseline).  Keys the direction table doesn't know are recorded but never
+gate.  History is **backend-matched**: the newest record only gates
+against stored runs with the same ``backend`` (a CPU dev-box run neither
+fails against nor poisons the TPU history; with no same-backend history
+every key records as "no history yet" and the gate passes).
 
-What gets compared (all higher-is-better throughputs):
+**Legacy mode** (``--legacy``, or automatically when the store is missing
+or holds fewer than two records) compares the newest ``BENCH_r*.json``
+against the previous one, exactly the pre-windowed behavior.
 
-* the headline ``parsed`` record — ``value`` (candidates/sec) and
-  ``vs_baseline`` — always, when both rounds carry one;
-* stage-level throughput sequences (``trials_per_sec``,
-  ``candidates_per_sec``, ``cv_fits_per_sec``) regex-mined from the
-  recorded output tail, compared positionally ONLY when both rounds report
-  the same number of occurrences (a round that adds or drops a stage would
-  otherwise misalign the comparison — those names are skipped with a note
-  instead of guessed at);
-* lower-is-better latency/memory keys (``ask_p*_ms`` from the ask_latency
-  stage, ``peak_hbm_bytes``/``history_bytes`` from the devmem stage) gated
-  on the allowed relative RISE instead.
-
-The no-baseline case (fewer than two ``BENCH_r*.json`` — a fresh repo with
-an empty bench trajectory) records what the newest round reports and
-passes: the gate's job is to compare rounds, not to manufacture one.
+Opt in from the test runner with ``BENCH_GATE=1 ./run_tests.sh``.  The
+no-history case records what the newest round reports and passes: the
+gate's job is to compare runs, not to manufacture one.
 
 Shared-hardware noise note: these benches run on a tunneled, contended
-chip; the default 20% threshold (35% for ``vs_baseline``, whose numpy
-denominator is itself noisy) is deliberately loose.  Override per run with
-``--threshold``.
+chip; the default thresholds (20% throughputs, 35-100% latency tails) are
+deliberately loose.  Override per run with ``--threshold``.
 """
 
 from __future__ import annotations
@@ -36,7 +40,17 @@ import glob
 import json
 import os
 import re
+import statistics
 import sys
+
+# the gate must never claim the ambient TPU: force CPU before any
+# hyperopt_tpu import can pull jax in
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 # metric-name → allowed relative drop (new >= prev * (1 - threshold));
 # for the LOWER_IS_BETTER latency metrics the same threshold bounds the
@@ -144,17 +158,180 @@ def compare(prev, new, thresholds):
     return regressions, notes
 
 
+def windowed_compare(history, new, directions, window=5, override=None):
+    """Newest trajectory record vs the windowed median of its history.
+
+    ``history``/``new`` are obs/trajectory.py record dicts (oldest-first
+    history, excluding ``new``).  ``directions`` is the
+    ``KEY_DIRECTIONS`` table: ``{key: {direction, threshold[, absolute]}}``
+    — an unknown key is recorded in the notes but never gates.  Returns
+    ``(regressions, notes)``.
+    """
+    regressions, notes = [], []
+    hist = history[-window:]
+
+    def check(label, key, nv, values):
+        meta = directions.get(key)
+        if meta is None:
+            notes.append(f"{label}: {nv:.6g}  (ungated key, recorded only)")
+            return
+        thr = override if override is not None else meta["threshold"]
+        direction = meta.get("direction", "higher")
+        if meta.get("absolute"):
+            # FIXED bar, not median-relative: an overhead fraction gated
+            # vs its own history would ratchet (~thr per window shift)
+            # instead of staying pinned at the documented absolute bound.
+            # Needs no history, so it gates from the very first run.
+            lo, hi = -thr, thr
+            bound_txt = f"fixed bar ±{thr:.6g} (absolute)"
+        else:
+            med = statistics.median(values)
+            if med == 0:
+                # a zero median (e.g. history_bytes on a backend where
+                # memory_stats is None) makes every relative bound
+                # degenerate — any nonzero value would gate regardless of
+                # threshold, so going from unmeasured-zero to measured
+                # must record, not fail
+                notes.append(f"{label}: {nv:.6g}  (history median is 0 — "
+                             "relative bound undefined, recording only)")
+                return
+            lo, hi = med * (1.0 - thr), med * (1.0 + thr)
+            bound_txt = f"median {med:.6g} ± {thr:.0%}"
+        if direction == "higher" and nv < lo:
+            regressions.append(
+                f"{label}: {nv:.6g} < {lo:.6g}  [{bound_txt} over "
+                f"{len(values)} run(s), higher=better]")
+        elif direction == "lower" and nv > hi:
+            regressions.append(
+                f"{label}: {nv:.6g} > {hi:.6g}  [{bound_txt} over "
+                f"{len(values)} run(s), lower=better]")
+        else:
+            notes.append(f"{label}: {nv:.6g}  ok vs {bound_txt} "
+                         f"({len(values)} run(s), {direction}=better)")
+
+    # every scalar key gates against the windowed median of whatever
+    # history carries it: the headline values (value, vs_baseline) and
+    # each tail metric's representative view (bench.py names its own
+    # exactly via keys_override; backfilled rounds fall back to first
+    # tail occurrence — noisier, but the median absorbs a mislabeled
+    # round where skipping would mean the key NEVER gates, since real
+    # histories rarely keep identical series shapes across PRs for the
+    # positional pass below)
+    new_series = new.get("series") or {}
+    for key, nv in sorted((new.get("keys") or {}).items()):
+        if not isinstance(nv, (int, float)):
+            continue
+        values = [(r.get("keys") or {}).get(key) for r in hist]
+        values = [v for v in values if isinstance(v, (int, float))]
+        if not values and not (directions.get(key) or {}).get("absolute"):
+            # absolute fixed-bar keys gate even without history
+            notes.append(f"{key}: {nv:.6g}  (no history yet, recording)")
+            continue
+        check(key, key, nv, values)
+    # tail-mined / repeating metrics (one occurrence per shard count, per
+    # algo, per stage): windowed per position, over history runs with the
+    # SAME occurrence count — a run that added or dropped a stage (or a
+    # differently-truncated recorded tail) never misaligns the gate
+    for key, nseq in sorted(new_series.items()):
+        if not isinstance(nseq, list) or not nseq:
+            continue
+        if len(nseq) == 1 and key in (new.get("keys") or {}):
+            # the scalar pass above already gated this key, possibly
+            # against a DIFFERENT value (keys_override names the
+            # representative; the tail miner only knows text order) —
+            # a second verdict under the identical label would be
+            # untraceable
+            continue
+        hseqs = [(r.get("series") or {}).get(key) for r in hist]
+        hseqs = [s for s in hseqs
+                 if isinstance(s, list) and len(s) == len(nseq)]
+        if not hseqs:
+            if (directions.get(key) or {}).get("absolute"):
+                # fixed-bar keys need no history: gate each occurrence
+                for i in range(len(nseq)):
+                    label = f"{key}[{i}]" if len(nseq) > 1 else key
+                    check(label, key, nseq[i], [])
+                continue
+            notes.append(f"{key}: occurrence count {len(nseq)} has no "
+                         "matching history, skipping positional gate")
+            continue
+        for i in range(len(nseq)):
+            label = f"{key}[{i}]" if len(nseq) > 1 else key
+            check(label, key, nseq[i], [s[i] for s in hseqs])
+    return regressions, notes
+
+
+def _windowed_main(store, window, override):
+    """Gate the store's newest record against its windowed history.
+    Returns an exit code, or None to fall back to legacy mode."""
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS, load
+
+    records = [r for r in load(store) if r.get("kind") == "bench"]
+    if len(records) < 2:
+        return None  # not enough trajectory: legacy pairwise compare
+    new, history = records[-1], records[:-1]
+    # throughput/latency figures are only comparable on the same backend:
+    # a CPU dev-box run must not gate against (or poison the median of)
+    # the TPU history.  No same-backend history → every key records as
+    # "no history yet" and the gate passes, building the new backend's
+    # window from here.
+    backend = new.get("backend")
+    skipped = len(history)
+    history = [r for r in history if r.get("backend") == backend]
+    skipped -= len(history)
+    regressions, notes = windowed_compare(
+        history, new, KEY_DIRECTIONS, window=window, override=override)
+    n_win = min(window, len(history))
+    print(f"bench gate (windowed): {new.get('source', '?')} "
+          f"vs median of last {n_win} of {len(history)} "
+          f"backend={backend or '?'} run(s)"
+          + (f" ({skipped} other-backend run(s) excluded)" if skipped
+             else "")
+          + f" [{os.path.relpath(store)}]")
+    for line in notes:
+        print("  " + line)
+    if regressions:
+        print("bench gate: REGRESSION", file=sys.stderr)
+        for line in regressions:
+            print("  " + line, file=sys.stderr)
+        return 1
+    if not notes:
+        print("  (newest record carries no gateable keys)")
+    print("bench gate: ok")
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python scripts/bench_gate.py",
-        description="Fail on a perf regression between the two newest "
-                    "BENCH_r*.json rounds.")
+        description="Fail on a perf regression: newest bench run vs the "
+                    "windowed median of the trajectory store (fallback: "
+                    "the two newest BENCH_r*.json rounds).")
     p.add_argument("--dir", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))),
         help="directory holding BENCH_r*.json (default: repo root)")
     p.add_argument("--threshold", type=float, default=None,
                    help="override every per-metric relative threshold")
+    p.add_argument("--store", default=None,
+                   help="trajectory store path (default: "
+                        "<dir>/.obs/trajectory.jsonl)")
+    p.add_argument("--window", type=int, default=5,
+                   help="windowed mode: how many prior runs feed the "
+                        "median (default 5)")
+    p.add_argument("--legacy", action="store_true",
+                   help="force the pairwise newest-vs-previous "
+                        "BENCH_r*.json compare")
     args = p.parse_args(argv)
+
+    if not args.legacy:
+        store = args.store or os.path.join(args.dir, ".obs",
+                                           "trajectory.jsonl")
+        if os.path.exists(store):
+            rc = _windowed_main(store, args.window, args.threshold)
+            if rc is not None:
+                return rc
+            print("bench gate: trajectory store has <2 records; falling "
+                  "back to the pairwise BENCH_r*.json compare")
 
     thresholds = dict(DEFAULT_THRESHOLDS)
     if args.threshold is not None:

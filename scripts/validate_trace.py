@@ -15,6 +15,22 @@ Checked invariants — the contract a trace viewer actually relies on:
   ``ts`` file order (the exporter sorts; a violation means a broken merge);
 * metadata (``M``) events precede all others (the exporter's layout).
 
+Merged host+device artifacts (``obs.report --export-trace`` folds
+``jax.profiler`` captures from obs/profiler.py into the host spans) add
+three invariants:
+
+* **track-group naming** — every ``pid`` that carries timeline events has
+  a ``process_name`` metadata record (an unnamed device track group means
+  the capture merge dropped its synthesized name);
+* **counter-track monotonicity** — per ``(pid, tid, counter name)``,
+  ``C`` events appear in non-decreasing ``ts`` order and every counter
+  arg is numeric (an interleaved counter series plots as garbage);
+* **annotation ids present** — device-timeline events named for the loop
+  boundaries (``fmin.tick``, ``device.chunk``, ``driver.gen``) must carry
+  their trial/generation ids, either as ``args`` or TraceMe-encoded in
+  the name (``name#k=v#``) — a bare annotation means the id plumbing
+  broke and kernels can no longer be attributed.
+
 Exit 0 when every input validates, 1 otherwise, 2 on unreadable input.
 
 ``--self-test`` runs the whole pipeline end to end on CPU: a tiny armed
@@ -22,6 +38,12 @@ two-controller run (the ``fmin_multihost`` per-controller stream naming),
 ``obs.report --export-trace`` over the merged streams, then validation —
 the opt-in CI gate ``TRACE_GATE=1 ./run_tests.sh`` wires this in next to
 ``bench_gate.py``.
+
+``--profile-self-test`` is the device-capture round trip (``PROFILE_GATE=1
+./run_tests.sh``): a child ``fmin`` runs with the capture plane + scrape
+server armed, the parent triggers ``GET /profile?sec=1`` MID-RUN, and the
+resulting artifact must merge with the host spans into a trace this
+script accepts — device track groups, naming, annotations and all.
 """
 
 from __future__ import annotations
@@ -32,6 +54,13 @@ import sys
 
 _KNOWN_PH = {"X", "i", "I", "B", "E", "M", "C"}
 
+#: the loop-boundary annotation names obs/profiler.py stamps onto the
+#: device timeline — events with these names must carry trial/generation
+#: ids (as ``args`` or TraceMe-encoded ``name#k=v#``) or kernel
+#: attribution is broken
+ANNOTATION_NAMES = {"fmin.tick", "fmin.tick.speculative",
+                    "device.chunk", "driver.gen"}
+
 
 def validate_events(events):
     """Return a list of human-readable violations (empty = valid)."""
@@ -39,7 +68,10 @@ def validate_events(events):
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
     last_ts = {}  # (pid, tid) -> last seen ts
+    counter_ts = {}  # (pid, tid, counter name) -> last seen ts
     begin_stack = {}  # (pid, tid) -> [names]
+    named_pids = set()  # pids with a process_name metadata record
+    event_pids = set()  # pids carrying timeline events
     seen_non_meta = False
     for i, e in enumerate(events):
         where = f"event[{i}]"
@@ -53,6 +85,12 @@ def validate_events(events):
         if ph == "M":
             if seen_non_meta:
                 errors.append(f"{where}: metadata after timeline events")
+            if e.get("name") == "process_name" and isinstance(
+                    e.get("pid"), int):
+                if not (e.get("args") or {}).get("name"):
+                    errors.append(f"{where}: empty process_name for "
+                                  f"pid={e['pid']}")
+                named_pids.add(e["pid"])
             continue
         seen_non_meta = True
         pid, tid, ts = e.get("pid"), e.get("tid"), e.get("ts")
@@ -62,6 +100,7 @@ def validate_events(events):
         if not isinstance(ts, (int, float)) or ts < 0:
             errors.append(f"{where}: bad ts {ts!r}")
             continue
+        event_pids.add(pid)
         track = (pid, tid)
         prev = last_ts.get(track)
         if prev is not None and ts < prev:
@@ -69,12 +108,34 @@ def validate_events(events):
                 f"{where}: ts goes backwards on track pid={pid} tid={tid} "
                 f"({ts} < {prev})")
         last_ts[track] = ts
+        name = e.get("name")
+        if ph == "C":
+            # counter tracks share a tid but each NAME is its own series:
+            # per-series ts must be monotone and every value numeric
+            ctrack = (pid, tid, name)
+            cprev = counter_ts.get(ctrack)
+            if cprev is not None and ts < cprev:
+                errors.append(
+                    f"{where}: counter {name!r} ts goes backwards on "
+                    f"pid={pid} tid={tid} ({ts} < {cprev})")
+            counter_ts[ctrack] = ts
+            for k, v in (e.get("args") or {}).items():
+                if not isinstance(v, (int, float)):
+                    errors.append(
+                        f"{where}: counter {name!r} arg {k!r} is "
+                        f"non-numeric ({v!r})")
         if ph == "X":
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 errors.append(f"{where}: X event with bad dur {dur!r}")
+            base = (name or "").split("#", 1)[0]
+            if base in ANNOTATION_NAMES and "#" not in (name or "") \
+                    and not e.get("args"):
+                errors.append(
+                    f"{where}: annotation {base!r} carries no ids "
+                    "(no args, no TraceMe-encoded metadata)")
         elif ph == "B":
-            begin_stack.setdefault(track, []).append(e.get("name"))
+            begin_stack.setdefault(track, []).append(name)
         elif ph == "E":
             stack = begin_stack.get(track)
             if not stack:
@@ -87,6 +148,9 @@ def validate_events(events):
         for name in stack:
             errors.append(
                 f"unclosed B event {name!r} on track pid={pid} tid={tid}")
+    for pid in sorted(event_pids - named_pids):
+        errors.append(f"pid={pid} carries timeline events but no "
+                      "process_name metadata (unnamed track group)")
     return errors
 
 
@@ -156,6 +220,151 @@ def _self_test():
         return 0
 
 
+_PROFILE_CHILD = r"""
+import os, sys, time
+import numpy as np
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import rand
+
+url_file, stream, cap_dir, stop_file = sys.argv[1:5]
+t = Trials()
+
+state = {"written": False}
+def objective(d):
+    if not state["written"]:
+        with open(url_file + ".tmp", "w") as f:
+            f.write(t.obs_http_url or "DISABLED")
+        os.replace(url_file + ".tmp", url_file)
+        state["written"] = True
+    time.sleep(0.05)
+    # the run stays demonstrably live until the parent finished its
+    # capture: the stop file flips the loss under loss_threshold
+    if os.path.exists(stop_file):
+        return -1.0
+    return 1.0 + (d["x"] - 1.0) ** 2
+
+fmin(objective, {"x": hp.uniform("x", -5, 5)}, algo=rand.suggest,
+     max_evals=100000, loss_threshold=0.0, trials=t,
+     rstate=np.random.default_rng(0), show_progressbar=False,
+     obs=stream, obs_http=0, profile=cap_dir)
+print("CHILD_DONE")
+"""
+
+
+def _profile_self_test():
+    """The device-capture round trip: ``/profile?sec=1`` against a live
+    CPU-backend run, then the capture must merge with the host spans into
+    a trace that validates — including the device track-group naming and
+    annotation-id lint."""
+    import os
+    import subprocess
+    import tempfile
+    import time
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    with tempfile.TemporaryDirectory() as d:
+        url_file = os.path.join(d, "url")
+        stream = os.path.join(d, "run.jsonl")
+        cap_dir = os.path.join(d, "captures")
+        stop_file = os.path.join(d, "stop")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROFILE_CHILD, url_file, stream,
+             cap_dir, stop_file],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        try:
+            deadline = time.time() + 180
+            while not os.path.exists(url_file):
+                if proc.poll() is not None or time.time() > deadline:
+                    out, err = proc.communicate(timeout=10)
+                    print("profile self-test: child died before serving:\n"
+                          + err[-2000:], file=sys.stderr)
+                    return 1
+                time.sleep(0.05)
+            with open(url_file) as f:
+                url = f.read().strip()
+            if url == "DISABLED":
+                print("profile self-test: scrape server failed open",
+                      file=sys.stderr)
+                return 1
+            # the on-demand capture, against the demonstrably live run
+            # (bounded 1s record time; the xplane->trace conversion on
+            # stop can take a while on a cold backend, hence the generous
+            # HTTP timeout — the run keeps ticking throughout)
+            try:
+                with urllib.request.urlopen(url + "/profile?sec=1",
+                                            timeout=300) as r:
+                    cap = json.loads(r.read().decode())
+            except Exception as e:
+                print(f"profile self-test: /profile request failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return 1
+            if not cap.get("ok"):
+                print("profile self-test: /profile failed: "
+                      f"{cap.get('error')}", file=sys.stderr)
+                return 1
+            if not cap.get("trace_json") or not os.path.exists(
+                    cap["trace_json"]):
+                print("profile self-test: capture produced no "
+                      f"trace.json.gz artifact under {cap.get('dir')}",
+                      file=sys.stderr)
+                return 1
+            # capture landed: let the child finish its run cleanly
+            with open(stop_file, "w") as f:
+                f.write("done")
+            out, err = proc.communicate(timeout=180)
+            if "CHILD_DONE" not in out:
+                print("profile self-test: child did not finish cleanly:\n"
+                      + err[-2000:], file=sys.stderr)
+                return 1
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+
+        from hyperopt_tpu.obs import report
+
+        merged = os.path.join(d, "merged_trace.json")
+        rc = report.main(["--export-trace", merged, stream])
+        if rc != 0:
+            print("profile self-test: --export-trace failed",
+                  file=sys.stderr)
+            return 1
+        errors = validate_file(merged)
+        if errors:
+            print("profile self-test: merged trace INVALID:",
+                  file=sys.stderr)
+            for e in errors:
+                print("  " + e, file=sys.stderr)
+            return 1
+        with open(merged) as f:
+            events = json.load(f)["traceEvents"]
+        from hyperopt_tpu.obs.export import DEVICE_PID_BASE
+
+        device_pids = {e["pid"] for e in events
+                       if e.get("ph") != "M"
+                       and e.get("pid", 0) >= DEVICE_PID_BASE}
+        if not device_pids:
+            print("profile self-test: merged trace has no device track "
+                  "group — the capture artifact was not folded in",
+                  file=sys.stderr)
+            return 1
+        n_dev = sum(1 for e in events
+                    if e.get("pid", 0) >= DEVICE_PID_BASE
+                    and e.get("ph") != "M")
+        print(f"profile self-test OK: {len(events)} events, {n_dev} from "
+              f"{len(device_pids)} device track group(s), lint clean")
+        return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python scripts/validate_trace.py",
@@ -164,9 +373,16 @@ def main(argv=None):
     p.add_argument("--self-test", action="store_true",
                    help="generate a merged two-controller run end-to-end "
                         "and validate its export (the CI gate)")
+    p.add_argument("--profile-self-test", action="store_true",
+                   help="end-to-end device-capture round trip: "
+                        "/profile?sec=1 against a live CPU run, merge the "
+                        "artifact with the host spans, validate (the "
+                        "PROFILE_GATE)")
     args = p.parse_args(argv)
     if args.self_test:
         return _self_test()
+    if args.profile_self_test:
+        return _profile_self_test()
     if not args.traces:
         p.error("give trace file(s) or --self-test")
     rc = 0

@@ -461,7 +461,10 @@ def test_export_trace_merged_controllers_validates(tmp_path):
 
 
 def test_validator_rejects_broken_traces():
-    ok = [{"name": "p", "ph": "M", "pid": 0, "tid": 0, "args": {}},
+    # every pid carrying timeline events must be a NAMED track group (the
+    # merged host+device lint) — as the real exporter always emits
+    ok = [{"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+           "args": {"name": "p"}},
           {"name": "a", "ph": "X", "ts": 1.0, "dur": 2.0,
            "pid": 0, "tid": 0}]
     assert validate_trace.validate_events(ok) == []

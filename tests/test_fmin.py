@@ -271,14 +271,30 @@ def test_phase_timings_recorded():
 
 
 def test_jax_profiler_trace_hook(tmp_path, monkeypatch):
-    # HYPEROPT_TPU_PROFILE=<dir> wraps the loop in jax.profiler.trace
-    monkeypatch.setenv("HYPEROPT_TPU_PROFILE", str(tmp_path / "prof"))
+    # HYPEROPT_TPU_PROFILE=full:<dir> wraps the loop in jax.profiler.trace
+    # (the legacy whole-run mode; the bare <dir> form arms the bounded
+    # capture plane instead — obs/profiler.py, tests/test_profiler.py)
+    monkeypatch.setenv("HYPEROPT_TPU_PROFILE",
+                       "full:" + str(tmp_path / "prof"))
     t = Trials()
     fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -5, 5)},
          algo=rand.suggest, max_evals=5, trials=t,
          rstate=np.random.default_rng(0), show_progressbar=False)
     traces = list((tmp_path / "prof").rglob("*"))
     assert traces, "no profiler artifacts written"
+
+
+def test_profile_dir_arms_bounded_plane_not_whole_run(tmp_path, monkeypatch):
+    # the bare-dir form must NOT open a whole-run trace session (it would
+    # starve every on-demand /profile and stall capture for the run's
+    # lifetime) — it arms RunObs.profiler and leaves the loop unwrapped
+    monkeypatch.setenv("HYPEROPT_TPU_PROFILE", str(tmp_path / "cap"))
+    t = Trials()
+    fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -5, 5)},
+         algo=rand.suggest, max_evals=5, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    # no whole-run artifacts; the capture dir stays empty until a capture
+    assert not list((tmp_path / "cap").rglob("*.trace.json.gz"))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,621 @@
+"""Device-profiling plane (hyperopt_tpu/obs/profiler.py) + the merged
+host/device Perfetto export (obs/export.py) + kernel attribution
+(health.roofline_table).
+
+All tier-1 (CPU, fast).  The load-bearing invariants pinned here:
+
+* the DISARMED hot path is untouched — no profile env/kwarg means no new
+  threads, a shared null annotation context, and TPE proposals
+  bit-identical to an armed run's;
+* every capture is BOUNDED (``max_capture_sec`` clamps a typo'd
+  duration) and EXCLUSIVE (a concurrent request reports busy, never
+  raises into the run);
+* the watchdog stall escalation takes exactly ONE bounded capture per
+  run — a six-hour hang produces one device trace, not 72;
+* ``/profile`` fails OPEN: disarmed plane, bad duration, busy session
+  and unsupported backends all answer structured JSON, never a 500 from
+  a raised exception;
+* a capture artifact merges into the host-span export in the reserved
+  device pid range, every track group named, timestamps wall-aligned —
+  and the merged artifact passes scripts/validate_trace.py's lint.
+"""
+
+import gzip
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.obs import ObsConfig, RunObs
+from hyperopt_tpu.obs.export import (DEVICE_PID_BASE, device_trace_events,
+                                     export_trace)
+from hyperopt_tpu.obs.flight import FlightRecorder
+from hyperopt_tpu.obs.health import roofline_table
+from hyperopt_tpu.obs.profiler import (DeviceProfiler, annotation_ctx,
+                                       find_capture_artifact,
+                                       split_profile_mode)
+from hyperopt_tpu.obs.report import main as report_main, render
+from hyperopt_tpu.obs.watchdog import Watchdog
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import validate_trace  # noqa: E402  (scripts/validate_trace.py)
+
+SPACE = {"x": hp.uniform("x", -5, 5), "y": hp.uniform("y", 0, 3)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2 + d["y"]
+
+
+# ---------------------------------------------------------------------------
+# env/kwarg grammar
+# ---------------------------------------------------------------------------
+
+
+def test_split_profile_mode_grammar():
+    assert split_profile_mode("") == (None, None)
+    assert split_profile_mode(None) == (None, None)
+    assert split_profile_mode("  ") == (None, None)
+    assert split_profile_mode("/tmp/caps") == ("/tmp/caps", None)
+    assert split_profile_mode("full:/tmp/trace") == (None, "/tmp/trace")
+    assert split_profile_mode("full:") == (None, None)
+
+
+def test_obsconfig_from_env_routes_profile_modes(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_PROFILE", "/tmp/capdir")
+    cfg = ObsConfig.from_env()
+    assert cfg.profile_dir == "/tmp/capdir" and cfg.profile_full is None
+    monkeypatch.setenv("HYPEROPT_TPU_PROFILE", "full:/tmp/whole")
+    cfg = ObsConfig.from_env()
+    assert cfg.profile_dir is None and cfg.profile_full == "/tmp/whole"
+
+
+# ---------------------------------------------------------------------------
+# bounded, exclusive, fail-open captures
+# ---------------------------------------------------------------------------
+
+
+class _FakeSleep:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, sec):
+        self.calls.append(sec)
+
+
+def _stubbed_profiler(tmp_path, monkeypatch, **kw):
+    """A DeviceProfiler whose jax.profiler session is a no-op and whose
+    capture sleep is recorded, not waited."""
+    import jax.profiler as jp
+
+    monkeypatch.setattr(jp, "start_trace", lambda d: None)
+    monkeypatch.setattr(jp, "stop_trace", lambda: None)
+    sleep = _FakeSleep()
+    prof = DeviceProfiler(str(tmp_path / "caps"), clock=sleep, **kw)
+    return prof, sleep
+
+
+def test_capture_clamps_to_max_duration(tmp_path, monkeypatch):
+    prof, sleep = _stubbed_profiler(tmp_path, monkeypatch,
+                                    max_capture_sec=30.0)
+    rec = prof.capture(3600, reason="ondemand")  # a typo'd hour
+    assert rec["ok"] and rec["sec"] == 30.0
+    assert sleep.calls == [30.0]
+    assert rec["reason"] == "ondemand"
+    assert prof.capture_count == 1
+
+
+def test_capture_rejects_bad_durations(tmp_path, monkeypatch):
+    prof, sleep = _stubbed_profiler(tmp_path, monkeypatch)
+    for bad in ("abc", None, 0, -1):
+        rec = prof.capture(bad)
+        assert not rec["ok"] and "error" in rec
+    assert sleep.calls == []  # nothing ever captured
+    assert prof.capture_count == 0
+
+
+def test_concurrent_capture_reports_busy(tmp_path, monkeypatch):
+    prof, _ = _stubbed_profiler(tmp_path, monkeypatch)
+    with prof._lock:  # a capture is in flight on another thread
+        rec = prof.capture(1)
+    assert not rec["ok"] and "in progress" in rec["error"]
+
+
+def test_unsupported_backend_fails_open_and_warns_once(
+        tmp_path, monkeypatch, caplog):
+    import logging
+
+    import jax.profiler as jp
+
+    def boom(d):
+        raise RuntimeError("profiler not supported on this backend")
+
+    monkeypatch.setattr(jp, "start_trace", boom)
+    prof = DeviceProfiler(str(tmp_path / "caps"), clock=_FakeSleep())
+    with caplog.at_level(logging.WARNING,
+                         logger="hyperopt_tpu.obs.profiler"):
+        r1 = prof.capture(1)
+        r2 = prof.capture(1)
+    assert not r1["ok"] and "RuntimeError" in r1["error"]
+    assert not r2["ok"]
+    warnings = [r for r in caplog.records
+                if "capture unavailable" in r.getMessage()]
+    assert len(warnings) == 1  # once-logged, not per capture
+
+
+def test_real_cpu_capture_roundtrip(tmp_path):
+    """One REAL (tiny) jax.profiler capture on the CPU backend: artifact
+    located, record ok, wall time bounded."""
+    import jax
+    import jax.numpy as jnp
+
+    prof = DeviceProfiler(str(tmp_path / "caps"), max_capture_sec=2.0)
+
+    done = threading.Event()
+
+    def work():
+        # give the capture something to record
+        while not done.is_set():
+            jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+
+    worker = threading.Thread(target=work, daemon=True)
+    worker.start()
+    try:
+        rec = prof.capture(0.3, reason="test")
+    finally:
+        done.set()
+        worker.join()
+    assert rec["ok"], rec.get("error")
+    # the requested duration is clamped (the wall clock additionally pays
+    # one-time profiler init/convert overhead, which is unbounded-ish on a
+    # cold CPU backend — the SLEEP bound is pinned by the fake-clock tests)
+    assert rec["sec"] == 0.3
+    assert rec["trace_json"] and os.path.exists(rec["trace_json"])
+    assert find_capture_artifact(rec["dir"]) == rec["trace_json"]
+    assert prof.captures == [rec]
+
+
+# ---------------------------------------------------------------------------
+# stall escalation: ONE bounded capture per run (fake-clock watchdog)
+# ---------------------------------------------------------------------------
+
+
+def test_profile_on_stall_once_per_run(tmp_path, monkeypatch):
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    wd = Watchdog(quiet_sec=300.0, clock=clock, flight=FlightRecorder())
+    wd.retain()
+    prof, sleep = _stubbed_profiler(tmp_path, monkeypatch,
+                                    stall_capture_sec=5.0)
+    wd.add_escalation(prof.capture_on_stall)
+    wd.beat("fmin.tick", n=1)
+
+    clock.t = 301.0  # first quiet period elapses: stall + ONE capture
+    assert wd.check() is not None
+    assert prof.capture_count == 1
+    assert sleep.calls == [5.0]  # the bounded stall duration
+
+    clock.t = 700.0  # a SECOND stall: no second capture (once per run)
+    assert wd.check() is not None
+    assert prof.capture_count == 1
+    assert sleep.calls == [5.0]
+    assert prof.captures and prof.captures[0]["reason"] == "stall"
+
+
+def test_stall_capture_retries_after_foreign_session_conflict(tmp_path,
+                                                              monkeypatch):
+    """Our lock only covers this DeviceProfiler; jax's one-session limit
+    is process-wide.  A foreign session (another run's profiler, a user's
+    own jax.profiler.trace) makes start_trace raise 'already active' —
+    that must report BUSY (retryable, budget kept), not latch the
+    once-per-run stall budget the way a truly unsupported backend does."""
+    import jax.profiler as jp
+
+    def foreign_conflict(d):
+        raise RuntimeError("Another profiler session is already active.")
+
+    monkeypatch.setattr(jp, "start_trace", foreign_conflict)
+    monkeypatch.setattr(jp, "stop_trace", lambda: None)
+    sleep = _FakeSleep()
+    prof = DeviceProfiler(str(tmp_path / "caps"), clock=sleep)
+    rec = prof.capture_on_stall()
+    assert not rec["ok"] and rec.get("busy")
+    assert not prof._stall_captured  # budget NOT consumed
+    monkeypatch.setattr(jp, "start_trace", lambda d: None)  # session ended
+    rec = prof.capture_on_stall()
+    assert rec["ok"] and prof._stall_captured  # the hang still gets a trace
+
+
+def test_stall_capture_referenced_from_postmortem(tmp_path, monkeypatch):
+    """The whole point of the escalation: a hang's flight dump points at
+    the device trace.  The capture record lands in the process-global
+    flight ring, so a dump written after the stall carries it — and the
+    postmortem renderer surfaces it."""
+    from hyperopt_tpu.obs.flight import get_flight
+    from hyperopt_tpu.obs.report import render_postmortem
+
+    prof, _ = _stubbed_profiler(tmp_path, monkeypatch)
+    fr = get_flight()
+    was = fr.enabled
+    fr.enabled = True
+    try:
+        rec = prof.capture_on_stall()
+    finally:
+        fr.enabled = was
+    assert rec["ok"] and rec["reason"] == "stall"
+    # the ring carries the capture record (tail of a subsequent dump)
+    tail = [r for r in fr.records() if r.get("kind") == "profile"]
+    assert tail and tail[-1]["dir"] == rec["dir"]
+    # and the postmortem renderer points at the artifact
+    dump = [
+        {"kind": "flight_dump", "reason": "SIGTERM",
+         "ts": rec["ts"] + 10.0},
+        dict(tail[-1]),
+    ]
+    text = render_postmortem(dump, name="run.flight.jsonl")
+    assert "device captures" in text
+    assert "stall" in text and rec["dir"] in text
+
+
+def test_watchdog_escalation_failure_never_kills_detector():
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    wd = Watchdog(quiet_sec=10.0, clock=clock, flight=FlightRecorder())
+    wd.retain()
+
+    def bad_escalation(rec):
+        raise RuntimeError("escalation exploded")
+
+    wd.add_escalation(bad_escalation)
+    wd.beat("fmin.tick")
+    clock.t = 11.0
+    assert wd.check() is not None  # the stall still reports
+    wd.remove_escalation(bad_escalation)
+    clock.t = 22.0
+    assert wd.check() is not None
+
+
+# ---------------------------------------------------------------------------
+# /profile endpoint: fail-open contract
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def test_profile_endpoint_not_armed_fails_open():
+    obs = RunObs(ObsConfig(level="basic", http_port=0), run_id="prof-off")
+    try:
+        assert obs.profiler is None
+        body = _get_json(obs.http.url + "/profile?sec=1")
+        assert body["ok"] is False
+        assert "not armed" in body["error"]
+    finally:
+        obs.finish()
+
+
+def test_profile_endpoint_bounded_capture_and_bad_params(
+        tmp_path, monkeypatch):
+    obs = RunObs(ObsConfig(level="basic", http_port=0,
+                           profile_dir=str(tmp_path / "caps")),
+                 run_id="prof-on")
+    try:
+        assert obs.profiler is not None
+        # stub the session so the endpoint answers instantly
+        import jax.profiler as jp
+
+        monkeypatch.setattr(jp, "start_trace", lambda d: None)
+        monkeypatch.setattr(jp, "stop_trace", lambda: None)
+        sleep = _FakeSleep()
+        obs.profiler._sleep = sleep
+        obs.profiler.max_capture_sec = 2.0
+
+        body = _get_json(obs.http.url + "/profile?sec=999")
+        assert body["ok"] is True
+        assert body["sec"] == 2.0 and sleep.calls == [2.0]  # clamped
+        assert body["reason"] == "http"
+
+        body = _get_json(obs.http.url + "/profile?sec=abc")
+        assert body["ok"] is False and "bad capture duration" in body["error"]
+    finally:
+        obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# disarmed hot path untouched (the standing invariant, extended)
+# ---------------------------------------------------------------------------
+
+
+def _tpe_run(seed=11, max_evals=10, **kw):
+    t = Trials()
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=max_evals, trials=t,
+         rstate=np.random.default_rng(seed), show_progressbar=False, **kw)
+    return t
+
+
+def test_disarmed_no_new_threads_and_armed_proposals_bit_identical(
+        tmp_path):
+    t_plain = _tpe_run()
+    before = {th.name for th in threading.enumerate()}
+    t_again = _tpe_run()
+    after = {th.name for th in threading.enumerate()}
+    assert before == after  # a disarmed run starts ZERO new threads
+    # an ARMED capture plane (annotations live on every tick, no capture
+    # triggered) proposes bit-identically to the disarmed loop
+    t_armed = _tpe_run(profile=str(tmp_path / "caps"))
+    assert t_plain.losses() == t_again.losses() == t_armed.losses()
+    for a, b in zip(t_plain.trials, t_armed.trials):
+        assert a["misc"]["vals"] == b["misc"]["vals"]
+
+
+def test_disarmed_annotation_is_shared_null_context():
+    obs = RunObs(ObsConfig(level="basic"), run_id="ann-off")
+    try:
+        assert obs.profiler is None
+        # one shared object per call path — no per-tick construction on
+        # the disarmed hot loop
+        assert obs.annotate("fmin.tick", step=1) is obs.annotate("x")
+        assert annotation_ctx(None, "fmin.tick") is annotation_ctx(None, "y")
+    finally:
+        obs.finish()
+
+
+def test_armed_annotations_usable_without_active_session(tmp_path):
+    obs = RunObs(ObsConfig(level="basic",
+                           profile_dir=str(tmp_path / "caps")),
+                 run_id="ann-on")
+    try:
+        with obs.annotate("fmin.tick", step=3, tid=7, n=1):
+            pass  # TraceAnnotation no-ops while no session records
+        with obs.annotate("device.chunk", start=0, limit=8):
+            pass
+    finally:
+        obs.finish()
+
+
+# ---------------------------------------------------------------------------
+# export: device capture merge + validate_trace lint
+# ---------------------------------------------------------------------------
+
+
+def _fake_capture_json(tmp_path, gz=True):
+    data = {"traceEvents": [
+        {"ph": "M", "pid": 7, "tid": 0, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 10.0, "dur": 5.0,
+         "name": "fused_ei_kernel"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 20.0,
+         "name": "fmin.tick#step=3,tid=7#"},  # TraceMe-encoded ids
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 12.0, "name": "nodur"},
+        {"ph": "B", "pid": 7, "tid": 1, "ts": 1.0, "name": "dropped"},
+    ]}
+    if gz:
+        path = tmp_path / "cap.trace.json.gz"
+        with gzip.open(path, "wt") as f:
+            json.dump(data, f)
+    else:
+        path = tmp_path / "cap.trace.json"
+        path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_device_trace_events_remap_shift_name(tmp_path):
+    path = _fake_capture_json(tmp_path)
+    events, n_pids = device_trace_events(path, DEVICE_PID_BASE,
+                                         name="cap1", epoch_offset_sec=2.0)
+    assert n_pids == 2  # pids 7 and 9 remap densely
+    metas = [e for e in events if e["ph"] == "M"]
+    names = {e["pid"]: e["args"]["name"] for e in metas
+             if e["name"] == "process_name"}
+    assert names[DEVICE_PID_BASE] == "device:cap1:/device:TPU:0"
+    assert names[DEVICE_PID_BASE + 1].startswith("device:cap1:")  # synth
+    xs = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert xs["fused_ei_kernel"]["ts"] == pytest.approx(2.0e6 + 10.0)
+    assert xs["nodur"]["dur"] == 0.0  # X without a duration repaired
+    assert xs["nodur"]["pid"] == DEVICE_PID_BASE + 1
+    assert "dropped" not in xs  # only viewer-meaningful phases survive
+
+
+def test_export_merges_device_capture_and_lints_clean(tmp_path):
+    cap = _fake_capture_json(tmp_path, gz=False)
+    host = [
+        {"kind": "span", "name": "suggest", "ts": 1.0, "wall_sec": 0.5,
+         "tname": "MainThread"},
+    ]
+    trace = export_trace([("run.jsonl", host)],
+                         device_traces=[("cap1", cap, 1.0)])
+    events = trace["traceEvents"]
+    assert validate_trace.validate_events(events) == []
+    pids = {e["pid"] for e in events if e["ph"] != "M"}
+    assert 0 in pids and DEVICE_PID_BASE in pids
+    # a vanished artifact degrades to a skipped track group, not a raise
+    trace2 = export_trace([("run.jsonl", host)],
+                          device_traces=[("gone", str(tmp_path / "no.gz"),
+                                          1.0)])
+    assert {e["pid"] for e in trace2["traceEvents"]} == {0}
+
+
+def test_export_cli_resolves_capture_path_relative_to_stream(tmp_path,
+                                                             monkeypatch,
+                                                             capsys):
+    # profiler.py records trace_json relative to the RUN's cwd; exporting
+    # from another directory must retry next to the stream file instead
+    # of silently dropping the capture
+    run_dir = tmp_path / "rundir"
+    run_dir.mkdir()
+    cap = _fake_capture_json(run_dir, gz=False)
+    rel = os.path.relpath(cap, run_dir)
+    (run_dir / "run.jsonl").write_text(json.dumps(
+        {"kind": "profile", "ok": True, "ts": 2.0, "t0": 2.0,
+         "reason": "http", "dir": "caps", "trace_json": rel}) + "\n")
+    monkeypatch.chdir(tmp_path)  # NOT the run's directory
+    out = str(tmp_path / "merged.json")
+    assert report_main(["--export-trace", out,
+                        str(run_dir / "run.jsonl")]) == 0
+    events = json.loads((tmp_path / "merged.json").read_text())
+    events = events["traceEvents"] if isinstance(events, dict) else events
+    assert any(e.get("pid", 0) >= DEVICE_PID_BASE for e in events)
+    # a genuinely missing artifact warns instead of silently dropping
+    (run_dir / "run.jsonl").write_text(json.dumps(
+        {"kind": "profile", "ok": True, "ts": 2.0, "t0": 2.0,
+         "reason": "http", "dir": "caps", "trace_json": "gone.json"}) + "\n")
+    assert report_main(["--export-trace", out,
+                        str(run_dir / "run.jsonl")]) == 0
+    assert "skipping device capture" in capsys.readouterr().err
+
+
+def test_validate_trace_lints_merged_artifact_invariants():
+    base = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+             "args": {"name": "host"}}]
+    # unnamed track group
+    errs = validate_trace.validate_events(
+        base + [{"ph": "X", "pid": 5, "tid": 0, "ts": 1, "dur": 1,
+                 "name": "k"}])
+    assert any("no process_name" in e for e in errs)
+    # counter series going backwards in ts
+    errs = validate_trace.validate_events(base + [
+        {"ph": "C", "pid": 0, "tid": 3, "ts": 10, "name": "a",
+         "args": {"v": 1}},
+        {"ph": "C", "pid": 0, "tid": 3, "ts": 10, "name": "b",
+         "args": {"v": 1}},
+        {"ph": "C", "pid": 0, "tid": 3, "ts": 5, "name": "a",
+         "args": {"v": 2}},
+    ])
+    assert any("counter 'a' ts goes backwards" in e for e in errs)
+    # non-numeric counter value
+    errs = validate_trace.validate_events(base + [
+        {"ph": "C", "pid": 0, "tid": 3, "ts": 1, "name": "a",
+         "args": {"v": "high"}}])
+    assert any("non-numeric" in e for e in errs)
+    # a loop-boundary annotation stripped of its ids
+    errs = validate_trace.validate_events(base + [
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 1, "dur": 1,
+         "name": "fmin.tick"}])
+    assert any("carries no ids" in e for e in errs)
+    # ids as args OR TraceMe-encoded both pass
+    ok = validate_trace.validate_events(base + [
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 1, "dur": 1,
+         "name": "fmin.tick", "args": {"step": 3}},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 2, "dur": 1,
+         "name": "device.chunk#start=0#"},
+    ])
+    assert ok == []
+
+
+# ---------------------------------------------------------------------------
+# kernel attribution: the roofline join
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_table_joins_cost_and_execute_spans():
+    dev = {"chunk.flops": 100.0, "chunk.bytes": 8.0,
+           "chunk.execute_sec": {"count": 2, "sum": 0.4},
+           "suggest.flops": 50.0, "suggest.bytes": 0.0}
+    rows = roofline_table(dev, phases={"suggest": {"sec": 1.0, "count": 4}})
+    assert rows["chunk"]["achieved_flops_per_sec"] == pytest.approx(500.0)
+    assert rows["chunk"]["arithmetic_intensity"] == pytest.approx(12.5)
+    assert rows["chunk"]["pct_of_ask"] == pytest.approx(0.4)
+    # static-only program (no execute spans yet) keeps its reader
+    assert "dispatches" not in rows["suggest"]
+    assert rows["suggest"]["arithmetic_intensity"] is None  # bytes 0
+
+
+def test_report_renders_roofline_and_capture_sections():
+    records = [
+        {"kind": "span", "name": "suggest", "ts": 1.0, "wall_sec": 1.0},
+        {"kind": "metrics", "ts": 2.0, "snapshot": {"shared": {"device": {
+            "metrics": {"chunk.flops": 100.0, "chunk.bytes": 8.0,
+                        "chunk.execute_sec": {"count": 2, "sum": 0.4,
+                                              "min": 0.1, "max": 0.3}},
+        }}}},
+        {"kind": "profile", "reason": "http", "ts": 3.0, "ok": True,
+         "sec": 1.0, "wall_sec": 1.01, "dir": "/tmp/c1",
+         "trace_json": "/tmp/c1/x.trace.json.gz"},
+        {"kind": "profile", "reason": "stall", "ts": 4.0, "ok": False,
+         "error": "capture already in progress"},
+    ]
+    text = render(records)
+    assert "kernel roofline" in text
+    assert "x2" in text and "500.0F/s" in text
+    assert "device captures" in text
+    assert "http" in text and "/tmp/c1/x.trace.json.gz" in text
+    assert "stall" in text and "FAILED" in text
+
+
+# ---------------------------------------------------------------------------
+# fmin plumbing: profile= kwarg
+# ---------------------------------------------------------------------------
+
+
+def test_fmin_profile_kwarg_arms_plane(tmp_path, monkeypatch):
+    import hyperopt_tpu.obs as obs_mod
+
+    seen = {}
+    orig = obs_mod.RunObs.resolve.__func__
+
+    def spy(cls, obs, totals=None, run_id=None):
+        bundle = orig(cls, obs, totals=totals, run_id=run_id)
+        seen.setdefault("profiler", bundle.profiler)
+        seen.setdefault("cfg", bundle.config)
+        return bundle
+
+    monkeypatch.setattr(obs_mod.RunObs, "resolve", classmethod(spy))
+    cap_dir = str(tmp_path / "caps")
+    t = _tpe_run(max_evals=4, profile=cap_dir)
+    assert len(t) == 4  # the run itself is unaffected
+    assert seen["cfg"].profile_dir == cap_dir
+    assert seen["profiler"] is not None
+    assert seen["profiler"].out_dir == cap_dir
+    # full:<dir> routes to the legacy whole-run mode instead
+    seen.clear()
+    _tpe_run(max_evals=3, profile="full:" + cap_dir)
+    assert seen["cfg"].profile_full == cap_dir
+    assert seen["cfg"].profile_dir is None
+    assert seen["profiler"] is None
+
+
+def test_trials_expose_programmatic_capture_handle(tmp_path):
+    """The documented programmatic trigger is
+    ``trials.obs_profiler.capture(sec)`` — the handle must exist on an
+    armed run (even without an obs= stream), be None disarmed, and drop
+    from pickles (it holds the capture lock)."""
+    import pickle
+
+    t = _tpe_run(max_evals=3, profile=str(tmp_path / "caps"))
+    assert t.obs_profiler is not None
+    assert t.obs_profiler.out_dir == str(tmp_path / "caps")
+    assert callable(t.obs_profiler.capture)
+    t2 = pickle.loads(pickle.dumps(t))
+    assert getattr(t2, "obs_profiler", None) is None
+    assert _tpe_run(max_evals=3).obs_profiler is None  # disarmed
+
+
+def test_fmin_profile_kwarg_ignored_with_prebuilt_runobs(tmp_path, caplog):
+    import logging
+
+    obs = RunObs(ObsConfig(level="basic"), run_id="prebuilt")
+    with caplog.at_level(logging.WARNING, logger="hyperopt_tpu.fmin"):
+        t = Trials()
+        fmin(quad, SPACE, algo=tpe.suggest, max_evals=3, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             obs=obs, profile=str(tmp_path / "caps"))
+    assert any("ignored" in r.getMessage() for r in caplog.records)

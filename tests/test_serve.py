@@ -353,6 +353,19 @@ _GOLDEN_SECTIONS = {
             "flops_per_dispatch": 100.0,
         },
     },
+    # kernel attribution (ISSUE 7): static cost × measured execute spans,
+    # plus the program's share of the suggest phase wall clock
+    "roofline": {
+        "chunk": {
+            "achieved_flops_per_sec": 500.0,
+            "arithmetic_intensity": 12.5,
+            "bytes_per_dispatch": 8.0,
+            "dispatches": 2,
+            "execute_sec_total": 0.4,
+            "flops_per_dispatch": 100.0,
+            "pct_of_ask": 0.4,
+        },
+    },
 }
 
 

@@ -1,0 +1,388 @@
+"""Perf-trajectory store (hyperopt_tpu/obs/trajectory.py) + the windowed
+regression gate (scripts/bench_gate.py) + the --trend renderer.
+
+All tier-1 (CPU, fast).  The load-bearing invariants pinned here:
+
+* the store is append-only JSONL whose readers tolerate a torn final
+  line (a bench killed mid-append never blinds the gate to the history);
+* backfill from the checked-in ``BENCH_r*.json`` is idempotent and
+  captures the headline + tail-mined metrics per round;
+* the windowed gate is direction-aware (higher-is-better throughputs vs
+  lower-is-better latencies vs absolute-deviation overhead fractions),
+  passes on stable history, FAILS on a synthetic injected regression,
+  and never gates keys its direction table doesn't know;
+* occurrence-count mismatches in tail-mined series skip positionally
+  instead of misaligning (differently-truncated recorded tails).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from hyperopt_tpu.obs import trajectory
+from hyperopt_tpu.obs.report import main as report_main, render_trend
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import bench_gate  # noqa: E402  (scripts/bench_gate.py)
+
+
+def _rec(value=100.0, ask_p50=2.0, overhead=0.005, rnd=None,
+         source="bench.py", series=None, keys_extra=None):
+    keys = {"value": value, "ask_p50_ms": ask_p50,
+            "profiler_overhead_frac": overhead}
+    if keys_extra:
+        keys.update(keys_extra)
+    return {"kind": "bench", "ts": 1000.0 + (rnd or 0), "round": rnd,
+            "source": source, "git_rev": "abc1234", "rc": 0,
+            "backend": "cpu", "config": {},
+            "keys": keys,
+            "series": dict(series or {"ask_p50_ms": [ask_p50],
+                                      "profiler_overhead_frac": [overhead]})}
+
+
+def _store(tmp_path, records):
+    path = str(tmp_path / ".obs" / "trajectory.jsonl")
+    for r in records:
+        trajectory.append(r, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# store: append-only, torn-line tolerant
+# ---------------------------------------------------------------------------
+
+
+def test_append_load_roundtrip_and_torn_line(tmp_path):
+    path = _store(tmp_path, [_rec(rnd=1), _rec(rnd=2)])
+    # a bench killed mid-append leaves a torn final line
+    with open(path, "a") as f:
+        f.write('{"kind": "bench", "ts": 3, "keys": {"value": 1')
+    records = trajectory.load(path)
+    assert [r["round"] for r in records] == [1, 2]  # torn line skipped
+    # and the gate still runs over the surviving history
+    regs, notes = bench_gate.windowed_compare(
+        records[:-1], records[-1], trajectory.KEY_DIRECTIONS)
+    assert regs == []
+
+
+def test_load_missing_store_is_empty(tmp_path):
+    assert trajectory.load(str(tmp_path / "nope.jsonl")) == []
+
+
+# ---------------------------------------------------------------------------
+# backfill from BENCH_r*.json
+# ---------------------------------------------------------------------------
+
+
+def _fake_bench_artifact(tmp_path, n, value, tail_metrics=""):
+    rec = {"n": n, "cmd": "python bench.py", "rc": 0,
+           "tail": '{"metric": "x", "value": %s%s}' % (value, tail_metrics),
+           "parsed": {"metric": "tpe_candidate_proposal_throughput",
+                      "value": value, "vs_baseline": 2.0,
+                      "backend": "cpu"}}
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return str(path)
+
+
+def test_backfill_mines_rounds_and_is_idempotent(tmp_path):
+    _fake_bench_artifact(tmp_path, 1, 100.0,
+                         ', "trials_per_sec": 50.0')
+    _fake_bench_artifact(tmp_path, 2, 120.0,
+                         ', "trials_per_sec": 60.0, "ask_p50_ms": 2.5')
+    store = str(tmp_path / ".obs" / "trajectory.jsonl")
+    appended = trajectory.backfill(root=str(tmp_path), path=store)
+    assert appended == [1, 2]
+    records = trajectory.load(store)
+    assert [r["round"] for r in records] == [1, 2]
+    assert records[0]["keys"]["value"] == 100.0
+    # tail metrics stay in series ONLY for backfilled rounds: a recorded
+    # tail's first occurrence can name a different stage than the live
+    # keys_override representative, so it must not share the scalar key
+    assert "trials_per_sec" not in records[0]["keys"]
+    assert records[0]["series"]["trials_per_sec"] == [50.0]
+    assert records[1]["series"]["ask_p50_ms"] == [2.5]
+    assert records[0]["source"] == "BENCH_r01.json"
+    # idempotent: a second backfill appends nothing
+    assert trajectory.backfill(root=str(tmp_path), path=store) == []
+    assert len(trajectory.load(store)) == 2
+    # force re-appends
+    assert trajectory.backfill(root=str(tmp_path), path=store,
+                               force=True) == [1, 2]
+    assert len(trajectory.load(store)) == 4
+
+
+def test_repo_store_is_seeded_with_bench_history():
+    # the satellite acceptance: >= 5 backfilled records committed, so the
+    # windowed gate has history from day one
+    records = trajectory.load()
+    rounds = [r.get("round") for r in records if r.get("round") is not None]
+    assert len(rounds) >= 5
+    assert rounds == sorted(rounds)
+
+
+def test_record_from_headline_stamps_rev_and_config():
+    rec = trajectory.record_from_headline(
+        {"value": 42.0, "vs_baseline": 3.0, "backend": "cpu"},
+        detail_tail='{"ask_p50_ms": 1.5, "ask_p50_ms": 2.5}',
+        config={"hist_dtype": "bf16"})
+    assert rec["keys"]["value"] == 42.0
+    assert rec["keys"]["ask_p50_ms"] == 1.5  # first occurrence
+    assert rec["series"]["ask_p50_ms"] == [1.5, 2.5]
+    assert rec["config"] == {"hist_dtype": "bf16"}
+    assert rec["source"] == "bench.py"
+    # this repo IS a git checkout: the live record carries its rev
+    assert rec["git_rev"]
+
+
+def test_key_directions_cover_gated_tail_metrics():
+    # every tail-mined metric the store records has explicit direction
+    # metadata — the "learns the new trajectory keys" satellite
+    for name in trajectory.TAIL_METRICS:
+        meta = trajectory.KEY_DIRECTIONS[name]
+        assert meta["direction"] in ("higher", "lower")
+        assert meta["threshold"] > 0
+    assert trajectory.KEY_DIRECTIONS["profiler_overhead_frac"]["absolute"]
+
+
+# ---------------------------------------------------------------------------
+# windowed gate semantics
+# ---------------------------------------------------------------------------
+
+
+def _history(n=5, **kw):
+    return [_rec(rnd=i + 1, **kw) for i in range(n)]
+
+
+def test_windowed_gate_passes_on_stable_history():
+    hist = _history(5)
+    regs, notes = bench_gate.windowed_compare(
+        hist, _rec(value=101.0, ask_p50=1.9), trajectory.KEY_DIRECTIONS)
+    assert regs == []
+    assert any("value" in n for n in notes)
+
+
+def test_windowed_gate_fails_on_injected_throughput_regression():
+    hist = _history(5)
+    # higher-is-better: a 40% drop vs the median trips the 20% threshold
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(value=60.0), trajectory.KEY_DIRECTIONS)
+    assert any(r.startswith("value:") for r in regs)
+
+
+def test_windowed_gate_fails_on_injected_latency_rise():
+    hist = _history(5)
+    # lower-is-better: ask_p50 2.0 -> 3.5 is a 75% rise vs the 35% bound
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(ask_p50=3.5), trajectory.KEY_DIRECTIONS)
+    assert any(r.startswith("ask_p50_ms") for r in regs)
+
+
+def test_windowed_gate_absolute_threshold_for_overhead_frac():
+    hist = _history(5, overhead=0.004)
+    # profiler_overhead_frac gates the ABSOLUTE value (0.35 — decisively
+    # above the stage's ±15-20% wall-clock noise): a plane that stopped
+    # being idle (+50%) fails even though near-zero fractions make
+    # relative bounds meaningless, while noise-scale swings pass
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(overhead=0.50), trajectory.KEY_DIRECTIONS)
+    assert any(r.startswith("profiler_overhead_frac") for r in regs)
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(overhead=0.17), trajectory.KEY_DIRECTIONS)
+    assert not any(r.startswith("profiler_overhead_frac") for r in regs)
+
+
+def test_windowed_gate_scalar_view_gates_despite_series_shape_change():
+    # real histories change series shape across PRs (stages added,
+    # differently-truncated tails), so the positional pass alone would
+    # never engage — the representative scalar view must still gate
+    hist = [_rec(rnd=i + 1, keys_extra={"trials_per_sec": 100.0},
+                 series={"trials_per_sec": [100.0, 50.0]})
+            for i in range(5)]
+    new = _rec(keys_extra={"trials_per_sec": 40.0},
+               series={"trials_per_sec": [40.0, 20.0, 10.0]})  # new shape
+    regs, _ = bench_gate.windowed_compare(
+        hist, new, trajectory.KEY_DIRECTIONS)
+    assert any(r.startswith("trials_per_sec") for r in regs)
+
+
+def test_load_filters_non_bench_records(tmp_path):
+    path = _store(tmp_path, [_rec(rnd=1)])
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "span", "name": "suggest",
+                            "ts": 1.0}) + "\n")
+    recs = trajectory.load(path)
+    assert len(recs) == 1 and recs[0]["kind"] == "bench"
+
+
+def test_windowed_gate_zero_median_records_instead_of_gating():
+    # history_bytes can be all-zero on a backend where memory_stats() is
+    # None; the first run that MEASURES a real value must not fail the
+    # gate (a zero median makes every relative bound degenerate)
+    hist = _history(5, keys_extra={"history_bytes": 0.0},
+                    series={"history_bytes": [0.0]})
+    new = _rec(keys_extra={"history_bytes": 4096.0},
+               series={"history_bytes": [4096.0]})
+    regs, notes = bench_gate.windowed_compare(
+        hist, new, trajectory.KEY_DIRECTIONS)
+    assert not any(r.startswith("history_bytes") for r in regs)
+    assert any("median is 0" in n for n in notes)
+
+
+def test_windowed_gate_median_robust_to_one_noisy_round():
+    # one catastrophic round in the window must not poison the baseline
+    # (the exact failure mode of the pairwise newest-vs-previous gate)
+    hist = _history(4) + [_rec(value=5.0, rnd=5)]
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(value=95.0), trajectory.KEY_DIRECTIONS)
+    assert regs == []
+
+
+def test_windowed_gate_skips_mismatched_series_counts():
+    hist = _history(5, series={"sharded_cand_per_sec": [10.0, 19.0, 36.0]})
+    new = _rec(series={"sharded_cand_per_sec": [10.0, 19.0]})
+    regs, notes = bench_gate.windowed_compare(
+        hist, new, trajectory.KEY_DIRECTIONS)
+    assert not any("sharded" in r for r in regs)
+    assert any("no matching history" in n for n in notes)
+
+
+def test_windowed_gate_positional_series_regression():
+    hist = _history(5, series={"sharded_cand_per_sec": [10.0, 19.0, 36.0]})
+    new = _rec(series={"sharded_cand_per_sec": [10.0, 19.0, 20.0]})
+    regs, _ = bench_gate.windowed_compare(
+        hist, new, trajectory.KEY_DIRECTIONS)
+    assert any(r.startswith("sharded_cand_per_sec[2]") for r in regs)
+
+
+def test_windowed_gate_unknown_keys_never_gate():
+    hist = _history(5, keys_extra={"mystery_metric": 100.0})
+    regs, notes = bench_gate.windowed_compare(
+        hist, _rec(keys_extra={"mystery_metric": 1.0}),
+        trajectory.KEY_DIRECTIONS)
+    assert not any("mystery" in r for r in regs)
+    assert any("mystery" in n and "ungated" in n for n in notes)
+
+
+def test_windowed_gate_window_limits_history():
+    # six ancient slow rounds + four recent fast ones: window=4 sees only
+    # the fast era, so a return to the ancient value IS a regression
+    hist = _history(6, value=10.0) + [
+        _rec(value=100.0, rnd=i + 7) for i in range(4)]
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(value=10.0), trajectory.KEY_DIRECTIONS, window=4)
+    assert any(r.startswith("value:") for r in regs)
+    # window=10 folds the slow-majority era back in: the median returns
+    # to the ancient value and the same run passes
+    regs, _ = bench_gate.windowed_compare(
+        hist, _rec(value=10.0), trajectory.KEY_DIRECTIONS, window=10)
+    assert regs == []
+
+
+# ---------------------------------------------------------------------------
+# bench_gate CLI: windowed main + legacy fallback
+# ---------------------------------------------------------------------------
+
+
+def test_bench_gate_cli_windowed_pass_and_fail(tmp_path, capsys):
+    _store(tmp_path, _history(5) + [_rec(value=99.0, rnd=6)])
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "windowed" in out and "ok" in out
+
+    tmp2 = tmp_path / "fail"
+    tmp2.mkdir()
+    _store(tmp2, _history(5) + [_rec(value=10.0, rnd=6)])
+    assert bench_gate.main(["--dir", str(tmp2)]) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err and "value" in err
+
+
+def test_bench_gate_cli_backend_matched_history(tmp_path, capsys):
+    # a CPU dev-box run must not gate against (or poison) TPU history:
+    # with no same-backend record the gate records "no history" and
+    # passes instead of failing the cross-backend compare
+    tpu = _history(5)
+    for r in tpu:
+        r["backend"] = "tpu"
+    _store(tmp_path, tpu + [_rec(value=1.0, rnd=6)])  # 100x "drop", cpu
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "backend=cpu" in out and "5 other-backend" in out
+
+    # same-backend history still gates: one more cpu run, then a real drop
+    _store(tmp_path, [_rec(value=1.0, rnd=7), _rec(value=0.1, rnd=8)])
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_bench_gate_cli_threshold_override(tmp_path):
+    _store(tmp_path, _history(5) + [_rec(value=85.0, rnd=6)])
+    # 15% drop: passes the default 20%, fails an overridden 5%
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    assert bench_gate.main(["--dir", str(tmp_path),
+                            "--threshold", "0.05"]) == 1
+
+
+def test_bench_gate_cli_falls_back_to_legacy_without_store(tmp_path,
+                                                           capsys):
+    _fake_bench_artifact(tmp_path, 1, 100.0)
+    _fake_bench_artifact(tmp_path, 2, 95.0)
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "BENCH_r01.json -> BENCH_r02.json" in out
+
+
+def test_bench_gate_cli_single_record_store_falls_back(tmp_path, capsys):
+    _store(tmp_path, [_rec(rnd=1)])
+    _fake_bench_artifact(tmp_path, 1, 100.0)
+    assert bench_gate.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "falling back" in out
+
+
+# ---------------------------------------------------------------------------
+# --trend renderer
+# ---------------------------------------------------------------------------
+
+
+def test_render_trend_directions_and_sparklines():
+    records = _history(6)
+    records[-1]["keys"]["value"] = 140.0
+    text = render_trend(records)
+    assert "bench trajectory" in text
+    assert "value" in text and "higher=better" in text
+    assert "ask_p50_ms" in text and "lower=better" in text
+    assert "100 -> 140" in text
+    assert "abc1234" in text  # per-run rev line
+
+
+def test_render_trend_segments_mixed_backends():
+    # a tpu→cpu switch is a hardware change, not a 1000x regression: keys
+    # render one sparkline row per backend instead of one mixed line
+    recs = [dict(_rec(value=1e8, rnd=i + 1), backend="tpu")
+            for i in range(2)] + [_rec(value=5e5)]  # _rec defaults to cpu
+    text = render_trend(recs)
+    assert "value [tpu]" in text and "value [cpu]" in text
+    assert "2 tpu runs" in text and "1 cpu runs" in text
+
+
+def test_render_trend_empty_store():
+    text = render_trend([])
+    assert "store is empty" in text
+
+
+def test_report_trend_cli(tmp_path, capsys):
+    path = _store(tmp_path, _history(3))
+    assert report_main(["--trend", path]) == 0
+    out = capsys.readouterr().out
+    assert "bench trajectory" in out and "value" in out
+    # --trend is its own view
+    assert report_main(["--trend", "--merge", path]) == 2
+    # a missing store errors cleanly
+    assert report_main(["--trend", str(tmp_path / "nope.jsonl")]) == 2
+    # a scripted consumer must get an error, not text with exit 0
+    assert report_main(["--trend", "--format", "json", path]) == 2
+    assert report_main(["--trend", path, path]) == 2
