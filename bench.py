@@ -473,6 +473,45 @@ def bench_profiler_overhead(max_evals=60, repeats=3, seed=0):
     return out
 
 
+def bench_fleet_recovery(reps=5, lease_ttl=0.25, poll=0.01):
+    """Elastic-fleet recovery latency (ISSUE 8): wall seconds from a
+    controller dying mid-shard (claimed lease, heartbeats stop) to a
+    survivor HOLDING the reclaimed lease.  Honest measurement — the
+    survivor really polls ``reclaim_stale``+``try_claim`` against real
+    lease files, so the number is ``lease_ttl`` + reclaim/claim filesystem
+    cost + poll jitter; the trajectory gate watches it for the failure
+    mode where reclaim stops working and recovery degrades to the barrier
+    timeout."""
+    import tempfile
+    import time as _t
+
+    from hyperopt_tpu.parallel.membership import FleetMembership
+
+    lat = []
+    for rep in range(reps):
+        with tempfile.TemporaryDirectory() as tmp:
+            dead = FleetMembership(tmp, owner=f"dead:{rep}",
+                                   lease_ttl=lease_ttl)
+            live = FleetMembership(tmp, owner=f"live:{rep}",
+                                   lease_ttl=lease_ttl)
+            assert dead.try_claim(0, 0)
+            t0 = _t.monotonic()  # the "death": heartbeats stop here
+            while True:
+                live.reclaim_stale(0, 1)
+                if live.try_claim(0, 0):
+                    break
+                _t.sleep(poll)
+            lat.append(_t.monotonic() - t0)
+    lat.sort()
+    return {
+        "recovery_latency_sec": lat[len(lat) // 2],
+        "recovery_latency_max_sec": lat[-1],
+        "lease_ttl_sec": lease_ttl,
+        "reps": reps,
+        "backend": "host",
+    }
+
+
 def _pcts(samples_sec):
     """p50/p95/p99/mean in milliseconds from a raw latency list."""
     ms = sorted(1e3 * s for s in samples_sec)
@@ -1140,6 +1179,9 @@ _JAX_STAGES = (
     ("flight_overhead", bench_flight_overhead),
     # capture-plane overhead bar: armed-but-idle profiler vs off (ISSUE 7)
     ("profiler_overhead", bench_profiler_overhead),
+    # elastic-fleet recovery latency: dead controller -> survivor holds the
+    # reclaimed shard lease (ISSUE 8; bench_gate key recovery_latency_sec)
+    ("fleet_recovery", bench_fleet_recovery),
     ("hr_conditional_tpe", bench_hr_conditional),
     ("parallel_trials_10k", bench_parallel_trials),
     ("parallel_trials_10k_tpe", bench_parallel_trials_tpe),

@@ -24,6 +24,7 @@ __all__ = [
     "parse_shard",
     "parse_hist_shard_min",
     "parse_pallas",
+    "parse_allgather_timeout",
 ]
 
 logger = logging.getLogger(__name__)
@@ -167,6 +168,30 @@ def parse_pallas(env=None):
     env = os.environ if env is None else env
     raw = env.get("HYPEROPT_TPU_PALLAS", "").strip().lower()
     return raw not in ("", "0", "off", "false", "no")
+
+
+def parse_allgather_timeout(env=None):
+    """``HYPEROPT_TPU_ALLGATHER_TIMEOUT=<seconds>`` → monotonic deadline
+    for every ``fmin_multihost`` collective (driver.py ``_timed_gather``),
+    or None when unset/disabled/invalid.  Armed, a collective whose peer
+    died degrades to checkpoint-and-shrink (``FleetDegraded``) instead of
+    hanging; disarmed (the default) the collective path is byte-identical
+    to previous rounds and starts no threads."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_ALLGATHER_TIMEOUT", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    try:
+        sec = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_ALLGATHER_TIMEOUT", raw,
+                   "a timeout in seconds")
+        return None
+    if not sec > 0:
+        _warn_once("HYPEROPT_TPU_ALLGATHER_TIMEOUT", raw,
+                   "a positive timeout")
+        return None
+    return sec
 
 
 _CACHE_CONFIGURED = False

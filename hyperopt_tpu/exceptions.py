@@ -33,6 +33,17 @@ class InvalidAnnotatedParameter(HyperoptTpuError):
     """Raised when an ``hp.*`` call is malformed (bad label, bad args)."""
 
 
+class FleetDegraded(HyperoptTpuError):
+    """Raised instead of hanging when a multi-controller run cannot make
+    progress: a collective (``process_allgather``) exceeded its timeout —
+    a peer controller is dead or partitioned — or an elastic-fleet
+    generation barrier expired with shards leased but never published.
+    The raiser checkpoints the last checksum-verified generation first, so
+    the surviving fleet (of ANY size) restarts from the checkpoint/store
+    and replays bitwise; this is the "degrade to checkpoint-and-shrink"
+    half of the preemption story (docs/DESIGN.md §15)."""
+
+
 class StaleHistoryError(HyperoptTpuError):
     """Raised when a device-resident trial history is touched after its
     buffers were DONATED to a fused tell+ask dispatch and the program's

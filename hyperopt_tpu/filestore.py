@@ -43,6 +43,8 @@ import pickle
 import threading
 import time
 
+from . import chaos
+from .retry import RetryPolicy
 from .base import (
     JOB_STATE_CANCEL,
     JOB_STATE_DONE,
@@ -87,8 +89,22 @@ class ReserveTimeout(Exception):
 # transition regardless of the sweep's max_age (see _sweep_orphan_claims)
 _CLAIM_GRACE = 5.0
 
+# reserve-contention backoff (ISSUE 8 satellite): when a rename loses the
+# claim race, back off a jittered-exponential beat before trying the next
+# candidate instead of storming the directory — with many workers the old
+# tight loop showed up as pure reserve.contention churn.  Micro-scale
+# delays (1ms base, 50ms cap): contention means *other workers are making
+# progress*, not that the store is down.
+_RESERVE_BACKOFF = RetryPolicy(max_retries=0, base_delay=0.001,
+                               max_delay=0.05, jitter=0.5)
+
 
 def _atomic_write(path, payload: bytes):
+    # deterministic fault injection (HYPEROPT_TPU_CHAOS ioerr@io:<p>):
+    # every durable write in the store — docs, heartbeats, attachments,
+    # checkpoints, fleet results — shares this one failure point, which is
+    # exactly the surface a flaky NFS/GCS-fuse mount presents
+    chaos.io_point("io")
     # pid AND thread id: two same-process threads writing the same target
     # (a heartbeat thread racing the claim path, concurrent reclaim+cancel)
     # would otherwise share one tmp name — the loser's os.replace then
@@ -160,6 +176,7 @@ class FileStore:
         self.events = EventLog(sink=FileEventSink(
             os.path.join(self.root, "attachments", _EVENTS_ATTACHMENT)))
         self.metrics = get_metrics("filestore")
+        self._sleep = time.sleep  # injectable for backoff tests
 
     def read_events(self):
         """The durable lifecycle log, parsed — every event any process on
@@ -301,8 +318,16 @@ class FileStore:
     def reserve(self, owner):
         """Atomically claim one NEW job: rename into running/ (exactly one
         claimant can win the rename), then stamp owner/book_time.  Returns
-        the claimed doc or None."""
+        the claimed doc or None.
+
+        Contention backs off: each lost rename sleeps a jittered
+        exponentially-growing beat (1ms base, 50ms cap, deterministic in
+        ``(owner, losses-so-far)``) before the next candidate, so N
+        workers racing one burst of NEW docs de-synchronize instead of
+        storming ``listdir``+``rename`` in lockstep.  The
+        ``reserve.backoff_sec`` histogram is the tuning signal."""
         new_dir = os.path.join(self.root, "new")
+        contention = 0
         for fname in sorted(os.listdir(new_dir)):
             if not fname.endswith(".pkl"):
                 continue
@@ -321,6 +346,10 @@ class FileStore:
                 # another claimant won this one: the contention counter is
                 # the store's "how many workers fight per job" signal
                 self.metrics.counter("reserve.contention").inc()
+                delay = _RESERVE_BACKOFF.delay(contention, key=str(owner))
+                contention += 1
+                self.metrics.histogram("reserve.backoff_sec").observe(delay)
+                self._sleep(delay)
                 continue
             doc = self._read(dst)
             if doc is None:

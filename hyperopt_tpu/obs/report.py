@@ -311,6 +311,53 @@ def _pipeline_section(spans, metrics, out):
                    "synchronous dispatch+readback)")
 
 
+def _resilience_section(metrics, out):
+    """Fleet & chaos summary (ISSUE 8): shard-lease traffic, injected
+    faults, retry/backoff pressure — rendered only when the run recorded
+    any of it (a non-fleet, chaos-free run keeps its report unchanged)."""
+    lease_keys = [k for k in metrics if k.startswith("lease.")]
+    chaos_keys = [k for k in metrics if k.startswith("chaos.")]
+    retry_n = metrics.get("trials.retries", 0)
+    backoff = metrics.get("retry.backoff_sec") or {}
+    res_backoff = metrics.get("reserve.backoff_sec") or {}
+    ag_timeouts = metrics.get("allgather.timeouts", 0)
+    if not (lease_keys or chaos_keys or retry_n or backoff.get("count")
+            or res_backoff.get("count") or ag_timeouts):
+        return
+    out.append("")
+    out.append("== fleet & chaos " + "=" * 47)
+    if lease_keys or metrics.get("fleet.members") is not None:
+        out.append(
+            f"  leases   claims {int(metrics.get('lease.claims', 0))}"
+            f"  reclaims {int(metrics.get('lease.reclaims', 0))}"
+            f"  contention {int(metrics.get('lease.contention', 0))}"
+            f"  heartbeats {int(metrics.get('lease.heartbeats', 0))}")
+        members = metrics.get("fleet.members")
+        pub = metrics.get("shard.published", 0)
+        if members is not None or pub:
+            out.append(f"  fleet    members {int(members or 0)}"
+                       f"  shards published {int(pub)}"
+                       f"  joins {int(metrics.get('fleet.joins', 0))}")
+    if chaos_keys:
+        inj = "  ".join(f"{k[len('chaos.'):]} x{int(metrics[k])}"
+                        for k in sorted(chaos_keys))
+        out.append(f"  chaos    {inj}")
+    if retry_n or backoff.get("count"):
+        line = f"  retries  {int(retry_n)} re-attempts"
+        if backoff.get("count"):
+            line += (f"  backoff p50 {_fmt_sec(backoff.get('p50', 0))}"
+                     f"  max {_fmt_sec(backoff.get('max', 0))}")
+        out.append(line)
+    if res_backoff.get("count"):
+        out.append(
+            f"  reserve  backoff x{int(res_backoff['count'])}"
+            f"  p50 {_fmt_sec(res_backoff.get('p50', 0))}"
+            f"  total {_fmt_sec(res_backoff.get('sum', 0))}")
+    if ag_timeouts:
+        out.append(f"  DEGRADED: {int(ag_timeouts)} collective timeout(s) — "
+                   "checkpoint-and-shrink path taken")
+
+
 def _devmem_section(devmem_recs, out):
     """HBM watermark over the run's devmem samples (obs/devmem.py) + the
     last live-array census, so "how much memory did it hold" is answerable
@@ -634,6 +681,7 @@ def render(records, top=5):
     out.append("== phase-time breakdown " + "=" * 40)
     _phase_section(spans, out)
     _pipeline_section(spans, _last_snapshot_metrics(records), out)
+    _resilience_section(_last_snapshot_metrics(records), out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)
     out.append("")

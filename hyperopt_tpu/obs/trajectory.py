@@ -96,6 +96,12 @@ KEY_DIRECTIONS = {
     # committed round measured -0.167), so anything tighter gates noise.
     "profiler_overhead_frac": {"direction": "lower", "threshold": 0.35,
                                "absolute": True},
+    # fleet shard-reclaim latency (bench.py fleet_recovery stage): wall
+    # seconds from a controller dying mid-shard to a survivor holding the
+    # reclaimed lease.  Dominated by the stage's lease_ttl constant plus
+    # poll jitter; the loose bar catches a broken reclaim path (latency
+    # jumping to the barrier timeout), not scheduler noise.
+    "recovery_latency_sec": {"direction": "lower", "threshold": 1.00},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -105,7 +111,7 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "sharded_cand_per_sec",
                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                 "peak_hbm_bytes", "history_bytes",
-                "profiler_overhead_frac")
+                "profiler_overhead_frac", "recovery_latency_sec")
 
 
 def trajectory_path(root=None):

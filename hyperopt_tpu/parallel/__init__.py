@@ -19,8 +19,15 @@ MongoDB workers or Spark executors (``hyperopt/mongoexp.py`` sym: MongoTrials,
 * ``driver`` — the end-to-end SPMD multi-controller ``fmin_multihost``:
   global proposals, per-controller evaluation shards, deterministic folds,
   divergence checksum (the MongoTrials.fmin + MongoWorker analog).
+* ``membership`` / ``fleet`` — the elastic, preemption-native form of the
+  same driver (``fmin_multihost(fleet_dir=...)``): generation ownership as
+  filestore shard LEASES, controllers joining/leaving freely, survivors
+  reclaiming dead controllers' shards, and bitwise replay at any fleet
+  size (ISSUE 8 / ROADMAP item 4 — the reliability half of production
+  scale).
 """
 
 from . import executor, sharding  # noqa: F401
 from .executor import ExecutorTrials  # noqa: F401
 from .driver import fmin_multihost, MultihostResult, ControllerDivergence  # noqa: F401
+from .membership import FleetMembership, shard_trials  # noqa: F401

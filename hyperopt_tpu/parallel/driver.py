@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 import time
 
 import numpy as np
@@ -46,14 +47,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..exceptions import AllTrialsFailed
+from .. import chaos
+from ..exceptions import AllTrialsFailed, FleetDegraded
 from ..obs import ObsConfig, RunObs
 from ..obs.health import controller_stream_path
 from ..spaces import compile_space
 from ..algos import tpe
 from . import payload as payload_mod
 
-__all__ = ["fmin_multihost", "MultihostResult", "ControllerDivergence"]
+__all__ = ["fmin_multihost", "MultihostResult", "ControllerDivergence",
+           "FleetDegraded"]
 
 
 class ControllerDivergence(RuntimeError):
@@ -102,6 +105,70 @@ def _gen_seed(seed, gen):
     return (int(seed) + 0x9E3779B1 * (gen + 1)) & 0xFFFFFFFF
 
 
+def _digest_generation(digest, labels, flats, losses, B):
+    """Advance the divergence digest by one generation's rows — per trial,
+    the f32 raw loss then each label's f32 value, in global trial-id
+    order.  THE shared byte order: the collective fold, the fleet fold and
+    the checkpoint replay all call this (or write the row-major
+    ``[n, 1+L]`` f32 equivalent), which is what makes their checksums
+    comparable bitwise."""
+    for j in range(B):
+        digest.update(np.float32(losses[j]).tobytes())
+        digest.update(
+            b"".join(np.float32(flats[l][j]).tobytes() for l in labels))
+
+
+def _timed_gather(fn, timeout, what, obs, on_timeout):
+    """Run one collective with a MONOTONIC deadline.  ``timeout=None`` is
+    the direct call — zero threads, zero behavior change (the default).
+
+    With a timeout, the collective runs on a daemon thread; if it misses
+    the deadline the peer is presumed dead/partitioned (the hang this
+    exists to break), ``on_timeout()`` checkpoints the last verified
+    generation, and :class:`FleetDegraded` tells the operator to restart
+    the surviving fleet — which resumes bitwise at any size.  The blocked
+    thread is deliberately abandoned: the collective can only be freed by
+    the peer that will never arrive, and the process is about to exit on
+    the raise anyway."""
+    if timeout is None:
+        return fn()
+    box = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # surfaced on the caller thread
+            box["err"] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=run, name="hyperopt-allgather", daemon=True)
+    th.start()
+    if not done.wait(timeout):  # Event.wait is monotonic under the hood
+        obs.event("allgather_timeout", point=what, timeout_sec=timeout)
+        obs.counter("allgather.timeouts").inc()
+        ckpt = False
+        try:
+            # on_timeout returns True when it actually wrote a checkpoint
+            # (no checkpoint_file configured / resume-path timeout → False)
+            ckpt = bool(on_timeout())
+        except Exception:
+            pass  # best-effort checkpoint: the raise below must win
+        raise FleetDegraded(
+            f"collective '{what}' did not complete within {timeout:.0f}s — "
+            "a controller is dead or partitioned; "
+            + ("the last verified generation is checkpointed: restart the "
+               "surviving fleet (any size) with the same checkpoint_file "
+               "to resume bitwise" if ckpt else
+               "NO checkpoint was written (no checkpoint_file configured, "
+               "or the run already resumed from the one on disk) — restart "
+               "the fleet from its last durable state"))
+    if "err" in box:
+        raise box["err"]
+    return box["out"]
+
+
 def _controller_port(port, pid):
     """Per-controller scrape port: explicit base port + process index
     (``obs.top`` scrapes each controller's ``run.p<i>`` server); 0 stays 0
@@ -126,7 +193,8 @@ def _controller_port(port, pid):
 
 def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                    n_startup=None, checkpoint_file=None, obs=None,
-                   _force_single=False):
+                   _force_single=False, fleet_dir=None, n_shards=None,
+                   lease_ttl=15.0, allgather_timeout=None):
     """Minimize ``fn`` over ``space`` across every process of a
     ``jax.distributed`` runtime.  Call from ALL processes with identical
     arguments (SPMD); returns the same :class:`MultihostResult` everywhere.
@@ -167,7 +235,35 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     phase breakdown, divergence correlation) with::
 
         python -m hyperopt_tpu.obs.report --merge run.p0.jsonl run.p1.jsonl
+
+    ``fleet_dir``: run as one controller of an ELASTIC fleet instead (no
+    ``jax.distributed`` required): generation ownership moves from
+    positional bucketing onto filestore shard leases rooted at this
+    directory, controllers may join/leave at any time, a survivor reclaims
+    a dead controller's shard after ``lease_ttl`` seconds, and a fleet
+    resumed at a DIFFERENT size replays the store to a bitwise-identical
+    history (``n_shards`` pins the work-shard structure — see
+    :mod:`~hyperopt_tpu.parallel.fleet` and docs/DESIGN.md §15).
+
+    ``allgather_timeout`` (collective mode; or
+    ``HYPEROPT_TPU_ALLGATHER_TIMEOUT``): bound every cross-controller
+    collective by a monotonic deadline.  On expiry the driver checkpoints
+    the last checksum-verified generation and raises
+    :class:`FleetDegraded` instead of hanging in a collective whose peer
+    died — restart the surviving fleet (any size) with the same
+    ``checkpoint_file`` to resume bitwise.
     """
+    if fleet_dir is not None:
+        from .fleet import fleet_fmin
+
+        return fleet_fmin(
+            fn, space, max_evals, fleet_dir, batch=batch, seed=seed,
+            cfg=cfg, n_startup=n_startup, n_shards=n_shards,
+            lease_ttl=lease_ttl, checkpoint_file=checkpoint_file, obs=obs)
+    if allgather_timeout is None:
+        from .._env import parse_allgather_timeout
+
+        allgather_timeout = parse_allgather_timeout()
     single = _force_single or jax.process_count() == 1
     if single:
         pid, P = 0, 1
@@ -415,10 +511,13 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # heartbeat is {"point": "proposals", "mark": "pre"} IS a hung
         # allgather — the post-mortem names the blocked collective
         obs.heartbeat("driver.allgather", point="proposals", mark="pre")
+        chaos.point("allgather", metrics=obs.metrics)
         t0 = time.perf_counter()
-        full = np.asarray(
-            multihost_utils.process_allgather(mat, tiled=True)
-        ).reshape(batch, len(labels))
+        full = np.asarray(_timed_gather(
+            lambda: multihost_utils.process_allgather(mat, tiled=True),
+            allgather_timeout, "proposals", obs,
+            lambda: _save_checkpoint(force=True),
+        )).reshape(batch, len(labels))
         obs.histogram("allgather.proposals_sec").observe(
             time.perf_counter() - t0)
         obs.heartbeat("driver.allgather", point="proposals", mark="post")
@@ -458,11 +557,14 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
         # pure overhead per fmin_multihost call (ADVICE.md round 5).
         obs.counter("resume_agreement_checks").inc()
         obs.heartbeat("driver.allgather", point="resume", mark="pre")
+        chaos.point("allgather", metrics=obs.metrics)
         t0 = time.perf_counter()
         state8 = np.frombuffer(digest.digest()[:8], np.uint64)[0]
         mine = jnp.asarray(np.asarray([n_done, state8], np.uint64))
-        all_s = np.asarray(
-            multihost_utils.process_allgather(mine)).reshape(P, 2)
+        all_s = np.asarray(_timed_gather(
+            lambda: multihost_utils.process_allgather(mine),
+            allgather_timeout, "resume", obs, lambda: None,
+        )).reshape(P, 2)
         obs.histogram("allgather.resume_sec").observe(
             time.perf_counter() - t0)
         obs.heartbeat("driver.allgather", point="resume", mark="post")
@@ -474,37 +576,47 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
                 " — checkpoint_file must live on a filesystem shared by"
                 " every controller")
 
-    def _save_checkpoint():
+    def _save_checkpoint(force=False, upto=None):
         """Atomic generation-boundary snapshot; controller 0 writes (every
         controller holds an identical history — that is the divergence
-        guarantee this driver enforces).
+        guarantee this driver enforces).  ``force=True`` lets ANY
+        controller write on the degrade path (a timed-out collective may
+        mean controller 0 is the dead one); ``upto`` caps the snapshot at
+        the last checksum-VERIFIED trial count when the current
+        generation's verification never completed.
 
         Host-materialization boundary: the snapshot is built from the
         numpy ``hist`` exclusively — never from the device-resident mirror,
         whose buffers may be donated/aliased by the in-place generation
-        fold and are not picklable state."""
-        if checkpoint_file is None or pid != 0:
-            return
+        fold and are not picklable state.  Returns True when a snapshot
+        was written (the degrade path's FleetDegraded message reports
+        whether an operator actually has a checkpoint to resume from)."""
+        if checkpoint_file is None or (pid != 0 and not force):
+            return False
         import pickle
 
         from ..filestore import _atomic_write
 
+        chaos.point("checkpoint", metrics=obs.metrics)
+        n = n_done if upto is None else upto
         state = {
             "run_params": run_params,
-            "n_done": n_done,
-            "losses": hist["losses"][:n_done].copy(),
-            "has_loss": hist["has_loss"][:n_done].copy(),
-            "raw_losses": raw_losses[:n_done].copy(),
-            "vals": {l: hist["vals"][l][:n_done].copy() for l in labels},
-            "active": {l: hist["active"][l][:n_done].copy() for l in labels},
+            "n_done": n,
+            "losses": hist["losses"][:n].copy(),
+            "has_loss": hist["has_loss"][:n].copy(),
+            "raw_losses": raw_losses[:n].copy(),
+            "vals": {l: hist["vals"][l][:n].copy() for l in labels},
+            "active": {l: hist["active"][l][:n].copy() for l in labels},
         }
         t0 = time.perf_counter()
         _atomic_write(checkpoint_file, pickle.dumps(state))
         obs.histogram("checkpoint.save_sec").observe(
             time.perf_counter() - t0)
+        return True
 
     while n_done < max_evals:
         obs.heartbeat("driver.gen", gen=gen, n_done=n_done)
+        chaos.point("gen", metrics=obs.metrics)
         # generation-boundary HBM sample: each controller samples its OWN
         # devices; obs.report --merge aggregates the per-controller streams
         obs.devmem_sample()
@@ -577,10 +689,14 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             obs.gauge("payload.bytes_per_controller").set(int(wire.nbytes))
             obs.heartbeat("driver.allgather", point="results", mark="pre",
                           gen=gen)
+            chaos.point("allgather", metrics=obs.metrics)
             t0 = time.perf_counter()
-            gathered = np.asarray(
-                multihost_utils.process_allgather(jnp.asarray(wire))
-            ).reshape(P, width, wire.shape[1])
+            wire_dev = jnp.asarray(wire)
+            gathered = np.asarray(_timed_gather(
+                lambda: multihost_utils.process_allgather(wire_dev),
+                allgather_timeout, "results", obs,
+                lambda: _save_checkpoint(force=True),
+            )).reshape(P, width, wire.shape[1])
             obs.histogram("allgather.results_sec").observe(
                 time.perf_counter() - t0)
             obs.heartbeat("driver.allgather", point="results", mark="post",
@@ -602,11 +718,7 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             payload_mod.fold_generation(
                 hist, raw_losses, n_done, labels,
                 {l: flats[l][:B] for l in labels}, losses, active_rows)
-            for j in range(B):
-                digest.update(np.float32(losses[j]).tobytes())
-                digest.update(
-                    b"".join(np.float32(flats[l][j]).tobytes()
-                             for l in labels))
+            _digest_generation(digest, labels, flats, losses, B)
         n_done += B
         gen += 1
         obs.counter("generations").inc()
@@ -622,9 +734,16 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
             h = int.from_bytes(digest.digest()[:8], "big")
             obs.heartbeat("driver.allgather", point="checksum", mark="pre",
                           gen=gen)
+            chaos.point("allgather", metrics=obs.metrics)
             t0 = time.perf_counter()
-            all_h = np.asarray(multihost_utils.process_allgather(
-                jnp.asarray(np.uint64(h))))
+            h_dev = jnp.asarray(np.uint64(h))
+            all_h = np.asarray(_timed_gather(
+                lambda: multihost_utils.process_allgather(h_dev),
+                allgather_timeout, "checksum", obs,
+                # this generation is folded but NOT verified: degrade to
+                # the last checksum-verified boundary
+                lambda: _save_checkpoint(force=True, upto=n_done - B),
+            ))
             obs.histogram("allgather.checksum_sec").observe(
                 time.perf_counter() - t0)
             obs.heartbeat("driver.allgather", point="checksum", mark="post",

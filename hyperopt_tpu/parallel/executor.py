@@ -38,6 +38,7 @@ import numpy as np
 
 from ..obs import EventLog, MetricsRegistry
 from ..obs.watchdog import beat as _wd_beat
+from ..retry import RetryPolicy
 from ..obs.events import (
     TRIAL_CANCELLED,
     TRIAL_CLAIMED,
@@ -80,16 +81,27 @@ class ExecutorTrials(Trials):
         return self.n_workers
 
     def __init__(self, n_workers=4, traceable=False, timeout=None,
-                 exp_key=None, refresh=True):
+                 retry=None, exp_key=None, refresh=True):
         self.n_workers = int(n_workers)
         self.traceable = bool(traceable)
-        # per-trial wall-clock budget (the SparkTrials(timeout=) analog):
-        # a RUNNING trial older than this is moved to JOB_STATE_CANCEL by the
+        # per-trial budget (the SparkTrials(timeout=) analog): a RUNNING
+        # trial past its deadline is moved to JOB_STATE_CANCEL by the
         # driver's poll loop; the orphaned worker thread's eventual result is
         # discarded.  Python threads can't be killed — cancellation is a
         # state-level guarantee (fmin never waits on it), not a CPU reclaim,
         # matching Spark's job-group cancel semantics at the trial-doc level.
+        # Deadlines are MONOTONIC-clock, stamped at claim time (ISSUE 8):
+        # wall-clock arithmetic on book_time meant an NTP step or a
+        # suspended host could mass-cancel every healthy in-flight trial.
         self.timeout = timeout
+        # per-trial retry policy (retry.py): a raising objective is re-run
+        # in place with jittered exponential backoff, the attempt count
+        # recorded in misc['attempts'] — None/0 keeps the old
+        # fail-immediately behavior
+        self.retry = RetryPolicy.coerce(retry)
+        self._deadlines = {}  # tid -> monotonic cancel deadline
+        self._monotonic = time.monotonic  # injectable for fake-clock tests
+        self._sleep = time.sleep
         self._lock = threading.RLock()
         self._pool = None
         self._domain_cache = None
@@ -141,19 +153,28 @@ class ExecutorTrials(Trials):
     # -- claim / evaluate --------------------------------------------------
 
     def _claim(self, trial):
-        """Atomically move NEW -> RUNNING (MongoJobs.reserve analog)."""
+        """Atomically move NEW -> RUNNING (MongoJobs.reserve analog).
+        The cancel deadline is stamped HERE, from the monotonic clock —
+        claim time is the only moment both the budget and the clock are
+        known to be fresh."""
         with self._lock:
             if trial["state"] != JOB_STATE_NEW:
                 return False
             trial["state"] = JOB_STATE_RUNNING
             trial["book_time"] = coarse_utcnow()
             trial["owner"] = threading.current_thread().name
+            if self.timeout is not None:
+                self._deadlines[trial["tid"]] = (
+                    self._monotonic() + self.timeout)
         self.obs_events.emit(TRIAL_CLAIMED, trial["tid"],
                              owner=trial["owner"])
         return True
 
     def _finish(self, trial, result=None, error=None):
         with self._lock:
+            # the monotonic deadline dies with the trial whatever the
+            # outcome — only live RUNNING docs are budget-tracked
+            self._deadlines.pop(trial["tid"], None)
             if trial["state"] == JOB_STATE_CANCEL:
                 self.metrics.counter("results.discarded").inc()
                 return  # timed out meanwhile: the late result is discarded
@@ -188,17 +209,30 @@ class ExecutorTrials(Trials):
             doc["refresh_time"] = coarse_utcnow()
 
     def _cancel_timed_out(self):
-        """RUNNING → CANCEL for trials over the per-trial budget (SparkTrials
-        timeout policy: hyperopt/spark.py sym: _FMinState timeout handling).
-        Runs under the driver's poll cadence."""
+        """RUNNING → CANCEL for trials past their MONOTONIC deadline
+        (SparkTrials timeout policy: hyperopt/spark.py sym: _FMinState
+        timeout handling).  Runs under the driver's poll cadence.
+
+        Deadlines are stamped at claim time from ``time.monotonic`` — the
+        old wall-clock ``now - book_time`` arithmetic meant an NTP step or
+        a laptop resume could instantly "age" every healthy RUNNING trial
+        past its budget and mass-cancel them.  A RUNNING trial with no
+        recorded deadline (resumed from a checkpoint: monotonic values are
+        meaningless across processes/boots) is granted a fresh full budget
+        on first sight rather than cancelled on a clock it never saw."""
         if self.timeout is None:
             return
         with self._lock:
+            now_mono = self._monotonic()
             now = coarse_utcnow()
             for t in self._dynamic_trials:
                 if t["state"] != JOB_STATE_RUNNING or t.get("book_time") is None:
                     continue
-                if (now - t["book_time"]).total_seconds() >= self.timeout:
+                deadline = self._deadlines.get(t["tid"])
+                if deadline is None:
+                    self._deadlines[t["tid"]] = now_mono + self.timeout
+                    continue
+                if now_mono >= deadline:
                     t["state"] = JOB_STATE_CANCEL
                     # merge, don't overwrite: a Ctrl.checkpoint partial
                     # result must survive cancellation
@@ -208,6 +242,7 @@ class ExecutorTrials(Trials):
                         f"trial exceeded per-trial timeout {self.timeout}s",
                     )
                     t["refresh_time"] = now
+                    self._deadlines.pop(t["tid"], None)
                     self.metrics.counter("trials.timeouts").inc()
                     self.obs_events.emit(TRIAL_CANCELLED, t["tid"],
                                          reason="trial_timeout")
@@ -225,12 +260,19 @@ class ExecutorTrials(Trials):
                     t["result"] = {**(t.get("result") or {}), "status": STATUS_FAIL}
                     t["misc"]["error"] = ("Cancelled", "fmin timeout")
                     t["refresh_time"] = coarse_utcnow()
+                    self._deadlines.pop(t["tid"], None)
                     self.metrics.counter("trials.cancelled").inc()
                     self.obs_events.emit(TRIAL_CANCELLED, t["tid"],
                                          reason="fmin_timeout")
 
     def _run_one(self, trial):
-        """Evaluate one claimed trial (MongoWorker.run_one analog)."""
+        """Evaluate one claimed trial (MongoWorker.run_one analog), with
+        the per-trial retry policy: a raising objective re-runs in place
+        after a jittered exponential backoff, up to ``retry.max_retries``
+        extra attempts, the attempt count recorded in
+        ``misc['attempts']``.  A trial cancelled (timeout / fmin timeout)
+        between attempts is NOT retried — the state-level cancel guarantee
+        outranks the retry budget."""
         domain = self._get_domain()
         if domain is None or not self._claim(trial):
             return
@@ -242,12 +284,38 @@ class ExecutorTrials(Trials):
         t0 = time.perf_counter()
         try:
             spec = spec_from_misc(trial["misc"])
-            result = domain.evaluate(spec, Ctrl(self, current_trial=trial))
-        except Exception as e:  # worker crash must not kill the driver
-            logger.error("async job exception: %s", e)
-            self._finish(trial, error=e)
-        else:
-            self._finish(trial, result=result)
+            attempt = 0
+            while True:
+                with self._lock:
+                    if trial["state"] != JOB_STATE_RUNNING:
+                        # cancelled during the backoff sleep (trial or
+                        # fmin timeout): the doc is already terminal —
+                        # re-evaluating would burn a full objective run
+                        # whose result _finish must then discard
+                        self.metrics.counter("results.discarded").inc()
+                        break
+                trial["misc"]["attempts"] = attempt + 1
+                try:
+                    result = domain.evaluate(
+                        spec, Ctrl(self, current_trial=trial))
+                except Exception as e:  # crash must not kill the driver
+                    with self._lock:
+                        cancelled = trial["state"] != JOB_STATE_RUNNING
+                    if cancelled or not self.retry.retries_left(attempt + 1):
+                        logger.error("async job exception: %s", e)
+                        self._finish(trial, error=e)
+                        break
+                    delay = self.retry.delay(attempt, key=trial["tid"])
+                    self.metrics.counter("trials.retries").inc()
+                    self.metrics.histogram("retry.backoff_sec").observe(delay)
+                    logger.warning(
+                        "trial %s attempt %d failed (%s); retrying in %.2fs",
+                        trial["tid"], attempt + 1, e, delay)
+                    self._sleep(delay)
+                    attempt += 1
+                else:
+                    self._finish(trial, result=result)
+                    break
         finally:
             self.metrics.counter("worker_busy_sec").inc(
                 time.perf_counter() - t0)
@@ -381,13 +449,22 @@ class ExecutorTrials(Trials):
         state["_batch_eval_cache"] = None
         # a resumed process has no workers yet: NEW docs must redispatch there
         state["_dispatched"] = set()
+        # monotonic deadlines are meaningless in another process/boot:
+        # _cancel_timed_out re-stamps resumed RUNNING trials on first sight
+        state["_deadlines"] = {}
+        state["_monotonic"] = None
+        state["_sleep"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._lock = threading.RLock()
+        self._monotonic = time.monotonic
+        self._sleep = time.sleep
         # checkpoints written by older versions predate these attributes
         self.__dict__.setdefault("_dispatched", set())
+        self.__dict__.setdefault("_deadlines", {})
+        self.__dict__.setdefault("retry", RetryPolicy(0))
         self.__dict__.setdefault(
             "metrics", MetricsRegistry(f"executor-{next(_instance_ids)}"))
         self.__dict__.setdefault("obs_events", EventLog())

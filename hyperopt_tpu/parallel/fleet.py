@@ -1,0 +1,388 @@
+"""Elastic, preemption-native fleet driver: ``fmin_multihost`` over leased
+work shards instead of ``jax.distributed`` collectives.
+
+The collective driver (``driver.fmin_multihost``) is bitwise-deterministic
+but membership-static: its result exchange is ``process_allgather``, so a
+controller lost mid-generation leaves every survivor blocked in a
+collective that will never complete.  This module runs the SAME algorithm —
+same proposals, same fold order, same digest, bitwise-identical history —
+with the exchange moved onto the filestore lease plane
+(:mod:`~hyperopt_tpu.parallel.membership`):
+
+* every controller computes the full generation's proposals locally
+  (deterministic in ``(seed, generation, history)`` — replicated compute
+  buys zero coordination);
+* evaluation ownership is **leased per shard** (``j % n_shards``); a
+  controller claims, heartbeats, evaluates and publishes shard results as
+  atomic blobs in the store;
+* a survivor **reclaims** a dead controller's stale lease and re-runs the
+  shard — determinism makes the duplicate publish byte-identical, so
+  at-least-once execution folds into an exactly-once history;
+* the generation barrier is "every occupied shard has a published
+  result", which any fleet size (including ONE survivor) can satisfy —
+  controllers may join or leave at any point, not just between
+  generations, because mid-generation state lives in the store, not in a
+  collective schedule;
+* the store doubles as the checkpoint: a controller (re)starting on a
+  populated store replays completed generations by reading published
+  shard blobs instead of evaluating, so a resumed fleet of a *different*
+  size reaches a bitwise-identical history (``run_params`` — including
+  ``n_shards`` — are pinned write-once in the store and verified by every
+  joiner).
+
+The divergence checksum survives the redesign: each controller publishes
+its cumulative fold digest per generation (``checksum.<owner>``) and
+cross-checks every other controller's — a mismatch raises
+:class:`~hyperopt_tpu.parallel.driver.ControllerDivergence` exactly as the
+allgathered digest does in collective mode.
+
+Chaos sites (``hyperopt_tpu.chaos``): ``gen`` at each generation start,
+``claim`` before each lease claim, ``trial`` before each objective call,
+``publish`` before each shard publish, ``checkpoint`` before each
+checkpoint write.  Disarmed, every site is one attribute check.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import chaos
+from ..exceptions import AllTrialsFailed, FleetDegraded
+from ..obs import ObsConfig, RunObs
+from ..spaces import compile_space
+from ..algos import tpe
+from . import payload as payload_mod
+from .membership import FleetMembership, n_occupied_shards, shard_trials
+
+__all__ = ["fleet_fmin"]
+
+
+def fleet_fmin(fn, space, max_evals, fleet_dir, batch=None, seed=0, cfg=None,
+               n_startup=None, n_shards=None, lease_ttl=15.0,
+               checkpoint_file=None, obs=None, owner=None,
+               poll_interval=0.05, barrier_timeout=600.0):
+    """Minimize ``fn`` over ``space`` as one controller of an elastic
+    fleet rooted at ``fleet_dir``.  Run any number of these concurrently
+    (separate plain processes — no ``jax.distributed`` runtime required);
+    each returns the same :class:`~.driver.MultihostResult`, bitwise
+    identical to ``fmin_multihost(..., _force_single=True)`` at the same
+    ``(seed, batch, cfg)``.
+
+    ``n_shards`` fixes the generation's work-shard count (default
+    ``min(batch, 8)``) and is pinned in the store's ``params.json`` — the
+    re-bucketing invariant that lets a resumed fleet of a different size
+    replay bitwise.  ``lease_ttl`` is the heartbeat staleness bound after
+    which survivors reclaim a dead controller's shard.
+    ``barrier_timeout`` (monotonic deadline) bounds the wait for a
+    generation to complete; on expiry the controller checkpoints what is
+    verified and raises :class:`FleetDegraded` instead of hanging.
+    """
+    from .driver import (ControllerDivergence, MultihostResult, _default_cfg,
+                         _digest_generation, _gen_seed)
+    from .._env import (enable_persistent_compilation_cache, parse_hist_dtype)
+
+    if not isinstance(obs, RunObs):
+        obs = RunObs(ObsConfig.resolve(obs))
+
+    cs = compile_space(space)
+    labels = cs.labels
+    if batch is None:
+        batch = len(jax.devices())
+    cfg = dict(cfg or {})
+    enable_persistent_compilation_cache(cfg.pop("compile_cache", None))
+    cfg = dict(_default_cfg(batch), **cfg)
+    if n_startup is None:
+        n_startup = max(batch, 20)
+    if n_shards is None:
+        n_shards = max(1, min(int(batch), 8))
+    n_shards = int(n_shards)
+
+    run_params = {"labels": list(labels), "batch": int(batch),
+                  "seed": int(seed), "n_startup": int(n_startup),
+                  "cfg": sorted(cfg.items()), "n_shards": n_shards}
+
+    member = FleetMembership(fleet_dir, owner=owner, lease_ttl=lease_ttl,
+                             metrics=obs.metrics)
+    member.ensure_params(run_params)
+    member.join()
+    obs.event("fleet_controller", owner=member.owner, n_shards=n_shards,
+              lease_ttl=lease_ttl)
+
+    saved = None
+    if checkpoint_file is not None:
+        import os
+
+        if os.path.exists(checkpoint_file):
+            # trust boundary: same pickle-trust warning as the collective
+            # driver's checkpoint_file (docs/DESIGN.md "Observability &
+            # trust") — the fleet store adds params.json verification on
+            # top, but the snapshot itself is a pickle
+            t0 = time.perf_counter()
+            with open(checkpoint_file, "rb") as f:
+                saved = pickle.load(f)
+            obs.histogram("checkpoint.load_sec").observe(
+                time.perf_counter() - t0)
+        if saved is not None:
+            for k, v in run_params.items():
+                if saved["run_params"].get(k) != v:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_file} was written with "
+                        f"{k}={saved['run_params'].get(k)!r}; this run has "
+                        f"{k}={v!r} — bitwise resume requires identical "
+                        "run parameters")
+            if saved["n_done"] % batch and saved["n_done"] < max_evals:
+                raise ValueError(
+                    f"checkpoint ends in a partial final generation "
+                    f"(n_done={saved['n_done']}, batch={batch}): a completed "
+                    "run cannot be extended bitwise — delete the checkpoint "
+                    "to start a fresh run")
+
+    cap = 128
+    while cap < max(max_evals, saved["n_done"] if saved else 0):
+        cap *= 2
+    hist = {
+        "losses": np.full(cap, np.inf, np.float32),
+        "has_loss": np.zeros(cap, bool),
+        "vals": {l: np.zeros(cap, np.float32) for l in labels},
+        "active": {l: np.zeros(cap, bool) for l in labels},
+    }
+    raw_losses = np.full(cap, np.nan, np.float32)
+
+    propose_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg),
+                                  in_axes=(None, 0)))
+    sample_fn = jax.jit(jax.vmap(cs.sample_flat))
+    hist_dt = jnp.dtype(parse_hist_dtype())
+
+    def device_history():
+        # full upload per generation, compressed to the storage dtype the
+        # same way the collective single path does (bitwise parity): the
+        # fleet path optimizes survivability, not HBM traffic
+        return jax.tree.map(
+            lambda x: (jnp.asarray(x).astype(hist_dt)
+                       if np.issubdtype(np.asarray(x).dtype, np.floating)
+                       else jnp.asarray(x)), hist)
+
+    def local_keys(gseed):
+        return jax.vmap(
+            lambda i: jax.random.fold_in(jax.random.PRNGKey(gseed), i)
+        )(jnp.arange(batch, dtype=jnp.uint32))
+
+    digest = hashlib.sha256()
+    n_done = 0
+    gen = 0
+    if saved is not None:
+        n_done = saved["n_done"]
+        gen = n_done // batch
+        hist["losses"][:n_done] = saved["losses"]
+        hist["has_loss"][:n_done] = saved["has_loss"]
+        raw_losses[:n_done] = saved["raw_losses"]
+        for l in labels:
+            hist["vals"][l][:n_done] = saved["vals"][l]
+            hist["active"][l][:n_done] = saved["active"][l]
+        if n_done:
+            rows = np.concatenate(
+                [np.asarray(saved["raw_losses"], np.float32)[:, None]]
+                + [np.asarray(saved["vals"][l], np.float32)[:, None]
+                   for l in labels], axis=1)
+            digest.update(np.ascontiguousarray(rows, np.float32).tobytes())
+
+    def _save_checkpoint():
+        """Atomic generation-boundary snapshot.  Unlike the collective
+        driver there is no distinguished controller 0 — membership is
+        elastic — so EVERY controller writes; the bytes are identical by
+        the divergence guarantee, so last-write-wins is a no-op."""
+        if checkpoint_file is None:
+            return
+        from ..filestore import _atomic_write
+
+        chaos.point("checkpoint", metrics=obs.metrics)
+        state = {
+            "run_params": run_params,
+            "n_done": n_done,
+            "losses": hist["losses"][:n_done].copy(),
+            "has_loss": hist["has_loss"][:n_done].copy(),
+            "raw_losses": raw_losses[:n_done].copy(),
+            "vals": {l: hist["vals"][l][:n_done].copy() for l in labels},
+            "active": {l: hist["active"][l][:n_done].copy() for l in labels},
+        }
+        t0 = time.perf_counter()
+        _atomic_write(checkpoint_file, pickle.dumps(state))
+        obs.histogram("checkpoint.save_sec").observe(
+            time.perf_counter() - t0)
+
+    L_n = len(labels)
+
+    def flat_j(flats, j):
+        return {
+            l: (int(round(float(flats[l][j]))) if cs.params[l].is_int
+                else float(flats[l][j]))
+            for l in labels
+        }
+
+    def evaluate_shard(flats, gen, shard, js):
+        """Evaluate one claimed shard, heartbeating the lease between
+        trials (a single trial longer than the TTL may be reclaimed and
+        re-run elsewhere — the duplicate publish is byte-identical)."""
+        losses_s = np.full(len(js), np.nan, np.float32)
+        active_s = np.zeros((len(js), L_n), bool)
+        for k, j in enumerate(js):
+            chaos.point("trial", metrics=obs.metrics)
+            flat = flat_j(flats, j)
+            act = cs.active_flat(flat)
+            active_s[k] = [bool(act[l]) for l in labels]
+            try:
+                losses_s[k] = float(fn(cs.assemble(flat)))
+            except Exception:
+                losses_s[k] = np.nan
+                obs.counter("trials.failed").inc()
+            member.heartbeat_shard(gen, shard)
+        return losses_s, active_s
+
+    while n_done < max_evals:
+        obs.heartbeat("driver.gen", gen=gen, n_done=n_done,
+                      owner=member.owner)
+        obs.devmem_sample()
+        chaos.point("gen", metrics=obs.metrics)
+        member.heartbeat_member()
+        B = min(batch, max_evals - n_done)
+        S_gen = n_occupied_shards(B, n_shards)
+        gseed = _gen_seed(seed, gen)
+        with obs.annotate("driver.gen", step=gen, gen=gen, n_done=n_done), \
+                obs.span("propose", gen=gen):
+            if n_done < n_startup:
+                out = sample_fn(local_keys(gseed))
+            else:
+                out = propose_fn(device_history(), local_keys(gseed))
+            flats = {l: np.asarray(out[l]) for l in labels}
+
+        # evaluate-or-adopt until every occupied shard has a result: claim
+        # missing shards, reclaim stale leases, poll — bounded by a
+        # MONOTONIC deadline (NTP steps must not shrink the barrier).
+        # The deadline measures LIVENESS, not generation wall time: it
+        # re-arms whenever the barrier observes progress — a shard
+        # publishing, a reclaim, or a missing shard's lease mtime
+        # advancing (a live holder heartbeating through a long objective).
+        # A fleet evaluating 10-minute trials must never degrade while
+        # someone is visibly working; only a barrier where NOTHING moves
+        # for barrier_timeout seconds (a stalled-but-never-stale holder,
+        # or external store mutation) is declared degraded.
+        deadline = time.monotonic() + barrier_timeout
+        barrier_view = None
+        with obs.span("evaluate", gen=gen):
+            while True:
+                missing = member.missing_shards(gen, S_gen)
+                if not missing:
+                    break
+                progressed = False
+                for s in member.claim_order(missing):
+                    chaos.point("claim", metrics=obs.metrics)
+                    if not member.try_claim(gen, s):
+                        continue
+                    progressed = True
+                    js = shard_trials(B, n_shards, s)
+                    losses_s, active_s = evaluate_shard(flats, gen, s, js)
+                    blob = pickle.dumps(
+                        {"shard": int(s), "js": js, "losses": losses_s,
+                         "active": active_s}, protocol=4)
+                    chaos.point("publish", metrics=obs.metrics)
+                    member.publish(gen, s, blob)
+                if progressed:
+                    deadline = time.monotonic() + barrier_timeout
+                    continue
+                if member.reclaim_stale(gen, S_gen):
+                    deadline = time.monotonic() + barrier_timeout
+                    continue
+                view = (tuple(missing),
+                        tuple(member.lease_mtimes(gen, missing)))
+                if view != barrier_view:
+                    barrier_view = view
+                    deadline = time.monotonic() + barrier_timeout
+                if time.monotonic() >= deadline:
+                    _save_checkpoint()
+                    obs.event("fleet_barrier_timeout", gen=gen,
+                              missing=list(missing))
+                    raise FleetDegraded(
+                        f"generation {gen} incomplete after "
+                        f"{barrier_timeout:.0f}s (shards {missing} leased "
+                        "but never published and never went stale); "
+                        "verified history checkpointed — restart the fleet "
+                        "(any size) on the same store to resume bitwise")
+                member.heartbeat_member()
+                time.sleep(poll_interval)
+
+        # assemble the generation in global trial-id order from the
+        # published blobs (mine and everyone else's look identical)
+        losses = np.full(B, np.nan, np.float32)
+        active_rows = np.zeros((B, L_n), bool)
+        for s in range(S_gen):
+            blob = member.read_result(gen, s)
+            if blob is None:  # result swept between barrier and read?
+                raise FleetDegraded(
+                    f"shard result gen={gen} shard={s} vanished after the "
+                    "barrier — the fleet store is being mutated externally")
+            rec = pickle.loads(blob)
+            js = np.asarray(rec["js"], int)
+            losses[js] = rec["losses"]
+            active_rows[js] = rec["active"]
+
+        with obs.span("fold", gen=gen):
+            payload_mod.fold_generation(
+                hist, raw_losses, n_done, labels,
+                {l: flats[l][:B] for l in labels}, losses, active_rows)
+            _digest_generation(digest, labels, flats, losses, B)
+        n_done += B
+        gen += 1
+        obs.counter("generations").inc()
+        obs.counter("trials.completed").inc(B)
+        done_live = hist["has_loss"][:n_done]
+        if done_live.any():
+            obs.gauge("best_loss").set(float(
+                hist["losses"][:n_done][done_live].min()))
+
+        # divergence audit: publish my cumulative digest, cross-check every
+        # controller that folded this generation (dead controllers simply
+        # never wrote one — absence is not divergence)
+        my_sum = digest.hexdigest()
+        member.write_checksum(gen - 1, my_sum)
+        others = member.read_checksums(gen - 1)
+        bad = {o: c for o, c in others.items() if c != my_sum}
+        if bad:
+            obs.event("controller_divergence", owner=member.owner,
+                      n_done=int(n_done), gen=int(gen - 1),
+                      mine=my_sum, others=bad)
+            obs.counter("divergences").inc()
+            raise ControllerDivergence(
+                f"fleet history checksums diverged after {n_done} trials: "
+                f"mine={my_sum} theirs={bad}")
+        _save_checkpoint()
+
+    live = hist["has_loss"][:n_done]
+    losses_all = hist["losses"][:n_done]
+    if not live.any():
+        raise AllTrialsFailed(
+            f"all {n_done} trials failed (objective raised on every call)")
+    best_i = int(np.argmin(np.where(live, losses_all, np.inf)))
+    best_flat = {
+        l: (int(round(float(hist["vals"][l][best_i])))
+            if cs.params[l].is_int else float(hist["vals"][l][best_i]))
+        for l in labels
+    }
+    member.leave()
+    obs.finish()
+    return MultihostResult(
+        best=cs.assemble(best_flat),
+        best_loss=float(losses_all[best_i]),
+        n_evals=n_done,
+        losses=losses_all.copy(),
+        vals={l: hist["vals"][l][:n_done].copy() for l in labels},
+        checksum=digest.hexdigest(),
+        active={l: hist["active"][l][:n_done].copy() for l in labels},
+        _cs=cs,
+    )

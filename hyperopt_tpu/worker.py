@@ -22,9 +22,11 @@ import sys
 import threading
 import time
 
+from . import chaos
 from .base import Ctrl, JOB_STATE_NEW, JOB_STATE_RUNNING, spec_from_misc
 from .filestore import FileStore, FileTrials, ReserveTimeout
 from .obs.watchdog import beat as _wd_beat, get_watchdog
+from .retry import RetryPolicy
 
 __all__ = ["FileWorker", "main"]
 
@@ -35,13 +37,17 @@ class FileWorker:
     """One worker loop bound to a store (mongoexp.py sym: MongoWorker)."""
 
     def __init__(self, store_root, poll_interval=0.25, heartbeat_interval=2.0,
-                 stale_after=30.0, workdir=None):
+                 stale_after=30.0, workdir=None, retry=None):
         self.store = FileStore(store_root)
         self.store_root = store_root
         self.poll_interval = float(poll_interval)
         self.heartbeat_interval = float(heartbeat_interval)
         self.stale_after = float(stale_after)
         self.workdir = workdir
+        # per-trial retry policy (retry.py): flaky objectives re-run in
+        # place with jittered backoff while the heartbeat thread keeps the
+        # claim fresh; None/0 keeps the fail-immediately reference behavior
+        self.retry = RetryPolicy.coerce(retry)
         self.owner = f"{socket.gethostname()}:{os.getpid()}"
         self._domain = None
         # forensics: a SIGTERM'd/crashed worker dumps its flight ring into
@@ -67,15 +73,24 @@ class FileWorker:
 
     def run_one(self, reserve_timeout=None):
         """Reserve and evaluate one job (mongoexp.py sym: MongoWorker.run_one).
-        Raises ReserveTimeout if nothing could be claimed in time."""
-        deadline = None if reserve_timeout is None else time.time() + reserve_timeout
+        Raises ReserveTimeout if nothing could be claimed in time (a
+        MONOTONIC deadline: an NTP step must not expire the poll early)."""
+        deadline = (None if reserve_timeout is None
+                    else time.monotonic() + reserve_timeout)
         while True:
             _wd_beat("worker.poll", owner=self.owner)
-            self.store.reclaim_stale(self.stale_after)
-            doc = self.store.reserve(self.owner)
+            try:
+                self.store.reclaim_stale(self.stale_after)
+                doc = self.store.reserve(self.owner)
+            except OSError as e:
+                # transient store I/O failure (NFS blip, chaos-injected):
+                # a poll loop that dies on one bad write defeats the whole
+                # reclaim story — log, back off a beat, poll again
+                logger.warning("store I/O error while polling: %s", e)
+                doc = None
             if doc is not None:
                 break
-            if deadline is not None and time.time() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 raise ReserveTimeout(f"no job within {reserve_timeout}s")
             time.sleep(self.poll_interval)
 
@@ -97,27 +112,63 @@ class FileWorker:
 
         def beat():
             while not stop.wait(self.heartbeat_interval):
-                self.store.heartbeat(doc)
+                try:
+                    self.store.heartbeat(doc)
+                except OSError as e:
+                    # a failed heartbeat WRITE (chaos-injected or a real
+                    # NFS blip) must not kill the beat loop: a skipped
+                    # beat is recoverable (worst case a stale reclaim
+                    # re-runs deterministic work), a silently-dead beat
+                    # thread guarantees the reclaim
+                    logger.warning("heartbeat write failed for %s: %s",
+                                   doc["tid"], e)
                 # the store heartbeat proves the THREAD is alive; this one
                 # tells the stall watchdog which trial the worker is inside
                 _wd_beat("worker.trial", tid=doc["tid"], owner=self.owner)
 
-        hb = threading.Thread(target=beat, daemon=True)
+        hb = threading.Thread(target=beat, daemon=True,
+                              name=f"hyperopt-heartbeat-{doc['tid']}")
         hb.start()
         error = None
         result = None
         try:
             spec = spec_from_misc(doc["misc"])
             trials = FileTrials(self.store_root, refresh=False)
-            result = domain.evaluate(spec, Ctrl(trials, current_trial=doc))
-        except Exception as e:
-            error = e
+            ctrl = Ctrl(trials, current_trial=doc)
+            attempt = 0
+            while True:
+                # per-trial retry loop (retry.py): the heartbeat thread
+                # stays up across attempts and backoff sleeps, so the
+                # claim never goes stale while the trial is being retried;
+                # the attempt count rides the doc into the terminal state
+                doc["misc"]["attempts"] = attempt + 1
+                chaos.point("trial", metrics=self.store.metrics)
+                try:
+                    result = domain.evaluate(spec, ctrl)
+                    error = None
+                    break
+                except Exception as e:
+                    error = e
+                    if not self.retry.retries_left(attempt + 1):
+                        break
+                    delay = self.retry.delay(
+                        attempt, key=f"{self.owner}:{doc['tid']}")
+                    self.store.metrics.counter("trials.retries").inc()
+                    self.store.metrics.histogram(
+                        "retry.backoff_sec").observe(delay)
+                    logger.warning(
+                        "job %s attempt %d failed (%s); retrying in %.2fs",
+                        doc["tid"], attempt + 1, e, delay)
+                    time.sleep(delay)
+                    attempt += 1
         finally:
-            # the heartbeat must be fully stopped BEFORE finish() removes
-            # running/<tid>.pkl — a concurrent beat could pass its existence
-            # check and resurrect the file, which reclaim_stale would later
-            # move back to NEW and re-evaluate a finished (or deterministic-
-            # failure) trial
+            # the heartbeat must be fully stopped on EVERY exit path —
+            # including an objective exception or a raise from
+            # spec/ctrl construction — BEFORE finish() removes
+            # running/<tid>.pkl: a still-beating thread could pass its
+            # existence check and resurrect the file, which a concurrent
+            # reclaim_stale would later move back to NEW and re-evaluate a
+            # finished (or deterministic-failure) trial
             stop.set()
             hb.join(timeout=30)
         if hb.is_alive():
@@ -127,12 +178,22 @@ class FileWorker:
             logger.error("job %s: heartbeat thread stuck; leaving claim for "
                          "stale reclaim", doc["tid"])
             return False
-        if error is not None:
-            logger.error("job %s failed: %s", doc["tid"], error)
-            self.store.finish(doc, error=error)
+        try:
+            if error is not None:
+                logger.error("job %s failed: %s", doc["tid"], error)
+                self.store.finish(doc, error=error)
+                return False
+            self.store.finish(doc, result=result)
+            return True
+        except OSError as e:
+            # the terminal write failed (NFS blip, chaos-injected): the
+            # claim (running doc or orphaned *.finish.* rename) is exactly
+            # what the stale-reclaim/orphan-sweep machinery recovers —
+            # surviving here beats taking the worker down with the store
+            logger.warning("store I/O error finishing job %s: %s "
+                           "(claim left for stale/orphan recovery)",
+                           doc["tid"], e)
             return False
-        self.store.finish(doc, result=result)
-        return True
 
 
 def main(argv=None):
@@ -148,15 +209,26 @@ def main(argv=None):
                    help="exit after this long without claiming a job")
     p.add_argument("--max-jobs", type=int, default=sys.maxsize)
     p.add_argument("--workdir", default=None)
+    p.add_argument("--retries", type=int, default=None,
+                   help="extra per-trial attempts after a raising objective "
+                        "(jittered exponential backoff; default: "
+                        "HYPEROPT_TPU_TRIAL_RETRIES or 0)")
+    p.add_argument("--retry-base-delay", type=float, default=0.5,
+                   help="base backoff before the first retry (doubles per "
+                        "attempt, jittered)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    retry = (RetryPolicy.from_env() if args.retries is None
+             else RetryPolicy(max_retries=args.retries,
+                              base_delay=args.retry_base_delay))
     worker = FileWorker(
         args.store,
         poll_interval=args.poll_interval,
         heartbeat_interval=args.heartbeat_interval,
         stale_after=args.stale_after,
         workdir=args.workdir,
+        retry=retry,
     )
     consecutive_failures = 0
     done = 0

@@ -24,6 +24,12 @@
 # CPU-backend device-capture round trip — /profile?sec=1 against a live
 # run, merge the capture artifact with the host spans, validate the
 # merged trace (scripts/validate_trace.py --profile-self-test).
+# Opt-in chaos gate: CHAOS_GATE=1 additionally re-runs the resilience
+# suites and then scripts/chaos_smoke.py — a real 3-controller elastic
+# fleet under a seeded SIGTERM/SIGKILL schedule must converge to a final
+# history bit-identical to the undisturbed same-seed run, leave readable
+# flight dumps in the store, and replay bitwise when resumed at a
+# different fleet size.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -52,5 +58,11 @@ if [ "${SHARD_GATE:-0}" = "1" ]; then
 fi
 if [ "${PROFILE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_trace.py --profile-self-test || exit 1
+fi
+if [ "${CHAOS_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_membership.py tests/test_chaos.py \
+        tests/test_fleet.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
 fi
 exit 0

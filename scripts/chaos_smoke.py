@@ -1,0 +1,140 @@
+"""Chaos gate (``CHAOS_GATE=1 ./run_tests.sh``): a 3-controller elastic
+fleet survives a seeded SIGTERM/SIGKILL schedule and converges to a final
+history BIT-IDENTICAL to the undisturbed same-seed run.
+
+What it drives, end-to-end with real processes (no fakes — the same
+doctrine as tests/test_multihost.py):
+
+1. launches three ``tests/_fleet_child.py`` controllers on one shared
+   fleet store, each with its own deterministic ``HYPEROPT_TPU_CHAOS``
+   schedule: controller 0 takes a SIGTERM at its 3rd generation
+   (flight-recorder dump path), controller 1 takes a SIGKILL at its 2nd
+   shard publish (stale-lease reclaim path, no dump possible), controller
+   2 runs clean and must finish;
+2. asserts every surviving controller printed the SAME checksum, equal to
+   an in-process undisturbed reference run (fleet mode, one controller,
+   fresh store) AND to the collective single-process driver — the full
+   bitwise-convergence claim of ISSUE 8;
+3. asserts the SIGTERM'd controller's flight dump is readable through
+   ``FileStore.read_flight_dumps()`` and records the chaos injection;
+4. replays the finished store with one more controller ("resumed at a
+   different size") and asserts the replay is bitwise-identical too.
+
+Exit 0 prints ``CHAOS_SMOKE_OK``.
+
+NOTE: this box has ONE CPU core (see the verify skill's hardware facts) —
+run the gate sequentially, never concurrently with another
+CPU-saturating job (e.g. a full pytest run): three jax controllers
+starved of cycles can blow realistic lease/barrier budgets and the gate
+then measures the scheduler, not the fleet.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_fleet_child.py")
+sys.path.insert(0, REPO)
+
+SEED = 0
+MAX_EVALS = 48
+BATCH = 8
+N_SHARDS = 4
+LEASE_TTL = 2.0
+
+
+def _child_env(chaos_spec):
+    from hyperopt_tpu._env import forced_cpu_env
+
+    env = forced_cpu_env(dict(os.environ), n_devices=1)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("HYPEROPT_TPU_CHAOS", None)
+    if chaos_spec:
+        env["HYPEROPT_TPU_CHAOS"] = chaos_spec
+    return env
+
+
+def main():
+    from hyperopt_tpu.filestore import FileStore
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    obj = lambda d: float(dom.objective(d))  # noqa: E731
+
+    # undisturbed references: the collective single-process driver AND a
+    # one-controller fleet on a fresh store must already agree bitwise
+    ref = fmin_multihost(obj, dom.space, max_evals=MAX_EVALS, batch=BATCH,
+                         seed=SEED, _force_single=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        fleet_ref = fmin_multihost(
+            obj, dom.space, max_evals=MAX_EVALS, batch=BATCH, seed=SEED,
+            fleet_dir=os.path.join(tmp, "ref"), n_shards=N_SHARDS,
+            lease_ttl=LEASE_TTL)
+        assert fleet_ref.checksum == ref.checksum, \
+            "fleet mode diverged from the collective driver UNDISTURBED"
+
+        fleet_dir = os.path.join(tmp, "chaos")
+        schedules = [
+            "7:term@gen:3",      # dies mid-run with a flight dump
+            "7:kill@publish:2",  # dies holding a lease: reclaim path
+            None,                # clean survivor
+        ]
+        args = [sys.executable, CHILD, fleet_dir, "--seed", str(SEED),
+                "--max-evals", str(MAX_EVALS), "--batch", str(BATCH),
+                "--n-shards", str(N_SHARDS), "--lease-ttl", str(LEASE_TTL)]
+        procs = [subprocess.Popen(args, env=_child_env(spec), cwd=REPO,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True)
+                 for spec in schedules]
+        outs = [p.communicate(timeout=600) for p in procs]
+
+        survivors = []
+        for i, (p, (out, err)) in enumerate(zip(procs, outs)):
+            if p.returncode == 0:
+                assert "FLEET_OK" in out, (i, out, err[-2000:])
+                survivors.append(
+                    [tok.split("=", 1)[1] for tok in out.split()
+                     if tok.startswith("checksum=")][0])
+            else:
+                # the scheduled deaths: SIGTERM (-15) / SIGKILL (-9)
+                assert p.returncode in (-15, -9, 1), (i, p.returncode,
+                                                      err[-2000:])
+        assert survivors, (
+            "every controller died — the fleet did not survive:\n"
+            + "\n".join(
+                f"--- child {i} (chaos={schedules[i]}) rc={p.returncode}\n"
+                f"{err[-1500:]}"
+                for i, (p, (out, err)) in enumerate(zip(procs, outs))))
+        for c in survivors:
+            assert c == ref.checksum, (
+                f"chaos-run checksum {c} != undisturbed {ref.checksum}")
+        print(f"chaos fleet: {len(survivors)}/3 controllers survived, "
+              f"checksum converged bitwise")
+
+        # forensics: the SIGTERM'd controller dumped its flight ring into
+        # the store's attachments, injection recorded
+        dumps = FileStore(fleet_dir).read_flight_dumps()
+        assert dumps, "no flight dump found for the SIGTERM'd controller"
+        chaos_recs = [r for recs in dumps.values() for r in recs
+                      if r.get("kind") == "chaos"]
+        assert chaos_recs, f"no chaos record in flight dumps {list(dumps)}"
+        print(f"flight dumps collected from {sorted(dumps)} "
+              f"({len(chaos_recs)} chaos injection record(s))")
+
+        # resumed at a different size: one fresh controller replays the
+        # finished store bitwise
+        replay = fmin_multihost(
+            obj, dom.space, max_evals=MAX_EVALS, batch=BATCH, seed=SEED,
+            fleet_dir=fleet_dir, n_shards=N_SHARDS, lease_ttl=LEASE_TTL)
+        assert replay.checksum == ref.checksum, "store replay diverged"
+        print("post-chaos store replay: bitwise identical")
+
+    print("CHAOS_SMOKE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

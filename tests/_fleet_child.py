@@ -1,0 +1,55 @@
+"""Child process for tests/test_fleet.py and scripts/chaos_smoke.py: one
+controller of an elastic fleet.
+
+Usage::
+
+    python _fleet_child.py <fleet_dir> [--seed S] [--max-evals N]
+        [--batch B] [--n-shards K] [--lease-ttl T] [--echo-evals]
+        [--owner NAME]
+
+Joins the lease plane rooted at ``fleet_dir``, runs the elastic
+``fmin_multihost(fleet_dir=...)`` driver on the branin domain, and prints
+``FLEET_OK checksum=<hex> evals=<n>`` on success.  ``--echo-evals`` prints
+one ``EVAL <k>`` line per objective call (flushed) so a parent can time a
+SIGKILL to land mid-generation.  Chaos arms itself from
+``HYPEROPT_TPU_CHAOS`` in the child's environment — the parent scripts
+hand each controller its own schedule.
+"""
+
+import argparse
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("fleet_dir")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-evals", type=int, default=48)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--n-shards", type=int, default=4)
+    p.add_argument("--lease-ttl", type=float, default=2.0)
+    p.add_argument("--echo-evals", action="store_true")
+    args = p.parse_args()
+
+    from hyperopt_tpu.parallel.driver import fmin_multihost
+    from hyperopt_tpu.zoo import ZOO
+
+    dom = ZOO["branin"]
+    calls = {"n": 0}
+
+    def obj(d):
+        calls["n"] += 1
+        if args.echo_evals:
+            print(f"EVAL {calls['n']}", flush=True)
+        return float(dom.objective(d))
+
+    res = fmin_multihost(
+        obj, dom.space, max_evals=args.max_evals, batch=args.batch,
+        seed=args.seed, fleet_dir=args.fleet_dir, n_shards=args.n_shards,
+        lease_ttl=args.lease_ttl)
+    print(f"FLEET_OK checksum={res.checksum} evals={res.n_evals} "
+          f"best={res.best_loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,473 @@
+"""Resilience-layer tests (ISSUE 8): the deterministic fault-injection
+plane (``hyperopt_tpu.chaos``), the retry/backoff policy (``retry.py``),
+monotonic-clock trial deadlines and retries in the executor, the worker's
+heartbeat-join + retry hardening, and the filestore reserve backoff.
+
+The acceptance pin rides here too: with chaos DISARMED a run starts zero
+new threads and its proposals are bit-identical to a never-imported-chaos
+run — the same invariant every obs plane in this repo holds.
+"""
+
+import datetime
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import hyperopt_tpu.chaos as chaos
+import hyperopt_tpu.filestore as filestore_mod
+from hyperopt_tpu import JOB_STATE_DONE, JOB_STATE_ERROR, fmin, hp
+from hyperopt_tpu.base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_RUNNING,
+    Domain,
+    Trials,
+    coarse_utcnow,
+)
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.filestore import FileTrials
+from hyperopt_tpu.parallel import ExecutorTrials
+from hyperopt_tpu.retry import RetryPolicy
+from hyperopt_tpu.worker import FileWorker
+
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def quad(d):
+    return (d["x"] - 1.0) ** 2
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    """Every test leaves the process disarmed (env is clean in the suite,
+    so reset() == disarmed)."""
+    yield
+    chaos.reset()
+
+
+def _insert_new(trials, domain, n, seed=0):
+    from hyperopt_tpu.algos import rand
+
+    ids = trials.new_trial_ids(n)
+    docs = rand.suggest(ids, domain, trials, seed)
+    trials.insert_trial_docs(docs)
+    return ids
+
+
+# ---------------------------------------------------------------------------
+# chaos spec grammar + determinism
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_valid():
+    plan = chaos.parse_spec("7:kill@gen:2;ioerr@io:0.5;stall@trial:1.0:0.1")
+    assert plan is not None and plan.seed == 7
+    assert [r.action for r in plan.rules] == ["kill", "ioerr", "stall"]
+    assert plan.rules[0].count == 2
+    assert plan.rules[1].prob == 0.5
+    assert plan.rules[2].sec == 0.1
+
+
+@pytest.mark.parametrize("raw", [
+    "", "0", "off",            # explicitly disabled
+    "nonsense",                # no seed
+    "7:",                      # no rules
+    "x:kill@gen:1",            # bad seed
+    "7:frob@gen:1",            # unknown action
+    "7:kill@gen",              # missing count
+    "7:stall@gen:0.5",         # missing seconds
+    "7:ioerr@io:notafloat",    # bad probability
+])
+def test_parse_spec_disarms_on_bad_or_empty(raw):
+    assert chaos.parse_spec(raw) is None
+
+
+def test_count_rule_fires_on_exact_hit():
+    plan = chaos.parse_spec("1:term@gen:3")
+    assert plan.check("gen") == []
+    assert plan.check("gen") == []
+    assert plan.check("gen") == [("term",)]
+    assert plan.check("gen") == []          # one-shot
+    assert plan.check("other") == []        # site-scoped
+
+
+def test_probabilistic_schedule_is_seeded_deterministic():
+    a = chaos.parse_spec("42:ioerr@io:0.3")
+    b = chaos.parse_spec("42:ioerr@io:0.3")
+    seq_a = [bool(a.check("io", io=True)) for _ in range(200)]
+    seq_b = [bool(b.check("io", io=True)) for _ in range(200)]
+    assert seq_a == seq_b
+    assert any(seq_a) and not all(seq_a)  # it actually fires, sometimes
+    c = chaos.parse_spec("43:ioerr@io:0.3")
+    assert seq_a != [bool(c.check("io", io=True)) for _ in range(200)]
+
+
+def test_ioerr_ignored_at_plain_points():
+    plan = chaos.configure("1:ioerr@gen:1.0")
+    assert plan.check("gen", io=False) == []  # point() never raises
+    assert plan.check("gen", io=True) == [("ioerr",)]
+
+
+def test_io_point_raises_through_atomic_write(tmp_path):
+    chaos.configure("3:ioerr@io:1.0")
+    with pytest.raises(OSError, match="chaos"):
+        filestore_mod._atomic_write(str(tmp_path / "f"), b"x")
+    chaos.configure(None)
+    filestore_mod._atomic_write(str(tmp_path / "f"), b"x")  # disarmed: fine
+    assert (tmp_path / "f").read_bytes() == b"x"
+
+
+def test_stall_sleeps_at_site():
+    chaos.configure("5:stall@gen:1.0:0.05")
+    t0 = time.perf_counter()
+    chaos.point("gen")
+    assert time.perf_counter() - t0 >= 0.03
+
+
+def test_term_kills_process_at_scheduled_site():
+    code = ("import hyperopt_tpu.chaos as c; c.configure('1:term@x:2'); "
+            "c.point('x'); print('alive', flush=True); c.point('x'); "
+            "print('unreachable', flush=True)")
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu",
+                            "PALLAS_AXON_POOL_IPS": ""})
+    assert "alive" in p.stdout
+    assert "unreachable" not in p.stdout
+    assert p.returncode != 0  # died at the 2nd hit
+
+
+def test_injection_counted_in_metrics():
+    from hyperopt_tpu.obs import MetricsRegistry
+
+    reg = MetricsRegistry("chaos-test")
+    chaos.configure("1:stall@gen:1.0:0.0")
+    chaos.point("gen", metrics=reg)
+    assert reg.counter("chaos.stall.gen").value == 1
+
+
+def test_disarmed_no_new_threads_and_proposals_bit_identical():
+    def run(seed=11):
+        t = Trials()
+        fmin(quad, SPACE, algo=tpe.suggest, max_evals=10, trials=t,
+             rstate=np.random.default_rng(seed), show_progressbar=False)
+        return t
+
+    chaos.reset()  # env-resolved: disarmed
+    t_plain = run()
+    before = {th.name for th in threading.enumerate()}
+    t_again = run()
+    after = {th.name for th in threading.enumerate()}
+    assert after - before == set()  # chaos plane starts NOTHING
+    # armed-on-a-never-hit-site is behaviorally identical too (no draws
+    # outside matched sites)
+    chaos.configure("9:kill@nosuchsite:1")
+    t_armed = run()
+    assert t_plain.losses() == t_again.losses() == t_armed.losses()
+    for a, b in zip(t_plain.trials, t_armed.trials):
+        assert a["misc"]["vals"] == b["misc"]["vals"]
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_deterministic_jittered_backoff():
+    p = RetryPolicy(max_retries=3, base_delay=0.5, max_delay=4.0, jitter=0.5)
+    d0 = p.delay(0, key="t1")
+    assert d0 == p.delay(0, key="t1")       # deterministic
+    assert 0.25 <= d0 <= 0.5                # jitter window
+    assert p.delay(0, key="t2") != d0       # keys decorrelate
+    assert p.delay(10, key="t1") <= 4.0     # capped
+    assert RetryPolicy(1, jitter=0.0).delay(2) == 2.0  # pure exponential
+
+
+def test_retry_policy_coerce_and_budget():
+    assert RetryPolicy.coerce(None).max_retries == 0
+    assert RetryPolicy.coerce(3).max_retries == 3
+    p = RetryPolicy(2)
+    assert RetryPolicy.coerce(p) is p
+    assert p.retries_left(1) and p.retries_left(2) and not p.retries_left(3)
+    with pytest.raises(TypeError):
+        RetryPolicy.coerce("nope")
+
+
+def test_retry_policy_from_env():
+    assert RetryPolicy.from_env({}).max_retries == 0
+    p = RetryPolicy.from_env({"HYPEROPT_TPU_TRIAL_RETRIES": "2:0.1"})
+    assert p.max_retries == 2 and p.base_delay == 0.1
+    assert RetryPolicy.from_env(
+        {"HYPEROPT_TPU_TRIAL_RETRIES": "bogus"}).max_retries == 0
+
+
+# ---------------------------------------------------------------------------
+# executor: monotonic deadlines + retries
+# ---------------------------------------------------------------------------
+
+
+def test_executor_cancel_uses_monotonic_not_wall_clock():
+    t = ExecutorTrials(n_workers=1, timeout=10.0, refresh=False)
+    fake = {"now": 1000.0}
+    t._monotonic = lambda: fake["now"]
+    doc = {"tid": 1, "state": JOB_STATE_RUNNING, "misc": {},
+           "result": None, "book_time": coarse_utcnow(), "owner": "w"}
+    t._dynamic_trials.append(doc)
+    t._deadlines[1] = fake["now"] + 10.0
+    # NTP step / suspended host: wall book_time is suddenly 10 hours old,
+    # but the monotonic deadline has NOT expired — the trial must survive
+    doc["book_time"] = coarse_utcnow() - datetime.timedelta(hours=10)
+    t._cancel_timed_out()
+    assert doc["state"] == JOB_STATE_RUNNING
+    # real elapsed time past the budget: cancelled
+    fake["now"] += 10.5
+    t._cancel_timed_out()
+    assert doc["state"] == JOB_STATE_CANCEL
+    assert 1 not in t._deadlines
+    t.shutdown()
+
+
+def test_executor_resumed_running_trial_gets_fresh_budget():
+    t = ExecutorTrials(n_workers=1, timeout=10.0, refresh=False)
+    fake = {"now": 50.0}
+    t._monotonic = lambda: fake["now"]
+    # a RUNNING doc from a resumed checkpoint: no deadline recorded (the
+    # old process's monotonic clock is meaningless here)
+    doc = {"tid": 7, "state": JOB_STATE_RUNNING, "misc": {},
+           "result": None, "book_time": coarse_utcnow(), "owner": "w"}
+    t._dynamic_trials.append(doc)
+    t._cancel_timed_out()
+    assert doc["state"] == JOB_STATE_RUNNING  # stamped, not cancelled
+    assert t._deadlines[7] == 60.0
+    fake["now"] = 61.0
+    t._cancel_timed_out()
+    assert doc["state"] == JOB_STATE_CANCEL
+    t.shutdown()
+
+
+def test_executor_deadlines_not_pickled():
+    t = ExecutorTrials(n_workers=1, timeout=10.0, refresh=False)
+    t._deadlines[3] = 123.0
+    state = t.__getstate__()
+    assert state["_deadlines"] == {}
+    t.shutdown()
+
+
+def test_executor_retries_flaky_objective_and_records_attempts():
+    calls = {"n": 0}
+
+    def flaky(d):
+        calls["n"] += 1
+        if calls["n"] % 2 == 1:  # every first attempt fails
+            raise RuntimeError("transient")
+        return quad(d)
+
+    t = ExecutorTrials(n_workers=1,
+                       retry=RetryPolicy(max_retries=2, base_delay=0.01))
+    fmin(flaky, SPACE, algo=tpe.suggest, max_evals=2, trials=t,
+         max_queue_len=1, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    t.shutdown()
+    assert t.count_by_state_unsynced(JOB_STATE_DONE) == 2
+    assert [d["misc"]["attempts"] for d in t.trials] == [2, 2]
+    assert t.metrics.counter("trials.retries").value == 2
+    assert t.metrics.histogram("retry.backoff_sec").count == 2
+
+
+def test_executor_cancel_during_backoff_stops_retries():
+    from hyperopt_tpu.algos import rand
+
+    calls = {"n": 0}
+
+    def bad(d):
+        calls["n"] += 1
+        raise RuntimeError("always")
+
+    t = ExecutorTrials(n_workers=1, refresh=False,
+                       retry=RetryPolicy(max_retries=5, base_delay=0.01))
+    domain = Domain(bad, SPACE)
+    t.attachments["FMinIter_Domain"] = domain
+    (trial,) = rand.suggest(t.new_trial_ids(1), domain, t, 0)
+    t._dynamic_trials.append(trial)
+
+    def cancel_during_backoff(delay):
+        with t._lock:
+            trial["state"] = JOB_STATE_CANCEL
+
+    t._sleep = cancel_during_backoff
+    t._run_one(trial)
+    # the docstring guarantee: a trial cancelled between attempts is NOT
+    # re-evaluated (the re-run's result could only ever be discarded)
+    assert calls["n"] == 1
+    assert t.metrics.counter("results.discarded").value >= 1
+    t.shutdown()
+
+
+def test_executor_deadlines_cleared_on_normal_finish():
+    t = ExecutorTrials(n_workers=1, timeout=60.0)
+    fmin(quad, SPACE, algo=tpe.suggest, max_evals=3, trials=t,
+         max_queue_len=1, rstate=np.random.default_rng(0),
+         show_progressbar=False)
+    t.shutdown()
+    assert t.count_by_state_unsynced(JOB_STATE_DONE) == 3
+    assert t._deadlines == {}  # no per-trial leak over a long run
+
+
+def test_executor_no_retry_by_default():
+    def bad(d):
+        raise RuntimeError("permanent")
+
+    t = ExecutorTrials(n_workers=1)
+    with pytest.raises(Exception):
+        fmin(bad, SPACE, algo=tpe.suggest, max_evals=2, trials=t,
+             max_queue_len=1, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+    t.shutdown()
+    assert t.count_by_state_unsynced(JOB_STATE_ERROR) == 2
+    assert all(d["misc"]["attempts"] == 1 for d in t.trials)
+
+
+# ---------------------------------------------------------------------------
+# worker: heartbeat lifecycle + retries
+# ---------------------------------------------------------------------------
+
+
+def _hb_threads():
+    return [th for th in threading.enumerate()
+            if th.is_alive() and th.name.startswith("hyperopt-heartbeat")]
+
+
+def test_worker_joins_heartbeat_on_objective_exception(tmp_path):
+    def bad(d):
+        raise RuntimeError("objective boom")
+
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(bad, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 1)
+    w = FileWorker(str(tmp_path / "s"), poll_interval=0.05,
+                   heartbeat_interval=0.05)
+    assert w.run_one(reserve_timeout=5) is False
+    # the satellite fix: no beating thread may survive the exception path
+    # (a leaked beat can resurrect running/<tid>.pkl after a concurrent
+    # reclaim already swept it)
+    assert _hb_threads() == []
+    t.refresh()
+    assert t.count_by_state_unsynced(JOB_STATE_ERROR) == 1
+    (doc,) = w.store.load_all()
+    assert doc["misc"]["attempts"] == 1
+
+
+def test_worker_retries_then_succeeds_and_records_attempts(tmp_path):
+    marker = tmp_path / "failed_once"
+
+    def flaky(d):
+        if not marker.exists():
+            marker.write_text("x")
+            raise RuntimeError("transient")
+        return quad(d)
+
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(flaky, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 1)
+    w = FileWorker(str(tmp_path / "s"), poll_interval=0.05,
+                   heartbeat_interval=0.05,
+                   retry=RetryPolicy(max_retries=2, base_delay=0.01))
+    assert w.run_one(reserve_timeout=5) is True
+    assert _hb_threads() == []
+    t.refresh()
+    assert t.count_by_state_unsynced(JOB_STATE_DONE) == 1
+    assert t.trials[0]["misc"]["attempts"] == 2
+    assert w.store.metrics.counter("trials.retries").value >= 1
+
+
+def test_worker_heartbeat_thread_survives_store_write_failure(tmp_path,
+                                                              monkeypatch):
+    marker = tmp_path / "evaluated"  # the domain is CLOUDPICKLED: closure
+    # state would mutate the worker's copy, not ours — mark via the fs
+
+    def slowish(d, _marker=str(marker)):
+        time.sleep(0.3)  # several heartbeat intervals
+        with open(_marker, "w") as f:
+            f.write("x")
+        return quad(d)
+
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(slowish, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 1)
+    w = FileWorker(str(tmp_path / "s"), poll_interval=0.05,
+                   heartbeat_interval=0.05)
+
+    def bad_heartbeat(doc):
+        raise OSError("nfs blip")
+
+    # every heartbeat WRITE fails: the beat loop must log-and-continue
+    # (a dead beat thread would guarantee a stale reclaim of live work),
+    # and the trial still finishes
+    monkeypatch.setattr(w.store, "heartbeat", bad_heartbeat)
+    assert w.run_one(reserve_timeout=5) is True
+    assert marker.exists()
+    assert _hb_threads() == []
+    t.refresh()
+    assert t.count_by_state_unsynced(JOB_STATE_DONE) == 1
+
+
+def test_worker_poll_loop_survives_injected_store_io_error(tmp_path):
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(quad, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 1)
+    w = FileWorker(str(tmp_path / "s"), poll_interval=0.01,
+                   heartbeat_interval=0.05)
+    # seeded intermittent store-write failure: reserve retries through it
+    chaos.configure("11:ioerr@io:0.5")
+    try:
+        ok = w.run_one(reserve_timeout=10)
+    finally:
+        chaos.configure(None)
+    assert _hb_threads() == []
+    t.refresh()
+    if ok:  # finish() may itself have lost its write — the claim survives
+        assert t.count_by_state_unsynced(JOB_STATE_DONE) == 1
+
+
+# ---------------------------------------------------------------------------
+# filestore: reserve contention backoff
+# ---------------------------------------------------------------------------
+
+
+def test_reserve_backs_off_on_contention(tmp_path, monkeypatch):
+    t = FileTrials(tmp_path / "s")
+    domain = Domain(quad, SPACE)
+    t.attachments["FMinIter_Domain"] = cloudpickle.dumps(domain)
+    _insert_new(t, domain, 3)
+    store = t.store
+    sleeps = []
+    store._sleep = sleeps.append
+
+    real_rename = os.rename
+    fails = {"n": 2}
+
+    def contended(src, dst, *a, **kw):
+        if "running" in str(dst) and fails["n"] > 0:
+            fails["n"] -= 1
+            raise FileNotFoundError(src)  # another worker won the race
+        return real_rename(src, dst, *a, **kw)
+
+    monkeypatch.setattr(filestore_mod.os, "rename", contended)
+    doc = store.reserve("me")
+    assert doc is not None  # third candidate claimed
+    assert len(sleeps) == 2
+    assert 0 < sleeps[0] <= 0.001        # attempt 0: jittered 1ms base
+    assert sleeps[1] <= 0.002            # attempt 1: doubled, capped
+    hist = store.metrics.histogram("reserve.backoff_sec")
+    assert hist.count >= 2
+    assert store.metrics.counter("reserve.contention").value >= 2

@@ -1,0 +1,268 @@
+"""Elastic-fleet driver tests (ISSUE 8 tentpole).
+
+Doctrine (SURVEY.md §4): "distributed" is tested as REAL local processes.
+The crash test SIGKILLs an actual ``fmin_multihost(fleet_dir=...)``
+controller subprocess mid-generation and resumes the store with a fleet of
+a DIFFERENT size, which must reach a bitwise-identical history — the
+re-bucketing invariant plus lease reclaim, end to end.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.exceptions import FleetDegraded
+from hyperopt_tpu.parallel.driver import _timed_gather, fmin_multihost
+from hyperopt_tpu.obs import RunObs
+from hyperopt_tpu.zoo import ZOO
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_fleet_child.py")
+
+DOM = ZOO["branin"]
+
+
+def _obj(d):
+    return float(DOM.objective(d))
+
+
+def _child_env():
+    from hyperopt_tpu._env import forced_cpu_env
+
+    env = forced_cpu_env(dict(os.environ), n_devices=1)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("HYPEROPT_TPU_CHAOS", None)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity with the collective driver
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_single_controller_matches_collective_bitwise(tmp_path):
+    ref = fmin_multihost(_obj, DOM.space, max_evals=24, batch=8, seed=3,
+                         _force_single=True)
+    r = fmin_multihost(_obj, DOM.space, max_evals=24, batch=8, seed=3,
+                       fleet_dir=str(tmp_path / "f"), n_shards=4)
+    assert r.checksum == ref.checksum
+    assert r.best_loss == ref.best_loss
+    np.testing.assert_array_equal(r.losses, ref.losses)
+    for l in r.vals:
+        np.testing.assert_array_equal(r.vals[l], ref.vals[l])
+
+
+def test_fleet_partial_final_generation(tmp_path):
+    # max_evals not a multiple of batch: the short generation occupies only
+    # the first B shards and still folds bitwise vs the collective driver
+    ref = fmin_multihost(_obj, DOM.space, max_evals=20, batch=8, seed=3,
+                         _force_single=True)
+    r = fmin_multihost(_obj, DOM.space, max_evals=20, batch=8, seed=3,
+                       fleet_dir=str(tmp_path / "f"), n_shards=4)
+    assert r.n_evals == 20
+    assert r.checksum == ref.checksum
+
+
+def test_fleet_store_replay_and_extension_bitwise(tmp_path):
+    ref = fmin_multihost(_obj, DOM.space, max_evals=48, batch=8, seed=3,
+                         _force_single=True)
+    fdir = str(tmp_path / "f")
+    fmin_multihost(_obj, DOM.space, max_evals=24, batch=8, seed=3,
+                   fleet_dir=fdir, n_shards=4)
+    # the store IS the checkpoint: a later (restarted) controller replays
+    # the 3 published generations without re-evaluating, then evaluates on
+    r = fmin_multihost(_obj, DOM.space, max_evals=48, batch=8, seed=3,
+                       fleet_dir=fdir, n_shards=4)
+    assert r.checksum == ref.checksum
+    np.testing.assert_array_equal(r.losses, ref.losses)
+
+
+def test_fleet_params_pinned_write_once(tmp_path):
+    fdir = str(tmp_path / "f")
+    fmin_multihost(_obj, DOM.space, max_evals=8, batch=8, seed=3,
+                   fleet_dir=fdir, n_shards=4)
+    with pytest.raises(ValueError, match="identical params"):
+        fmin_multihost(_obj, DOM.space, max_evals=8, batch=8, seed=4,
+                       fleet_dir=fdir, n_shards=4)
+    with pytest.raises(ValueError, match="identical params"):
+        # n_shards is part of the pinned re-bucketing structure
+        fmin_multihost(_obj, DOM.space, max_evals=8, batch=8, seed=3,
+                       fleet_dir=fdir, n_shards=2)
+
+
+def test_fleet_divergence_checksum_detected(tmp_path):
+    from hyperopt_tpu.parallel.driver import ControllerDivergence
+    from hyperopt_tpu.parallel.membership import FleetMembership
+
+    fdir = str(tmp_path / "f")
+    evil = FleetMembership(fdir, owner="evil")
+    evil.write_checksum(0, "deadbeef")  # a controller that folded garbage
+    with pytest.raises(ControllerDivergence):
+        fmin_multihost(_obj, DOM.space, max_evals=8, batch=8, seed=3,
+                       fleet_dir=fdir, n_shards=4)
+
+
+def test_fleet_failed_trials_fold_bitwise(tmp_path):
+    # the failure must be DETERMINISTIC IN THE SAMPLE (the fleet contract:
+    # shards evaluate in lease order, not global call order — an objective
+    # keyed on call count would fail different trials per topology, which
+    # is exactly the nondeterminism the divergence checksum exists to
+    # catch)
+    def flaky(d):
+        if (float(d["x"]) * 10) % 1 < 0.2:  # ~20% of samples, value-keyed
+            raise RuntimeError("flaky trial")
+        return _obj(d)
+
+    ref = fmin_multihost(flaky, DOM.space, max_evals=24, batch=8, seed=0,
+                         _force_single=True)
+    assert np.isinf(ref.losses).any()  # some trials really failed
+    r = fmin_multihost(flaky, DOM.space, max_evals=24, batch=8, seed=0,
+                       fleet_dir=str(tmp_path / "f"), n_shards=4)
+    assert r.checksum == ref.checksum  # NaN raw losses digest identically
+
+
+# ---------------------------------------------------------------------------
+# degrade-to-shrink: the collective timeout path
+# ---------------------------------------------------------------------------
+
+
+def test_timed_gather_passthrough_and_errors():
+    obs = RunObs()
+    assert _timed_gather(lambda: 42, None, "x", obs, lambda: None) == 42
+    assert _timed_gather(lambda: 42, 5.0, "x", obs, lambda: None) == 42
+    with pytest.raises(RuntimeError, match="boom"):
+        _timed_gather(_raise, 5.0, "x", obs, lambda: None)
+
+
+def _raise():
+    raise RuntimeError("boom")
+
+
+def test_timed_gather_degrades_to_checkpoint_and_shrink():
+    obs = RunObs()
+    saved = {"n": 0}
+
+    def hung_collective():
+        time.sleep(60)  # the peer never arrives
+
+    def on_timeout():
+        saved["n"] += 1  # the driver passes _save_checkpoint(force=True)
+        return True      # ...which reports whether a snapshot was written
+
+    t0 = time.monotonic()
+    with pytest.raises(FleetDegraded, match="restart the surviving fleet"):
+        _timed_gather(hung_collective, 0.2, "results", obs, on_timeout)
+    assert time.monotonic() - t0 < 5.0  # degraded, did not hang
+    assert saved["n"] == 1
+    assert obs.metrics.counter("allgather.timeouts").value == 1
+    # without a written checkpoint the message must NOT promise one
+    with pytest.raises(FleetDegraded, match="NO checkpoint was written"):
+        _timed_gather(hung_collective, 0.2, "results", obs, lambda: False)
+
+
+def test_fleet_barrier_rearms_while_lease_heartbeats(tmp_path):
+    # the barrier deadline measures LIVENESS, not generation wall time: a
+    # missing shard whose lease mtime keeps advancing (a live holder deep
+    # in a long objective) must hold the barrier open well past
+    # barrier_timeout; once the heartbeats FREEZE, the barrier degrades
+    # within ~barrier_timeout
+    import threading
+
+    from hyperopt_tpu.parallel.fleet import fleet_fmin
+    from hyperopt_tpu.parallel.membership import FleetMembership
+
+    fdir = str(tmp_path / "f")
+    holder = FleetMembership(fdir, owner="holder", lease_ttl=1000.0)
+    assert holder.try_claim(0, 0)  # shard 0 of gen 0, never published
+
+    barrier_timeout = 0.8
+    marks = {"t_barrier": None, "t_stop": None}
+    stop = threading.Event()
+
+    def beat():
+        # wait until the fleet has published every OTHER shard (it is now
+        # blocked on ours), then heartbeat through 3x the barrier budget
+        while not stop.is_set():
+            if holder.missing_shards(0, 4) == [0]:
+                break
+            time.sleep(0.05)
+        marks["t_barrier"] = time.monotonic()
+        end = time.monotonic() + 3 * barrier_timeout
+        while time.monotonic() < end and not stop.is_set():
+            holder.heartbeat_shard(0, 0)
+            time.sleep(0.1)
+        marks["t_stop"] = time.monotonic()
+
+    th = threading.Thread(target=beat, daemon=True)
+    th.start()
+    try:
+        with pytest.raises(FleetDegraded, match="incomplete after"):
+            fleet_fmin(_obj, DOM.space, max_evals=8, fleet_dir=fdir,
+                       batch=8, seed=3, n_shards=4, lease_ttl=1000.0,
+                       poll_interval=0.02, barrier_timeout=barrier_timeout)
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    t_raise = time.monotonic()
+    assert marks["t_barrier"] is not None
+    # held open across the heartbeat window (a frozen deadline would have
+    # degraded ~barrier_timeout after the barrier was reached)
+    assert t_raise - marks["t_barrier"] >= 2 * barrier_timeout
+    # and degraded promptly once liveness froze
+    assert marks["t_stop"] is not None
+
+
+# ---------------------------------------------------------------------------
+# crash-resume at a different fleet size (real processes, real SIGKILL)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_sigkill_mid_generation_resume_different_size(tmp_path):
+    ref = fmin_multihost(_obj, DOM.space, max_evals=48, batch=8, seed=0,
+                         _force_single=True)
+    fdir = str(tmp_path / "f")
+    args = [sys.executable, CHILD, fdir, "--seed", "0", "--max-evals", "48",
+            "--batch", "8", "--n-shards", "4", "--lease-ttl", "1.5"]
+
+    # leg 1: ONE controller, SIGKILLed mid-generation (after the 12th
+    # objective call = inside generation 1, holding a shard lease and
+    # having published part of the generation)
+    p = subprocess.Popen(args + ["--echo-evals"], env=_child_env(),
+                         cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True)
+    evals = 0
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        line = p.stdout.readline()
+        if not line:
+            break
+        if line.startswith("EVAL"):
+            evals += 1
+            if evals >= 12:
+                break
+    assert evals >= 12, f"child produced only {evals} evals"
+    os.kill(p.pid, signal.SIGKILL)
+    p.wait(timeout=60)
+    assert p.returncode == -signal.SIGKILL
+
+    # leg 2: a DIFFERENTLY-SIZED fleet (two controllers) adopts the store:
+    # replays published shards, reclaims the dead controller's stale
+    # lease, evaluates the rest — and must land on the reference bitwise
+    procs = [subprocess.Popen(args, env=_child_env(), cwd=REPO,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    sums = []
+    for q in procs:
+        out, err = q.communicate(timeout=300)
+        assert q.returncode == 0, f"resume child rc={q.returncode}\n{err[-3000:]}"
+        assert "FLEET_OK" in out, out
+        sums.append([tok.split("=", 1)[1] for tok in out.split()
+                     if tok.startswith("checksum=")][0])
+    assert sums == [ref.checksum] * 2, (sums, ref.checksum)
