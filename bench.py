@@ -1099,6 +1099,123 @@ def bench_sharded_suggest():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_multi_study(n_studies=1024, waves=4, seq_studies=128, seed=0):
+    """ISSUE 9 headline stage: serving throughput of the multi-study
+    batched suggest at ``n_studies`` (default 1k) concurrent studies.
+
+    Workload: ``zoo.make_study_mix`` — heterogeneous spaces, so the
+    scheduler runs several cohorts at once.  Startup waves seed each
+    study past ``n_startup_jobs`` by random search, then ``waves``
+    measured ask waves run ONE batched fused tell+ask program per cohort
+    (``tpe.build_suggest_batched``) for every study; losses are a cheap
+    deterministic host function of the proposal (the stage measures the
+    serving hot path — real-objective convergence is the SERVICE_GATE's
+    job).  The sequential-loop baseline drives an identical mix subset
+    through the single-study ``tpe.suggest`` path — one fused device
+    dispatch per study per wave, the pre-batching architecture — and the
+    headline is the per-study throughput ratio (acceptance bar: ≥ 8×).
+
+    Reported: ``studies_per_sec`` (batched asks served per wall second),
+    ``study_ask_p50/p99_ms`` (per-ask completion latency; every ask in a
+    wave completes with its wave — named apart from the single-study
+    ``ask_*_ms`` keys so the tail-mined gate series never mix the two),
+    ``slot_utilization_frac`` (occupied cohort slots / total — pow2 slot
+    padding is the honest denominator), ``vs_sequential_x``.
+    """
+    import numpy as _np
+
+    from hyperopt_tpu import zoo as zoo_mod
+    from hyperopt_tpu.base import Domain, Trials
+    from hyperopt_tpu.algos import tpe as tpe_mod
+    from hyperopt_tpu.service import StudyScheduler
+
+    def cheap_loss(params):
+        # deterministic, shape-free stand-in objective: keeps the stage's
+        # wall clock on the serving path instead of host jnp evaluation
+        return float(_np.sin(sum(float(v) for v in params.values())))
+
+    mix = zoo_mod.make_study_mix(n_studies, seed0=seed)
+    sched = StudyScheduler(max_studies=max(n_studies, 4096))
+    sids = [sched.create_study(m.domain.space, seed=m.seed,
+                               n_startup_jobs=m.n_startup_jobs)
+            for m in mix]
+
+    def wave(n=1):
+        answers = sched.ask_many([(sid, n) for sid in sids])
+        for sid in sids:
+            for a in answers[sid]:
+                sched.tell(sid, a["tid"], cheap_loss(a["params"]))
+
+    n_startup = mix[0].n_startup_jobs
+    for _ in range(n_startup):  # random-search seeding, unmeasured
+        wave()
+    wave()  # first TPE wave: pays the per-cohort XLA compiles, unmeasured
+
+    wave_sec = []
+    for _ in range(waves):
+        t0 = time.perf_counter()
+        wave()
+        wave_sec.append(time.perf_counter() - t0)
+    per_ask_ms = sorted(1e3 * s for s in wave_sec for _ in range(n_studies))
+    # best-of-waves, the repo bench convention ("honest strict-readback
+    # best-of-3"): the shared box's contention spikes hit whole waves, and
+    # the min is the reproducible figure (the tails still ride ask_p99_ms)
+    best = min(wave_sec)
+
+    # sequential-loop baseline: identical mix subset, one single-study
+    # fused dispatch per study per wave (what the service replaced)
+    sub = zoo_mod.make_study_mix(seq_studies, seed0=seed)
+    seq = []
+    for m in sub:
+        t = Trials()
+        dom = Domain(None, m.domain.space)
+        rstate = _np.random.default_rng(m.seed)
+        seq.append((t, dom, rstate))
+    from hyperopt_tpu.algos import rand as rand_mod
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK, spec_from_misc
+
+    def seq_wave():
+        t0 = time.perf_counter()
+        for t, dom, rstate in seq:
+            ids = t.new_trial_ids(1)
+            s = int(rstate.integers(2**31 - 1))
+            if len(t.trials) < n_startup:
+                docs = rand_mod.suggest(ids, dom, t, s)
+            else:
+                docs = tpe_mod.suggest(ids, dom, t, s,
+                                       n_startup_jobs=n_startup)
+            t.insert_trial_docs(docs)
+            t.refresh()
+            for d in docs:
+                d["result"] = {"loss": cheap_loss(spec_from_misc(d["misc"])),
+                               "status": STATUS_OK}
+                d["state"] = JOB_STATE_DONE
+            t.refresh()
+        return time.perf_counter() - t0
+
+    for _ in range(n_startup + 1):  # seeding + compile wave, unmeasured
+        seq_wave()
+    seq_sec = [seq_wave() for _ in range(waves)]
+    seq_rate = seq_studies / max(min(seq_sec), 1e-9)
+
+    rate = n_studies / max(best, 1e-9)
+    status = sched.studies_status()
+    return {
+        "n_studies": n_studies,
+        "waves": waves,
+        "studies_per_sec": rate,
+        "study_ask_p50_ms": per_ask_ms[len(per_ask_ms) // 2],
+        "study_ask_p99_ms": per_ask_ms[min(len(per_ask_ms) - 1,
+                                           int(0.99 * len(per_ask_ms)))],
+        "slot_utilization_frac": status["slot_utilization"],
+        "n_cohorts": len(status["cohorts"]),
+        "cohort_cache": status["cohort_cache"],
+        "sequential_studies_per_sec": seq_rate,
+        "sequential_subset": seq_studies,
+        "vs_sequential_x": rate / max(seq_rate, 1e-9),
+    }
+
+
 def bench_pallas_ei(n=8192, reps=5, seed=0):
     """jnp-vs-pallas crossover for the fused two-model EI score
     (``pallas_ei.ei_diff``) by COMPONENT COUNT — the axis the MEASURED
@@ -1195,6 +1312,9 @@ _JAX_STAGES = (
     # jnp-vs-pallas EI crossover by component count (ISSUE 6 satellite):
     # keeps pallas_ei.py's MEASURED VERDICT current; jnp-only off TPU
     ("pallas_ei", bench_pallas_ei),
+    # ISSUE 9 headline: 1k concurrent studies batched onto cohort ticks —
+    # studies/sec, per-ask p99, slot utilization, vs the sequential loop
+    ("multi_study", bench_multi_study),
 )
 
 _PROBE_SNIPPET = (
@@ -1408,6 +1528,15 @@ def main():
             "cand_batch_multiple": ss.get("cand_batch_multiple"),
             "bf16_reduction_x": ss.get("bf16_reduction_x"),
         }
+    # the multi-study serving throughput (ISSUE 9 tentpole) rides the
+    # headline line: studies/sec at 1k concurrent studies, per-ask p99,
+    # slot utilization and the vs-sequential-loop multiple
+    rec = stages.get("multi_study")
+    if rec and rec.get("ok"):
+        obs_summary["multi_study"] = {
+            k: rec["result"].get(k)
+            for k in ("n_studies", "studies_per_sec", "study_ask_p99_ms",
+                      "slot_utilization_frac", "vs_sequential_x")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact
@@ -1456,6 +1585,11 @@ def main():
             "history_bytes": _stage_val("devmem", "history_bytes"),
             "profiler_overhead_frac": _stage_val(
                 "profiler_overhead", "profiler_overhead_frac"),
+            "studies_per_sec": _stage_val("multi_study", "studies_per_sec"),
+            "study_ask_p99_ms": _stage_val("multi_study",
+                                           "study_ask_p99_ms"),
+            "slot_utilization_frac": _stage_val("multi_study",
+                                                "slot_utilization_frac"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

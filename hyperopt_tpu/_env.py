@@ -25,6 +25,10 @@ __all__ = [
     "parse_hist_shard_min",
     "parse_pallas",
     "parse_allgather_timeout",
+    "parse_service",
+    "parse_service_max_studies",
+    "parse_service_max_pending",
+    "parse_service_idle_sec",
 ]
 
 logger = logging.getLogger(__name__)
@@ -191,6 +195,90 @@ def parse_allgather_timeout(env=None):
         _warn_once("HYPEROPT_TPU_ALLGATHER_TIMEOUT", raw,
                    "a positive timeout")
         return None
+    return sec
+
+
+# -- ask/tell service knobs (ISSUE 9) ---------------------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# service it would have tuned.
+
+
+def parse_service(env=None):
+    """``HYPEROPT_TPU_SERVICE=<port>`` (or ``<host>:<port>``) → the bind
+    value for the ask/tell serving front end (``python -m
+    hyperopt_tpu.service.server`` reads it when ``--port`` is absent), or
+    None when unset/disabled/invalid.  Same grammar as
+    :func:`parse_obs_http` — ``0``/``off`` in the environment means
+    disabled; only the CLI's explicit ``--port 0`` asks for an ephemeral
+    port it then announces."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    host, _, port_s = raw.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_SERVICE", raw,
+                   "an integer port (or host:port)")
+        return None
+    if not 1 <= port <= 65535:
+        _warn_once("HYPEROPT_TPU_SERVICE", raw, "a port in [1, 65535]")
+        return None
+    return raw if host else port
+
+
+def _parse_pos_int(var, default, env=None):
+    env = os.environ if env is None else env
+    raw = env.get(var, "").strip()
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        _warn_once(var, raw, "an integer")
+        return default
+    if v < 1:
+        _warn_once(var, raw, "a positive integer")
+        return default
+    return v
+
+
+def parse_service_max_studies(env=None):
+    """``HYPEROPT_TPU_SERVICE_MAX_STUDIES`` → admission quota: how many
+    live studies the scheduler accepts before ``POST /study`` answers 429
+    (default 4096)."""
+    return _parse_pos_int("HYPEROPT_TPU_SERVICE_MAX_STUDIES", 4096, env)
+
+
+def parse_service_max_pending(env=None):
+    """``HYPEROPT_TPU_SERVICE_MAX_PENDING`` → per-study quota on asked-
+    but-untold trials; an ask past it answers 429 instead of letting one
+    client starve the cohort (default 64)."""
+    return _parse_pos_int("HYPEROPT_TPU_SERVICE_MAX_PENDING", 64, env)
+
+
+def parse_service_idle_sec(env=None):
+    """``HYPEROPT_TPU_SERVICE_IDLE_SEC`` → seconds of inactivity after
+    which a study's cohort slot is evicted (the study itself survives and
+    re-admits on its next ask; default 600).  Accepts fractions, like the
+    ``--idle-sec`` CLI flag; ``0``/``off`` disables idle eviction."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_IDLE_SEC", "").strip()
+    if not raw:
+        return 600.0
+    if raw.lower() in ("0", "off", "false", "no"):
+        return float("inf")  # never evict on idleness
+    try:
+        sec = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_SERVICE_IDLE_SEC", raw,
+                   "a duration in seconds (or 0/off)")
+        return 600.0
+    if sec < 0:
+        _warn_once("HYPEROPT_TPU_SERVICE_IDLE_SEC", raw,
+                   "a non-negative duration")
+        return 600.0
     return sec
 
 

@@ -50,6 +50,8 @@ __all__ = [
     "suggest",
     "suggest_async",
     "suggest_sharded",
+    "build_suggest_batched",
+    "cohort_cache_stats",
     "adaptive_parzen_normal",
     "linear_forgetting_weights",
     "normal_cdf",
@@ -1301,6 +1303,90 @@ def _seed_words(seed):
     derivation matching ``rand.seed_to_key``'s full-width semantics."""
     seed = int(seed)
     return np.asarray([seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF], np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# multi-study batched suggest (ISSUE 9): the fused tell+ask program vmapped
+# over a STUDY axis, so thousands of small concurrent studies share one
+# device dispatch instead of owning the mesh one at a time
+# ---------------------------------------------------------------------------
+
+# (space signature, cfg, cohort shape, layout) -> compiled cohort program.
+# A separate LRU from _suggest_jit_cache: cohort programs are specialized on
+# the (n_studies, cap, ids width) slot shape, and the scheduler reports this
+# cache's hit/miss rates as the ``suggest.cohort_cache`` metrics — study
+# churn that re-traces per ask wave shows up here, not as silent recompiles.
+_cohort_jit_cache = LRUCache(16)
+
+
+def cohort_cache_stats():
+    """Hit/miss/size counters of the cohort-program LRU (the scheduler
+    publishes these as ``suggest.cohort_cache.*`` gauges after each tick)."""
+    return _cohort_jit_cache.stats()
+
+
+def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
+                          mesh=None):
+    """Compile the STUDY-BATCHED fused tell+ask program:
+
+        run(hist_stack, rows_stack, seed_words[S, 2], ids[S, B])
+            -> (hist_stack', packed[S, B, L])
+
+    where every padded-history leaf carries a leading study axis
+    (``losses[S, cap]``, ``vals[l][S, cap]``, ...) and ``rows_stack`` is
+    ``[S, K, 2L+3]`` — per-study pending tell rows in the
+    ``PaddedHistory._pack_row`` layout.  The body is EXACTLY the
+    single-study program of :func:`_get_suggest_jit` ``vmap``-ped over the
+    study axis: same row fold, same in-trace key derivation
+    (``fold_in(PRNGKey(seed_words[0]), seed_words[1])`` then per-id
+    ``fold_in``), same grouped proposal pipeline — so each study's
+    proposals are bit-identical to the ones an independent sequential
+    ``fmin`` would produce at the same per-study seed (tier-1 pinned).
+
+    Every study in a cohort must share the space (``cs``), the capacity
+    bucket ``cap`` and the id width ``B`` — that is the scheduler's cohort
+    contract (``service/scheduler.py`` packs studies into fixed-shape
+    slots precisely so these are static).  ``donate=True`` donates the
+    stacked history, so the per-tick fold is an in-place scatter over the
+    whole cohort (no S×cap copy per wave).  ``mesh`` shards the study
+    axis over local devices via the partition-rule table
+    (``sharding.suggest_partition_rules(study_axis=True)``) with donation
+    preserved — ``n_studies`` must then divide the mesh's device count
+    total.
+    """
+    key = (cs.signature(), tuple(sorted(cfg.items())), "cohort",
+           int(n_studies), int(cap), int(n_ids), bool(donate))
+    if _pallas_armed():
+        key = key + ("pallas",)
+    if mesh is not None:
+        key = key + ("mesh", tuple(mesh.shape.items()),
+                     tuple(d.id for d in mesh.devices.flat))
+    fn = _cohort_jit_cache.get(key)
+    if fn is None:
+        propose = build_propose(cs, cfg)
+        labels = cs.labels
+
+        def one(history, rows, seed_words, ids):
+            hist = _apply_rows(labels, history, rows)
+            k = jax.random.fold_in(
+                jax.random.PRNGKey(seed_words[0]), seed_words[1]
+            )
+            keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+            out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
+            return hist, rand.pack_labels(cs, out)
+
+        run = jax.vmap(one)
+        donate_kw = {"donate_argnums": (0,)} if donate else {}
+        if mesh is None:
+            fn = jax.jit(run, **donate_kw)
+        else:
+            from ..parallel import sharding as _sh
+
+            in_sh, out_sh = _sh.suggest_batched_shardings(mesh, labels)
+            fn = jax.jit(run, in_shardings=in_sh, out_shardings=out_sh,
+                         **donate_kw)
+        _cohort_jit_cache.put(key, fn)
+    return fn
 
 
 # ---------------------------------------------------------------------------

@@ -314,6 +314,39 @@ class PaddedHistory:
     # first-call shape forced a second full XLA compile).
     _ROW_BUCKETS = (16,)
 
+    def pack_rows(self, start, K, noop_index=None):
+        """``[K, 2L+3]`` float32 tell-row matrix for trials ``start..n``
+        in the ``_pack_row`` layout, padded to ``K`` rows with out-of-
+        bounds no-op indices (``mode='drop'`` discards them in-trace).
+        The row form every fused tell+ask program folds — both the
+        single-study one (:meth:`device_state`) and the multi-study
+        cohort stack (``service/scheduler.py``).
+
+        ``noop_index`` is the drop index for padding rows — ``cap`` by
+        default; a cohort whose slot capacity differs from this
+        history's bucket passes its OWN capacity (an index that is
+        in-bounds for the consuming kernel would scatter a garbage row).
+        """
+        L = len(self.labels)
+        rows = np.zeros((K, 2 * L + 3), np.float32)
+        rows[:, 2 * L + 2] = float(self.cap if noop_index is None
+                                   else noop_index)
+        for j, i in enumerate(range(start, self.n)):
+            rows[j] = self._pack_row(i)
+        return rows
+
+    def host_padded(self):
+        """Full-capacity VIEWS of the host arrays (``vals``/``active``/
+        ``losses``/``has_loss``), padding included — what the multi-study
+        cohort stacks into its ``[S, cap]`` device mirror.  Read-only by
+        contract: the arrays are the authoritative host state."""
+        return {
+            "vals": self._vals,
+            "active": self._active,
+            "losses": self._losses,
+            "has_loss": self._has_loss,
+        }
+
     def _full_upload(self):
         # tag the cap-sized mirror buffers for the devmem live-array census
         # (obs/devmem.py) — uploads are rare (first view / growth), so the
@@ -322,12 +355,18 @@ class PaddedHistory:
 
         register_owner("history", (self.cap,))
         dt = jnp.dtype(self.hist_dtype)
+        # jnp.array (copy=True), NOT asarray: the mirror is DONATED into
+        # the fused tell+ask program, and on the CPU backend asarray can
+        # zero-copy a (page-aligned, e.g. large-cap) numpy buffer —
+        # donating an aliased buffer lets XLA free memory the
+        # authoritative host arrays still own (heap corruption; the
+        # cohort stack reproduced it, see service/scheduler.py)
         self._dev = {
-            "vals": {l: jnp.asarray(self._vals[l]).astype(dt)
+            "vals": {l: jnp.array(self._vals[l], dtype=dt)
                      for l in self.labels},
-            "active": {l: jnp.asarray(self._active[l]) for l in self.labels},
-            "losses": jnp.asarray(self._losses).astype(dt),
-            "has_loss": jnp.asarray(self._has_loss),
+            "active": {l: jnp.array(self._active[l]) for l in self.labels},
+            "losses": jnp.array(self._losses, dtype=dt),
+            "has_loss": jnp.array(self._has_loss),
         }
         self._dev_synced = self.n
 
@@ -371,11 +410,7 @@ class PaddedHistory:
             self._full_upload()
             delta = 0
         K = next(b for b in self._ROW_BUCKETS if b >= max(delta, 1))
-        L = len(self.labels)
-        rows = np.zeros((K, 2 * L + 3), np.float32)
-        rows[:, 2 * L + 2] = float(self.cap)  # default: dropped no-op
-        for j, i in enumerate(range(self._dev_synced, self.n)):
-            rows[j] = self._pack_row(i)
+        rows = self.pack_rows(self._dev_synced, K)
         self._pending_commit_n = self.n
         self._pending_commit_cap = self.cap
         self._donated = bool(donate)

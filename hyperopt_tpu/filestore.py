@@ -67,7 +67,7 @@ from .obs.events import (
     load_events,
 )
 
-__all__ = ["FileStore", "FileTrials", "ReserveTimeout"]
+__all__ = ["FileStore", "FileTrials", "ReserveTimeout", "new_run_id"]
 
 logger = logging.getLogger(__name__)
 
@@ -144,6 +144,18 @@ def _claim_suffix():
     an existing destination — one thread's live claim file would vanish
     under the other."""
     return f"{os.getpid()}.{threading.get_ident()}"
+
+
+def new_run_id(prefix="run"):
+    """Auth-agnostic opaque run/study id: ``<prefix>-<12 hex>`` from
+    ``os.urandom``.  Collision-safe across processes with no coordination
+    (the ask/tell service mints study ids with this — the id doubles as
+    the store subdirectory name when studies persist through a
+    :class:`FileStore`), and unguessable enough that knowing one study's
+    id never reveals a neighbor's."""
+    import binascii
+
+    return f"{prefix}-{binascii.hexlify(os.urandom(6)).decode()}"
 
 
 # the durable trial-lifecycle event log rides the attachments namespace so
@@ -263,6 +275,18 @@ class FileStore:
         _atomic_write(self._path(doc["state"], doc["tid"]), pickle.dumps(doc))
         if fresh:
             self.events.emit(TRIAL_NEW, doc["tid"])
+
+    def settle(self, doc):
+        """Write a TERMINAL doc and drop its superseded ``new``/``running``
+        copies.  The ask/tell service's tell path: a served trial goes
+        NEW → DONE without ever being worker-claimed, so the
+        reserve/finish lifecycle (and its claim files) never applies —
+        but leaving the stale ``new/`` copy behind would make every
+        ``load_all`` lean on state precedence forever."""
+        self.write_doc(doc)
+        for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+            if state != doc["state"]:
+                _remove_quiet(self._path(state, doc["tid"]))
 
     def _read(self, path):
         try:

@@ -102,6 +102,20 @@ KEY_DIRECTIONS = {
     # poll jitter; the loose bar catches a broken reclaim path (latency
     # jumping to the barrier timeout), not scheduler noise.
     "recovery_latency_sec": {"direction": "lower", "threshold": 1.00},
+    # multi-study serving throughput (bench.py multi_study stage): asks
+    # served per wall second at 1k concurrent studies over batched cohort
+    # ticks.  The loose-ish bar absorbs shared-hardware noise; a real
+    # regression here means the study axis stopped batching.
+    "studies_per_sec": {"direction": "higher", "threshold": 0.25},
+    # per-ask completion latency of a 1k-study wave (every ask completes
+    # with its wave) — deliberately NOT named ask_p99_ms: that key is the
+    # single-study interactive loop's, ~1000x smaller, and sharing the
+    # name would corrupt the tail-mined series
+    "study_ask_p99_ms": {"direction": "lower", "threshold": 1.00},
+    # occupied / total cohort slots after the measured waves: near-
+    # deterministic for a fixed mix (pow2 slot padding is the only
+    # slack), so a drop means the packer started stranding slots
+    "slot_utilization_frac": {"direction": "higher", "threshold": 0.15},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -111,7 +125,9 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "sharded_cand_per_sec",
                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                 "peak_hbm_bytes", "history_bytes",
-                "profiler_overhead_frac", "recovery_latency_sec")
+                "profiler_overhead_frac", "recovery_latency_sec",
+                "studies_per_sec", "study_ask_p99_ms",
+                "slot_utilization_frac")
 
 
 def trajectory_path(root=None):

@@ -56,6 +56,7 @@ __all__ = [
     "match_partition_rules",
     "suggest_partition_rules",
     "suggest_shardings",
+    "suggest_batched_shardings",
     "hist_shard_threshold",
     "should_shard_history",
 ]
@@ -107,7 +108,7 @@ def match_partition_rules(rules, tree):
     return jax.tree_util.tree_map_with_path(spec_for, tree)
 
 
-def suggest_partition_rules(shard_history=False, axes=None):
+def suggest_partition_rules(shard_history=False, axes=None, study_axis=False):
     """The rule table for the fused tell+ask program (and the generation
     fold): leaf path regex → PartitionSpec.
 
@@ -119,9 +120,22 @@ def suggest_partition_rules(shard_history=False, axes=None):
       shards its capacity axis above it;
     * scalar-ish side inputs (``rows``, ``seed_words``, fold row buffers)
       replicate — they are O(batch), not O(cap).
+
+    ``study_axis=True`` is the MULTI-STUDY cohort layout (ISSUE 9,
+    ``tpe.build_suggest_batched``): every leaf — history stack, tell rows,
+    seed words, ids, packed proposals — carries a LEADING study axis, and
+    that axis shards over the mesh.  Per-study math is device-local under
+    study sharding (each device owns whole studies), so cohort proposals
+    stay bit-identical to the replicated layout at the same seeds.
     """
     axes = (CAND_AXIS,) if axes is None else tuple(axes)
     batch = P(axes)
+    if study_axis:
+        # the study axis leads EVERY cohort-program leaf; shard them all
+        return (
+            (r"^hist/", batch),
+            (r"^(rows|seed_words|ids|packed|stats|splits)$", batch),
+        )
     hist = P(axes) if shard_history else P()
     return (
         (r"^hist/(vals|active)/", hist),
@@ -163,6 +177,25 @@ def suggest_shardings(mesh, labels, shard_history=False, diag=False):
     if diag:
         outs += [ns(out_specs["stats"]), ns(out_specs["splits"])]
     return in_sh, tuple(outs)
+
+
+def suggest_batched_shardings(mesh, labels):
+    """``(in_shardings, out_shardings)`` for the multi-study cohort
+    program ``run(hist_stack, rows, seed_words, ids) -> (hist_stack',
+    packed)`` (``tpe.build_suggest_batched``): the leading study axis of
+    every leaf shards over ``mesh`` per
+    :func:`suggest_partition_rules(study_axis=True)`."""
+    rules = suggest_partition_rules(study_axis=True, axes=mesh.axis_names)
+    hist = _hist_skeleton(labels)
+    in_tree = {"hist": hist, "rows": 0, "seed_words": 0, "ids": 0}
+    out_tree = {"hist": hist, "packed": 0}
+    in_specs = match_partition_rules(rules, in_tree)
+    out_specs = match_partition_rules(rules, out_tree)
+    ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    in_sh = (jax.tree.map(ns, in_specs["hist"]), ns(in_specs["rows"]),
+             ns(in_specs["seed_words"]), ns(in_specs["ids"]))
+    out_sh = (jax.tree.map(ns, out_specs["hist"]), ns(out_specs["packed"]))
+    return in_sh, out_sh
 
 
 def shard_map_suggest_fallback(run, mesh, diag=False):

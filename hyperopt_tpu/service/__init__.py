@@ -1,0 +1,17 @@
+"""Ask/tell optimizer service: thousands of concurrent studies on one mesh.
+
+The serving layer over ``tpe.build_suggest_batched`` (ISSUE 9 /
+ROADMAP item 1): a :class:`~hyperopt_tpu.service.scheduler.StudyScheduler`
+packs live studies into fixed-shape cohort slots and runs ONE batched
+fused tell+ask device program per ask wave, and
+``hyperopt_tpu.service.server`` puts a stdlib HTTP front end
+(``POST /study``, ``POST /ask``, ``POST /tell``, ``GET /studies``) on top
+— the surface every later workload (ATPE, multi-objective, ASHA) plugs
+into.
+"""
+
+from .scheduler import StudyScheduler, StudyQuotaError, UnknownStudyError
+from .spacespec import space_from_spec
+
+__all__ = ["StudyScheduler", "StudyQuotaError", "UnknownStudyError",
+           "space_from_spec"]

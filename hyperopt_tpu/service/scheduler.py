@@ -1,0 +1,813 @@
+"""Admitting scheduler: pack live studies into cohort slots, tick once per
+ask wave.
+
+One ``fmin`` owning the whole mesh wastes almost all of the kernel's
+throughput on small studies; a production service runs thousands of them
+at once.  This module is the host side of the multi-study batch
+(ISSUE 9): studies sharing a search space land in a **cohort** — a
+fixed-shape stack of device history slots — and every ask wave runs ONE
+study-batched fused tell+ask program (``tpe.build_suggest_batched``) for
+the whole cohort instead of one device dispatch per study.
+
+Determinism contract (tier-1 pinned): a cohort of N studies proposes
+bit-identically to N independent sequential ``fmin`` runs at the same
+per-study seeds.  Everything the scheduler does preserves that:
+
+* the per-study ask flow mirrors ``FMinIter._run`` exactly — draw
+  ``new_ids`` from the study's Trials, one seed per ask from the study's
+  ``rstate`` (``integers(2**31 - 1)``), random search below
+  ``n_startup_jobs``, the TPE cfg dict built like ``tpe.suggest``'s;
+* per-id PRNG keys derive from the id VALUE and the study seed, never
+  from slot position or wave composition, so cohort packing, slot
+  padding and eviction/re-admission are all proposal-invariant;
+* the cohort's device stack mirrors the per-study host
+  ``PaddedHistory`` arrays (the authoritative state) — an evicted study
+  re-admits by re-uploading them, bit-for-bit.
+
+Cohort shapes are static by construction: slot counts grow in powers of
+two, every study in a cohort shares the space signature, TPE cfg and
+capacity bucket, and ask widths pad to a power of two — so the compiled
+program LRU (``tpe._cohort_jit_cache``, surfaced as the
+``suggest.cohort_cache`` metrics) sees a handful of shapes, not one per
+wave.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..algos import rand, tpe
+from ..base import (
+    JOB_STATE_DONE,
+    STATUS_FAIL,
+    STATUS_OK,
+    Domain,
+    Trials,
+    coarse_utcnow,
+    spec_from_misc,
+)
+from ..obs.metrics import get_metrics
+
+__all__ = ["StudyScheduler", "Study", "StudyQuotaError",
+           "UnknownStudyError", "DuplicateTellError"]
+
+
+class UnknownStudyError(KeyError):
+    """No live study with that id (never created, or closed)."""
+
+
+class StudyQuotaError(RuntimeError):
+    """An admission or per-study quota would be exceeded (HTTP 429)."""
+
+
+class DuplicateTellError(RuntimeError):
+    """The trial was already told (HTTP 409 — a PERMANENT conflict, not a
+    retryable quota: a client retrying a lost tell response must not
+    back off forever on a 429)."""
+
+
+def _pow2(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class Study:
+    """One study's serving state: compiled space, trials, RNG stream and
+    quotas.  The ask/tell flow over these fields reproduces ``FMinIter``'s
+    loop, which is what makes the cohort determinism pin possible."""
+
+    def __init__(self, study_id, space, seed=0, n_startup_jobs=None,
+                 max_trials=None, trials=None, **tpe_kwargs):
+        self.study_id = study_id
+        self.domain = Domain(None, space)
+        self.trials = trials if trials is not None else Trials()
+        self.rstate = np.random.default_rng(seed)
+        self.seed = int(seed)
+        self.n_startup_jobs = int(n_startup_jobs
+                                  if n_startup_jobs is not None
+                                  else tpe._default_n_startup_jobs)
+        self.max_trials = None if max_trials is None else int(max_trials)
+        # mirror tpe.suggest_async's cfg construction field for field so
+        # the cohort kernel and the single-study kernel share cache keys
+        # downstream of the same space
+        self.cfg = {
+            "prior_weight": float(tpe_kwargs.pop(
+                "prior_weight", tpe._default_prior_weight)),
+            "n_EI_candidates": int(tpe_kwargs.pop(
+                "n_EI_candidates", tpe._default_n_EI_candidates)),
+            "gamma": float(tpe_kwargs.pop("gamma", tpe._default_gamma)),
+            "LF": int(tpe_kwargs.pop("linear_forgetting",
+                                     tpe._default_linear_forgetting)),
+            "ei_select": str(tpe_kwargs.pop("ei_select", "argmax")),
+            "ei_tau": float(tpe_kwargs.pop("ei_tau", 1.0)),
+            "prior_eps": float(tpe_kwargs.pop("prior_eps", 0.0)),
+        }
+        if tpe_kwargs:
+            raise TypeError(f"unknown study kwargs: {sorted(tpe_kwargs)}")
+        self.cfg_key = tuple(sorted(self.cfg.items()))
+        self.state = "active"
+        self.created = time.time()
+        self.last_active = self.created
+        self.n_asked = 0
+        self.n_told = 0
+
+    def next_seed(self):
+        """One suggest seed per ask — exactly ``FMinIter``'s
+        ``next_seed`` draw, so the study's proposal stream matches the
+        sequential ``fmin`` it is pinned against."""
+        return int(self.rstate.integers(2**31 - 1))
+
+    def touch(self):
+        self.last_active = time.time()
+
+    @property
+    def n_trials(self):
+        return len(self.trials._dynamic_trials)
+
+    @property
+    def n_pending(self):
+        return self.n_asked - self.n_told
+
+    def best_loss(self):
+        best = None
+        for r in self.trials.results:
+            loss = r.get("loss")
+            if (r.get("status") == STATUS_OK and loss is not None
+                    and (best is None or loss < best)):
+                best = loss
+        return best
+
+    def status_dict(self):
+        return {
+            "study_id": self.study_id,
+            "state": self.state,
+            "labels": list(self.domain.cs.labels),
+            "n_trials": self.n_trials,
+            "n_pending": self.n_pending,
+            "n_asked": self.n_asked,
+            "n_told": self.n_told,
+            "best_loss": self.best_loss(),
+            "max_trials": self.max_trials,
+            "created": self.created,
+            "last_active": self.last_active,
+            "seed": self.seed,
+        }
+
+
+class _AskReq:
+    """One TPE ask waiting for a cohort tick."""
+
+    __slots__ = ("study", "new_ids", "seed", "docs", "error")
+
+    def __init__(self, study, new_ids, seed):
+        self.study = study
+        self.new_ids = new_ids
+        self.seed = seed
+        self.docs = None
+        self.error = None
+
+
+#: smallest cohort slot capacity.  Serving-scale studies are SMALL (tens
+#: of trials), and the kernel's cost is dominated by cap-sized sorts and
+#: mixture densities — a 128-cap slot for a 12-trial study wastes ~90% of
+#: the tick.  Proposals are bitwise capacity-invariant (padding is fully
+#: masked — pinned by test), so the cohort can run a much tighter bucket
+#: than PaddedHistory's host _MIN_CAP without perturbing determinism.
+#: Correctness never depends on slack: a study whose live count outgrows
+#: its bucket migrates to the next cohort at its next ask (and the tick's
+#: outgrow guard evicts it meanwhile), re-uploading from the
+#: authoritative host arrays bit-for-bit.
+_COHORT_CAP_FLOOR = 16
+
+
+def _cohort_cap(n):
+    """Power-of-two slot capacity for a study with ``n`` live trials
+    (+1 so one settled trial between waves never forces a migration)."""
+    cap = _COHORT_CAP_FLOOR
+    while cap < n + 1:
+        cap *= 2
+    return cap
+
+
+class _Cohort:
+    """Fixed-shape device slots for studies sharing (space signature, TPE
+    cfg, capacity bucket).  Owns the stacked ``[S, cap]`` device history
+    mirror; per-study host arrays stay authoritative — admission uploads
+    them once, ticks move only the small pending tell rows.  The cohort
+    capacity is the GRADED bucket of :func:`_cohort_cap` — a slot holds
+    the live prefix of the study's (possibly larger) host arrays, and a
+    study that outgrows the bucket migrates to the next cohort."""
+
+    _ROW_BUCKET = 16  # one fixed row bucket, like PaddedHistory's
+
+    def __init__(self, cs, cfg, cap, hist_dtype="float32"):
+        self.cs = cs
+        self.cfg = dict(cfg)
+        self.cap = int(cap)
+        self.hist_dtype = str(hist_dtype)
+        self.slots = [None]  # Study | None; length is a power of two
+        self.slot_of = {}    # study_id -> slot index
+        self._dev = None     # stacked history pytree, or None (rebuild)
+        self._synced = {}    # slot -> host rows already folded on device
+        self.ticks = 0
+
+    @property
+    def n_slots(self):
+        return len(self.slots)
+
+    @property
+    def n_live(self):
+        return len(self.slot_of)
+
+    def admit(self, study):
+        """Place ``study`` in a free slot, doubling the slot count when
+        full (power-of-two shapes bound the compiled-program set).  The
+        stacked mirror rebuilds on the next tick — admissions are rare
+        next to ticks (startup graduation, re-admission after eviction)."""
+        if study.study_id in self.slot_of:
+            return self.slot_of[study.study_id]
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            self.slots.extend([None] * len(self.slots))
+            slot = self.slots.index(None)
+        self.slots[slot] = study
+        self.slot_of[study.study_id] = slot
+        self._dev = None
+        return slot
+
+    def evict(self, study_id):
+        """Free the study's slot.  The stale stack stays valid — an empty
+        slot's rows are no-ops and its outputs are discarded — so
+        eviction costs nothing until the slot is re-filled."""
+        slot = self.slot_of.pop(study_id, None)
+        if slot is not None:
+            self.slots[slot] = None
+            self._synced.pop(slot, None)
+        return slot
+
+    def _history(self, study):
+        return study.trials.history_object(self.cs.labels)
+
+    def _upload_stack(self, mesh=None):
+        """Full build of the stacked device mirror from every slotted
+        study's host arrays (admission / growth / recovery path)."""
+        L = self.cs.labels
+        S, cap = self.n_slots, self.cap
+        vals = {l: np.zeros((S, cap), np.float32) for l in L}
+        active = {l: np.zeros((S, cap), bool) for l in L}
+        losses = np.full((S, cap), np.inf, np.float32)
+        has_loss = np.zeros((S, cap), bool)
+        for slot, st in enumerate(self.slots):
+            if st is None:
+                continue
+            ph = self._history(st)
+            host = ph.host_padded()
+            c = min(cap, ph.cap)  # live prefix; the rest stays padding
+            for l in L:
+                vals[l][slot, :c] = host["vals"][l][:c]
+                active[l][slot, :c] = host["active"][l][:c]
+            losses[slot, :c] = host["losses"][:c]
+            has_loss[slot, :c] = host["has_loss"][:c]
+            self._synced[slot] = ph.n
+        dt = jnp.dtype(self.hist_dtype)
+
+        def put(x, floating):
+            # jnp.array (copy=True), NOT jnp.asarray: the stack is DONATED
+            # into every tick, and on the CPU backend asarray can zero-copy
+            # the numpy buffer — donating an aliased buffer lets XLA free
+            # memory numpy still owns (glibc "corrupted double-linked
+            # list" at the next teardown; reproduced before this guard)
+            arr = jnp.array(x, dtype=dt if floating else None)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                arr = jax.device_put(
+                    arr, NamedSharding(mesh, P(mesh.axis_names)))
+            return arr
+
+        self._dev = {
+            "vals": {l: put(vals[l], True) for l in L},
+            "active": {l: put(active[l], False) for l in L},
+            "losses": put(losses, True),
+            "has_loss": put(has_loss, False),
+        }
+
+    def tick(self, demand, donate=True, mesh=None):
+        """One batched fused tell+ask DISPATCH for the whole cohort.
+
+        ``demand``: ``{slot: (ids_uint32, seed)}`` — at most one ask per
+        slot.  Every occupied slot's pending tell rows fold (asking or
+        not), so the mirror never lags the host state.  Returns the
+        in-flight ``packed [S, B, L]`` device array — the caller reads it
+        back AFTER dispatching every other cohort's tick, so one
+        cohort's host-side doc building overlaps the next cohort's
+        device compute (the wave-level analog of PR 4's
+        dispatch/readback overlap).
+        """
+        self.ticks += 1
+        L = len(self.cs.labels)
+        B = _pow2(max((len(ids) for ids, _ in demand.values()), default=1))
+
+        # a slot whose study outgrew this capacity bucket is evicted (its
+        # next ask re-admits it to the right cohort; the host arrays are
+        # authoritative, so nothing is lost) — folding its rows here
+        # would scatter past the slot.  A slot that told more than K
+        # trials since its last tick forces a full re-upload (rare:
+        # serving waves tell a handful per study).
+        phs = {}
+        for slot, st in enumerate(self.slots):
+            if st is None:
+                continue
+            ph = self._history(st)
+            if ph.n > self.cap:
+                self.evict(st.study_id)
+                continue
+            phs[slot] = ph
+        # adaptive row bucket: the scatter's cost scales with K, and a
+        # serving wave folds one or two rows per slot — pow2-bucketed so
+        # the program set stays {K=1,2,4,8,16}; more than _ROW_BUCKET
+        # pending rows forces a full re-upload instead
+        delta = max([ph.n - self._synced.get(slot, 0)
+                     for slot, ph in phs.items()] or [0])
+        if self._dev is not None and delta > self._ROW_BUCKET:
+            self._dev = None
+        if self._dev is None:
+            self._upload_stack(mesh=mesh)
+            delta = 0
+        K = _pow2(max(delta, 1))
+
+        S = self.n_slots
+        R = 2 * L + 3
+        rows = np.zeros((S, K, R), np.float32)
+        rows[:, :, R - 1] = float(self.cap)  # default: dropped no-op
+        seed_words = np.zeros((S, 2), np.uint32)
+        ids = np.zeros((S, B), np.uint32)
+        pending_sync = {}
+        for slot, ph in phs.items():
+            rows[slot] = ph.pack_rows(self._synced.get(slot, 0), K,
+                                      noop_index=self.cap)
+            pending_sync[slot] = ph.n
+        for slot, (slot_ids, seed) in demand.items():
+            seed_words[slot] = tpe._seed_words(seed)
+            ids[slot, : len(slot_ids)] = slot_ids
+            if len(slot_ids) < B:  # pad by repeating the last id
+                ids[slot, len(slot_ids):] = slot_ids[-1]
+
+        run = tpe.build_suggest_batched(
+            self.cs, self.cfg, S, self.cap, B, donate=donate, mesh=mesh)
+        try:
+            new_dev, packed = run(self._dev, rows, seed_words, ids)
+        except BaseException:
+            # with donation armed the input stack may already be invalid:
+            # drop it and rebuild from the authoritative host arrays
+            self._dev = None
+            self._synced = {}
+            raise
+        self._dev = new_dev
+        self._synced.update(pending_sync)
+        return packed
+
+    def abandon_device(self):
+        """Drop the (possibly donated-and-poisoned) device stack after a
+        failed dispatch or readback; the next tick rebuilds it from the
+        authoritative host arrays."""
+        self._dev = None
+        self._synced = {}
+
+
+class StudyScheduler:
+    """Create/ask/tell over many studies, batched onto cohort ticks.
+
+    Thread-safe.  Concurrent ``ask`` callers coalesce through the
+    ``wave_window`` gather pause: the first thread to become the wave
+    ticker releases the lock for that window, every asker that arrives
+    meanwhile enqueues into the SAME wave, and one batched device tick
+    per cohort serves them all.  With ``wave_window=0`` (the default for
+    direct in-process use) asks serialize — single-threaded drivers
+    should express waves explicitly with :meth:`ask_many`; the HTTP
+    server always runs with a small window.
+
+    ``store_root`` persists every study through the existing
+    ``FileStore`` (one subdirectory per study id); default is in-memory
+    :class:`~hyperopt_tpu.base.Trials`.
+    """
+
+    def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
+                 store_root=None, wave_window=0.0):
+        from .._env import (parse_service_idle_sec,
+                            parse_service_max_pending,
+                            parse_service_max_studies)
+
+        self.max_studies = (parse_service_max_studies()
+                            if max_studies is None else int(max_studies))
+        self.max_pending = (parse_service_max_pending()
+                            if max_pending is None else int(max_pending))
+        self.idle_sec = (parse_service_idle_sec()
+                         if idle_sec is None else float(idle_sec))
+        if self.idle_sec <= 0:
+            # 0 means "never evict on idleness" EVERYWHERE (env grammar,
+            # CLI, constructor) — a literal 0 would instead evict every
+            # slot at every wave and re-upload every cohort stack
+            self.idle_sec = float("inf")
+        self.store_root = store_root
+        self.wave_window = float(wave_window)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._studies = {}
+        self._cohorts = {}  # (sig, cfg_key, cap) -> _Cohort
+        self._wave_reqs = []
+        self._tick_running = False
+        self.metrics = get_metrics("service")
+
+    # -- study lifecycle ---------------------------------------------------
+
+    def create_study(self, space, seed=0, study_id=None, **kwargs):
+        """Admit a new study; returns its id (``filestore.new_run_id``).
+        Raises :class:`StudyQuotaError` past the ``max_studies`` quota."""
+        from ..filestore import FileTrials, new_run_id
+
+        with self._lock:
+            live = sum(1 for s in self._studies.values()
+                       if s.state == "active")
+            if live >= self.max_studies:
+                raise StudyQuotaError(
+                    f"study quota reached ({self.max_studies} live studies)")
+            study_id = study_id or new_run_id("study")
+            if study_id in self._studies:
+                raise StudyQuotaError(f"study id {study_id!r} already exists")
+            trials = None
+            if self.store_root is not None:
+                import os
+
+                trials = FileTrials(os.path.join(self.store_root, study_id))
+            st = Study(study_id, space, seed=seed, trials=trials, **kwargs)
+            self._studies[study_id] = st
+            self.metrics.counter("service.studies_created").inc()
+            self.metrics.gauge("service.studies_live").set(live + 1)
+            return study_id
+
+    def close_study(self, study_id):
+        """Mark a study done and free its cohort slot (its trials stay
+        queryable; the admission quota counts only active studies)."""
+        with self._lock:
+            st = self._get(study_id)
+            st.state = "closed"
+            self._evict_from_cohort(st)
+            self._gc_cohorts()
+            self.metrics.gauge("service.studies_live").set(
+                sum(1 for s in self._studies.values()
+                    if s.state == "active"))
+
+    def _get(self, study_id):
+        st = self._studies.get(study_id)
+        if st is None:
+            raise UnknownStudyError(study_id)
+        return st
+
+    # -- cohort packing ----------------------------------------------------
+
+    def _cohort_for(self, st):
+        """The cohort matching the study's (space, cfg, capacity) — moving
+        the study between cohorts when its capacity bucket grew."""
+        ph = st.trials.history_object(st.domain.cs.labels)
+        cap = _cohort_cap(ph.n)
+        key = (st.domain.cs.signature(), st.cfg_key, cap)
+        cohort = self._cohorts.get(key)
+        if cohort is None:
+            from .._env import parse_hist_dtype
+
+            cohort = self._cohorts[key] = _Cohort(
+                st.domain.cs, st.cfg, cap, hist_dtype=parse_hist_dtype())
+        if st.study_id not in cohort.slot_of:
+            # evict from any smaller-capacity cohort it may still occupy
+            self._evict_from_cohort(st)
+            cohort.admit(st)
+        return cohort
+
+    def _evict_from_cohort(self, st):
+        for cohort in self._cohorts.values():
+            if cohort.evict(st.study_id) is not None:
+                self.metrics.counter("service.evictions").inc()
+
+    def evict_idle(self, now=None):
+        """Free cohort slots of studies idle past ``idle_sec`` (the study
+        itself survives — its next ask re-admits it bit-identically from
+        the host arrays)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            for st in self._studies.values():
+                if (st.state == "active"
+                        and now - st.last_active > self.idle_sec):
+                    self._evict_from_cohort(st)
+
+    def _gc_cohorts(self):
+        """Drop cohorts with no live slots.  Studies migrate between
+        capacity buckets as they grow, and an abandoned cohort would
+        otherwise pin its full stacked device mirror forever (and
+        permanently depress slot utilization)."""
+        with self._lock:
+            for key in [k for k, c in self._cohorts.items()
+                        if c.n_live == 0]:
+                del self._cohorts[key]
+
+    def slot_utilization(self):
+        """Occupied fraction of all cohort slots (1.0 = perfectly packed)."""
+        with self._lock:
+            total = sum(c.n_slots for c in self._cohorts.values())
+            live = sum(c.n_live for c in self._cohorts.values())
+            return (live / total) if total else 0.0
+
+    # -- ask / tell --------------------------------------------------------
+
+    def _prepare_ask(self, st, n):
+        """Draw ids + seed for one ask, exactly as ``FMinIter`` would.
+        Returns finished docs (startup random search, served inline) or an
+        :class:`_AskReq` awaiting a cohort tick."""
+        if st.state != "active":
+            raise UnknownStudyError(f"{st.study_id} is {st.state}")
+        n = int(n)
+        if n < 1:
+            raise ValueError("ask n must be >= 1")
+        if st.n_pending + n > self.max_pending:
+            raise StudyQuotaError(
+                f"{st.study_id}: {st.n_pending} pending + {n} asked would "
+                f"exceed the per-study quota ({self.max_pending})")
+        if (st.max_trials is not None
+                and st.n_trials + n > st.max_trials):
+            raise StudyQuotaError(
+                f"{st.study_id}: budget exhausted "
+                f"({st.n_trials}/{st.max_trials} trials)")
+        new_ids = st.trials.new_trial_ids(n)
+        st.trials.refresh()
+        seed = st.next_seed()
+        st.touch()
+        st.n_asked += n
+        self.metrics.counter("service.asks").inc()
+        if len(st.trials.trials) < st.n_startup_jobs:
+            docs = rand.suggest(new_ids, st.domain, st.trials, seed)
+            self._land(st, docs)
+            return docs
+        return _AskReq(st, new_ids, seed)
+
+    def _land(self, st, docs):
+        st.trials.insert_trial_docs(docs)
+        st.trials.refresh()
+
+    def _answers(self, st, docs):
+        return [{"study_id": st.study_id, "tid": d["tid"],
+                 "params": spec_from_misc(d["misc"])} for d in docs]
+
+    def _run_wave(self, reqs):
+        """Group pending asks by cohort and run one tick per cohort (a
+        study asked twice in one wave falls to a follow-up round so each
+        tick carries at most one ask per slot)."""
+        from .._env import parse_shard
+        from ..parallel import sharding as _sh
+
+        self.evict_idle()
+        while reqs:
+            this_round, leftover, seen = [], [], set()
+            for r in reqs:
+                (leftover if r.study.study_id in seen
+                 else this_round).append(r)
+                seen.add(r.study.study_id)
+            by_cohort = {}
+            for r in this_round:
+                try:
+                    cohort = self._cohort_for(r.study)
+                except Exception as e:  # noqa: BLE001 - per-req isolation
+                    r.error = e
+                    continue
+                by_cohort.setdefault(id(cohort), (cohort, []))[1].append(r)
+            n_shard = parse_shard()
+            # dispatch phase: every cohort's fused program goes onto the
+            # device queue before any readback, so the Python doc building
+            # below overlaps the remaining cohorts' device compute
+            dispatched = []
+            for cohort, cohort_reqs in by_cohort.values():
+                mesh = None
+                if n_shard is not None:
+                    m = _sh.suggest_mesh(n_shard)
+                    n_dev = int(m.devices.size)
+                    # the study axis must divide the mesh; small cohorts
+                    # stay single-device rather than padding slots
+                    if n_dev > 1 and cohort.n_slots % n_dev == 0:
+                        mesh = m
+                demand = {}
+                for r in cohort_reqs:
+                    slot = cohort.slot_of[r.study.study_id]
+                    demand[slot] = (np.asarray(
+                        [int(i) & 0xFFFFFFFF for i in r.new_ids],
+                        np.uint32), r.seed)
+                try:
+                    packed = cohort.tick(demand,
+                                         donate=tpe._donation_enabled(),
+                                         mesh=mesh)
+                except Exception as e:  # noqa: BLE001
+                    for r in cohort_reqs:
+                        r.error = e
+                    continue
+                dispatched.append((cohort, cohort_reqs, packed))
+            # readback phase: block per cohort, build and land the docs
+            for cohort, cohort_reqs, packed in dispatched:
+                try:
+                    mat = np.asarray(packed)
+                except Exception as e:  # noqa: BLE001 - runtime XLA error
+                    cohort.abandon_device()
+                    for r in cohort_reqs:
+                        r.error = e
+                    continue
+                for r in cohort_reqs:
+                    # per-req isolation: a landing failure (e.g. a full
+                    # disk under --store) must error THIS ask, not strand
+                    # the rest of the wave unresolved
+                    try:
+                        slot = cohort.slot_of[r.study.study_id]
+                        flats = rand.unpack_flats(
+                            cohort.cs, mat[slot], len(r.new_ids))
+                        docs = rand.flat_to_new_trial_docs(
+                            r.study.domain, r.study.trials, r.new_ids,
+                            flats)
+                        self._land(r.study, docs)
+                        r.docs = docs
+                    except Exception as e:  # noqa: BLE001
+                        r.error = e
+                self.metrics.counter("service.ticks").inc()
+                self.metrics.counter("service.tick_asks").inc(
+                    len(cohort_reqs))
+            reqs = leftover
+        self._gc_cohorts()
+        stats = tpe.cohort_cache_stats()
+        self.metrics.gauge("suggest.cohort_cache.hits").set(stats["hits"])
+        self.metrics.gauge("suggest.cohort_cache.misses").set(
+            stats["misses"])
+        self.metrics.gauge("service.slot_utilization").set(
+            self.slot_utilization())
+
+    def ask(self, study_id, n=1):
+        """Propose ``n`` new trials for one study.  Concurrent callers
+        coalesce: the first thread to reach a quiescent scheduler becomes
+        the wave ticker and serves every enqueued ask in one batched
+        device tick per cohort."""
+        t0 = time.perf_counter()
+        with self._cond:
+            st = self._get(study_id)
+            res = self._prepare_ask(st, n)
+            if not isinstance(res, _AskReq):  # startup random search
+                self.metrics.histogram("service.ask_sec").observe(
+                    time.perf_counter() - t0)
+                return self._answers(st, res)
+            req = res
+            self._wave_reqs.append(req)
+            while req.docs is None and req.error is None:
+                if self._tick_running:
+                    self._cond.wait(timeout=0.25)
+                    continue
+                self._tick_running = True
+                if self.wave_window > 0:
+                    # gather window: let concurrent askers enqueue into
+                    # this wave while the lock is released
+                    self._cond.wait(timeout=self.wave_window)
+                batch, self._wave_reqs = self._wave_reqs, []
+                try:
+                    self._run_wave(batch)
+                except Exception as e:  # noqa: BLE001
+                    # never strand a wave: an unresolved req would spin
+                    # its asker forever (the batch left _wave_reqs above)
+                    for r in batch:
+                        if r.docs is None and r.error is None:
+                            r.error = e
+                finally:
+                    self._tick_running = False
+                    self._cond.notify_all()
+        if req.error is not None:
+            with self._lock:  # release the reserved pending quota
+                req.study.n_asked -= len(req.new_ids)
+            raise req.error
+        self.metrics.histogram("service.ask_sec").observe(
+            time.perf_counter() - t0)
+        return self._answers(req.study, req.docs)
+
+    def ask_many(self, requests):
+        """Explicit wave: ``[(study_id, n), ...]`` asked in ONE batched
+        tick per cohort.  Returns ``{study_id: [answers]}`` — the
+        single-threaded driver's way to express an ask wave (bench, the
+        determinism tests).
+
+        Partial failure keeps the successes: a study whose cohort tick
+        (or doc landing) failed is simply ABSENT from the result (its
+        pending quota released, a warning logged) — raising would throw
+        away the other studies' already-landed trials, orphaning NEW
+        docs the caller could never tell.  Only an all-failed wave
+        raises."""
+        import logging
+
+        with self._lock:
+            out = {}
+            reqs = []
+            for study_id, n in requests:
+                st = self._get(study_id)
+                res = self._prepare_ask(st, n)
+                if isinstance(res, _AskReq):
+                    reqs.append(res)
+                else:
+                    out.setdefault(study_id, []).extend(
+                        self._answers(st, res))
+            self._run_wave(reqs)
+            failed = []
+            for r in reqs:
+                if r.error is not None:
+                    # release the failed req's pending quota, else
+                    # repeated failures wedge the study at 429
+                    r.study.n_asked -= len(r.new_ids)
+                    failed.append(r)
+                else:
+                    out.setdefault(r.study.study_id, []).extend(
+                        self._answers(r.study, r.docs))
+            if failed:
+                if not out:
+                    raise failed[0].error
+                logging.getLogger(__name__).warning(
+                    "ask_many: %d of %d studies failed this wave "
+                    "(first: %s: %s); returning the successes",
+                    len(failed), len(reqs), type(failed[0].error).__name__,
+                    failed[0].error)
+            return out
+
+    def tell(self, study_id, tid, loss=None, status=None):
+        """Report one trial's result.  ``status`` defaults to ok with a
+        finite loss, fail otherwise; the doc settles DONE and folds into
+        the study's posterior at its next ask (the tell half of the fused
+        tell+ask program)."""
+        with self._lock:
+            st = self._get(study_id)
+            tid = int(tid)
+            doc = next((d for d in st.trials._dynamic_trials
+                        if d["tid"] == tid), None)
+            if doc is None:
+                raise UnknownStudyError(
+                    f"{study_id}: no trial with tid {tid}")
+            if doc["state"] == JOB_STATE_DONE:
+                raise DuplicateTellError(
+                    f"{study_id}: trial {tid} was already told")
+            # a finite loss is REQUIRED for an ok record even when the
+            # caller says status="ok" — an inf/NaN loss folded into the
+            # posterior would poison every later EI split for the study
+            ok = (loss is not None and math.isfinite(float(loss))
+                  and (status is None or status == STATUS_OK))
+            doc["result"] = ({"loss": float(loss), "status": STATUS_OK}
+                             if ok else {"status": STATUS_FAIL})
+            doc["state"] = JOB_STATE_DONE
+            doc["refresh_time"] = coarse_utcnow()
+            store = getattr(st.trials, "store", None)
+            if store is not None:
+                store.settle(doc)
+            # base-class refresh on purpose: the doc was mutated in place
+            # and written through above, so only the _trials view needs
+            # rebuilding — FileTrials.refresh would rescan and unpickle
+            # the study's whole on-disk store on every tell (O(n) files)
+            Trials.refresh(st.trials)
+            st.n_told += 1
+            st.touch()
+            self.metrics.counter("service.tells").inc()
+            if (st.max_trials is not None
+                    and st.n_trials >= st.max_trials and st.n_pending == 0):
+                st.state = "done"
+                self._evict_from_cohort(st)
+
+    # -- status ------------------------------------------------------------
+
+    def study_status(self, study_id):
+        with self._lock:
+            return self._get(study_id).status_dict()
+
+    def studies_status(self):
+        """The ``GET /studies`` payload: per-study status plus the
+        cohort/slot roll-up."""
+        with self._lock:
+            cohorts = [{
+                "space_sig": repr(key[0])[:64],
+                "cap": c.cap,
+                "n_slots": c.n_slots,
+                "n_live": c.n_live,
+                "ticks": c.ticks,
+            } for key, c in self._cohorts.items()]
+            return {
+                "ts": time.time(),
+                "n_studies": len(self._studies),
+                "slot_utilization": self.slot_utilization(),
+                "cohort_cache": tpe.cohort_cache_stats(),
+                "cohorts": cohorts,
+                "studies": [s.status_dict()
+                            for s in self._studies.values()],
+            }

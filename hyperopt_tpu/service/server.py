@@ -1,0 +1,373 @@
+"""HTTP ask/tell front end over the :class:`StudyScheduler`.
+
+Grown out of ``obs/serve.py``'s fail-open stdlib-daemon pattern — the
+same ``ThreadingHTTPServer`` + daemon-thread shape, now serving
+*proposals* instead of metrics.  Endpoints (all JSON):
+
+* ``POST /study`` — ``{"space": <spec>}`` (``service/spacespec.py``
+  schema) or ``{"zoo": "<zoo name>"}``, plus optional ``seed``,
+  ``n_startup_jobs``, ``max_trials`` and the ``tpe.suggest`` tuning
+  kwargs → ``{"study_id": ...}`` (an opaque ``filestore.new_run_id``).
+* ``POST /ask`` — ``{"study_id": ..., "n": 1}`` →
+  ``{"trials": [{"tid": ..., "params": {label: value}}, ...]}``.
+  Concurrent asks coalesce into one batched cohort tick per wave.
+* ``POST /tell`` — ``{"study_id": ..., "tid": ..., "loss": ...}`` (or
+  ``"results": [{tid, loss[, status]}, ...]``) → ``{"ok": true}``.
+* ``POST /close`` — ``{"study_id": ...}`` frees the study's slot.
+* ``GET /studies`` — the study table: per-study status + cohort/slot
+  roll-up + cohort-program cache counters.
+* ``GET /metrics`` / ``GET /snapshot`` — the obs integration:
+  Prometheus exposition of every registry namespace (the ``service.*``
+  family rides along) and a JSON snapshot with the study table.
+
+Error mapping is in-band and typed: schema errors answer 400, unknown
+studies 404, quota exhaustion 429 — all as ``{"ok": false, "error":
+...}`` JSON.  A handler bug answers 500 once per request and never
+propagates into the scheduler (the obs/serve.py contract).
+
+Arming: ``python -m hyperopt_tpu.service.server [--port P]`` (or
+``HYPEROPT_TPU_SERVICE=<port>`` with no ``--port``); ``--port 0`` binds
+an ephemeral port and ``--announce`` prints ``SERVICE_URL <url>`` for
+harnesses (``scripts/service_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from ..obs.serve import prometheus_text, split_hostport
+from .scheduler import (DuplicateTellError, StudyQuotaError, StudyScheduler,
+                        UnknownStudyError)
+from .spacespec import SpaceSpecError, space_from_spec
+
+__all__ = ["ServiceHTTPServer", "main"]
+
+logger = logging.getLogger(__name__)
+
+_STUDY_KWARGS = ("n_startup_jobs", "max_trials", "prior_weight",
+                 "n_EI_candidates", "gamma", "linear_forgetting",
+                 "ei_select", "ei_tau", "prior_eps")
+
+
+class _RequestError(Exception):
+    """Typed in-band failure: (HTTP status, message)."""
+
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = int(status)
+
+
+class ServiceHTTPServer:
+    """Daemon-thread ask/tell server over one scheduler (see module
+    docstring).  Fail-open lifecycle matches ``obs/serve.py``:
+    ``start()`` warns and returns False on a bind failure instead of
+    raising, ``stop()`` is idempotent."""
+
+    def __init__(self, port, scheduler=None, host=None, store_root=None):
+        try:
+            if host is None:
+                host, port = split_hostport(port)
+            self.port = int(port)
+        except (TypeError, ValueError):
+            self.port = None  # start() warns and fails open
+        self.host = host or "127.0.0.1"
+        self.scheduler = scheduler if scheduler is not None else (
+            StudyScheduler(store_root=store_root, wave_window=0.005))
+        self._httpd = None
+        self._thread = None
+        self._stopped = False
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, method, path, body):
+        """Route one request; returns ``(status, payload dict)``.  Pure
+        (no socket I/O) so tests can drive it directly."""
+        sched = self.scheduler
+        try:
+            if method == "GET":
+                if path == "/studies":
+                    return 200, sched.studies_status()
+                if path == "/snapshot":
+                    return 200, self.snapshot_dict()
+                if path == "/":
+                    return 200, {
+                        "ok": True,
+                        "endpoints": ["POST /study", "POST /ask",
+                                      "POST /tell", "POST /close",
+                                      "GET /studies", "GET /metrics",
+                                      "GET /snapshot"]}
+                raise _RequestError(404, f"no such endpoint: {path}")
+            if method != "POST":
+                raise _RequestError(405, f"{method} not supported")
+            if path == "/study":
+                return 200, self._create_study(body)
+            if path == "/ask":
+                study_id = self._required(body, "study_id")
+                n = int(body.get("n", 1))
+                trials = sched.ask(study_id, n)
+                return 200, {"ok": True, "study_id": study_id,
+                             "trials": [{"tid": t["tid"],
+                                         "params": t["params"]}
+                                        for t in trials]}
+            if path == "/tell":
+                study_id = self._required(body, "study_id")
+                results = body.get("results")
+                batch = results is not None
+                if not batch:
+                    results = [{"tid": self._required(body, "tid"),
+                                "loss": body.get("loss"),
+                                "status": body.get("status")}]
+                told = dups = 0
+                for r in results:
+                    if not isinstance(r, dict) or r.get("tid") is None:
+                        raise _RequestError(
+                            400, f"each result needs a 'tid': {r!r}")
+                    try:
+                        sched.tell(study_id, r["tid"], loss=r.get("loss"),
+                                   status=r.get("status"))
+                        told += 1
+                    except DuplicateTellError:
+                        # a retried BATCH must not strand its untold
+                        # tail behind one already-settled tid — skip and
+                        # report; a single-tid duplicate still answers
+                        # 409 so the client learns the conflict
+                        if not batch:
+                            raise
+                        dups += 1
+                return 200, {"ok": True, "study_id": study_id,
+                             "told": told, "duplicates": dups}
+            if path == "/close":
+                study_id = self._required(body, "study_id")
+                sched.close_study(study_id)
+                return 200, {"ok": True, "study_id": study_id}
+            raise _RequestError(404, f"no such endpoint: {path}")
+        except _RequestError as e:
+            return e.status, {"ok": False, "error": str(e)}
+        except UnknownStudyError as e:
+            return 404, {"ok": False, "error": str(e)}
+        except DuplicateTellError as e:
+            # 409, not 429: "already told" is permanent — a client
+            # retrying a lost tell response must not back off forever
+            return 409, {"ok": False, "error": str(e)}
+        except StudyQuotaError as e:
+            return 429, {"ok": False, "error": str(e)}
+        # ValueError/TypeError here are request-shape problems (bad n,
+        # non-numeric loss, schema coercions); internal KeyError-class
+        # bugs fall through to the 500 handler so server-side alerting
+        # sees them instead of the client eating a bogus 400
+        except (SpaceSpecError, ValueError, TypeError) as e:
+            return 400, {"ok": False,
+                         "error": f"{type(e).__name__}: {e}"}
+        except Exception as e:  # noqa: BLE001 - fail-open contract
+            logger.warning("service: %s %s failed: %s", method, path, e)
+            return 500, {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    def _required(body, key):
+        v = body.get(key)
+        if v is None:
+            raise _RequestError(400, f"missing required field {key!r}")
+        return v
+
+    def _create_study(self, body):
+        if "space" in body:
+            space = space_from_spec(body["space"])
+        elif "zoo" in body:
+            from ..zoo import ZOO
+
+            rec = ZOO.get(str(body["zoo"]))
+            if rec is None:
+                raise _RequestError(
+                    400, f"unknown zoo domain {body['zoo']!r} "
+                         f"(one of {sorted(ZOO)})")
+            space = rec.space
+        else:
+            raise _RequestError(400, "POST /study needs 'space' or 'zoo'")
+        kwargs = {k: body[k] for k in _STUDY_KWARGS if k in body}
+        study_id = self.scheduler.create_study(
+            space, seed=int(body.get("seed", 0)), **kwargs)
+        return {"ok": True, "study_id": study_id}
+
+    def snapshot_dict(self):
+        """``/snapshot``: the service metrics namespace plus the study
+        table — the obs-plane view of the serving layer."""
+        out = {"ts": time.time(), "endpoint": "snapshot"}
+        out["sections"] = {
+            "service": self.scheduler.metrics.snapshot()["metrics"]}
+        status = self.scheduler.studies_status()
+        out["studies"] = status["studies"]
+        out["cohorts"] = status["cohorts"]
+        out["slot_utilization"] = status["slot_utilization"]
+        out["cohort_cache"] = status["cohort_cache"]
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def url(self):
+        if self._httpd is None:
+            return None
+        return f"http://{self.host}:{self._httpd.server_address[1]}"
+
+    def start(self):
+        """Bind + serve on a daemon thread; False (after one warning) on
+        any bind failure."""
+        import http.server
+
+        if self.port is None:
+            logger.warning("service: unparseable port/host value; "
+                           "ask/tell serving disabled")
+            return False
+        handler = _make_handler(self)
+        try:
+            self._httpd = http.server.ThreadingHTTPServer(
+                (self.host, self.port), handler)
+        except (OSError, OverflowError, ValueError) as e:
+            logger.warning("service: cannot bind %s:%s (%s); ask/tell "
+                           "serving disabled", self.host, self.port, e)
+            self._httpd = None
+            return False
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name="hyperopt-service-http", daemon=True)
+        self._thread.start()
+        logger.info("ask/tell service listening on %s", self.url)
+        return True
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except Exception:
+                pass
+
+
+def _make_handler(server):
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            logger.debug("service http: " + fmt, *args)
+
+        def _answer(self, status, payload, content_type="application/json"):
+            data = (payload if isinstance(payload, bytes)
+                    else json.dumps(payload, default=str,
+                                    sort_keys=True).encode())
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _dispatch(self, method):
+            path = self.path.partition("?")[0]
+            try:
+                if method == "GET" and path == "/metrics":
+                    self._answer(
+                        200, prometheus_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    return
+                body = {}
+                if method == "POST":
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b"{}"
+                    try:
+                        body = json.loads(raw or b"{}")
+                    except ValueError:
+                        self._answer(400, {"ok": False,
+                                           "error": "body is not JSON"})
+                        return
+                    if not isinstance(body, dict):
+                        self._answer(400, {"ok": False,
+                                           "error": "body must be a JSON "
+                                                    "object"})
+                        return
+                status, payload = server.handle(method, path, body)
+                self._answer(status, payload)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away mid-write
+            except Exception as e:  # noqa: BLE001 - never kill the server
+                logger.warning("service http: %s %s failed: %s",
+                               method, path, e)
+                try:
+                    self.send_error(500)
+                except Exception:
+                    pass
+
+        def do_GET(self):  # noqa: N802 (stdlib handler contract)
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+    return Handler
+
+
+def main(argv=None):
+    import argparse
+
+    from .._env import parse_service
+
+    p = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.service.server",
+        description="Serve ask/tell hyperparameter optimization over HTTP "
+                    "(thousands of concurrent studies batched onto one "
+                    "device mesh).")
+    p.add_argument("--port", default=None,
+                   help="bind port or host:port (0 = ephemeral; default: "
+                        "$HYPEROPT_TPU_SERVICE)")
+    p.add_argument("--store", default=None,
+                   help="FileStore root: persist each study's trials under "
+                        "<store>/<study_id>")
+    p.add_argument("--max-studies", type=int, default=None,
+                   help="admission quota (default: "
+                        "$HYPEROPT_TPU_SERVICE_MAX_STUDIES or 4096)")
+    p.add_argument("--max-pending", type=int, default=None,
+                   help="per-study asked-but-untold quota (default: "
+                        "$HYPEROPT_TPU_SERVICE_MAX_PENDING or 64)")
+    p.add_argument("--idle-sec", type=float, default=None,
+                   help="evict a study's cohort slot after this much "
+                        "inactivity (default: "
+                        "$HYPEROPT_TPU_SERVICE_IDLE_SEC or 600)")
+    p.add_argument("--announce", action="store_true",
+                   help="print 'SERVICE_URL <url>' once bound (harness "
+                        "handshake)")
+    args = p.parse_args(argv)
+
+    port = args.port if args.port is not None else parse_service()
+    if port is None:
+        p.error("no port: pass --port or set HYPEROPT_TPU_SERVICE")
+    sched = StudyScheduler(max_studies=args.max_studies,
+                           max_pending=args.max_pending,
+                           idle_sec=args.idle_sec,
+                           store_root=args.store,
+                           wave_window=0.005)
+    server = ServiceHTTPServer(port, scheduler=sched)
+    if not server.start():
+        return 1
+    if args.announce:
+        print(f"SERVICE_URL {server.url}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""JSON-wire search-space schema for the ask/tell service.
+
+A study arrives over HTTP, so its search space must travel as data.  The
+schema mirrors the ``hp.*`` constructors one-to-one — each node is
+``{"dist": <family>, "args": [...]}`` keyed by its label, families taking
+options use ``"options"`` — and :func:`space_from_spec` rebuilds the
+exact ``hp`` expression tree::
+
+    {"x":   {"dist": "uniform", "args": [-5, 5]},
+     "lr":  {"dist": "loguniform", "args": [-6, 0]},
+     "opt": {"dist": "choice", "options": [0, 1, 2]},
+     "head": {"dist": "choice",
+              "options": [{"width": {"dist": "uniformint",
+                                     "args": [1, 8]}},
+                          "linear"]}}
+
+``choice`` / ``pchoice`` options may be scalars or nested sub-space
+mappings (labels must stay unique across branches — the same
+``DuplicateLabel`` contract every ``hp`` space has).  Unknown families
+raise :class:`SpaceSpecError`, which the server maps to HTTP 400.
+"""
+
+from __future__ import annotations
+
+from .. import hp
+
+__all__ = ["SpaceSpecError", "space_from_spec", "SPEC_FAMILIES"]
+
+
+class SpaceSpecError(ValueError):
+    """Malformed space spec (HTTP 400, never a 500)."""
+
+
+#: family name -> (hp constructor, positional arg count[s])
+SPEC_FAMILIES = {
+    "uniform": (hp.uniform, (2,)),
+    "quniform": (hp.quniform, (3,)),
+    "uniformint": (hp.uniformint, (2, 3)),
+    "loguniform": (hp.loguniform, (2,)),
+    "qloguniform": (hp.qloguniform, (3,)),
+    "normal": (hp.normal, (2,)),
+    "qnormal": (hp.qnormal, (3,)),
+    "lognormal": (hp.lognormal, (2,)),
+    "qlognormal": (hp.qlognormal, (3,)),
+    "randint": (hp.randint, (1, 2)),
+}
+
+
+def _node_from_spec(label, node):
+    if not isinstance(node, dict) or "dist" not in node:
+        raise SpaceSpecError(
+            f"param {label!r}: expected {{'dist': ..., ...}}, got {node!r}")
+    fam = node["dist"]
+    if fam in ("choice", "pchoice"):
+        options = node.get("options")
+        if not isinstance(options, list) or not options:
+            raise SpaceSpecError(
+                f"param {label!r}: {fam} needs a non-empty 'options' list")
+        if fam == "choice":
+            return hp.choice(label, [_option(label, o) for o in options])
+        try:
+            pairs = [(float(p), _option(label, o)) for p, o in options]
+        except (TypeError, ValueError) as e:
+            raise SpaceSpecError(
+                f"param {label!r}: pchoice options must be "
+                f"[probability, option] pairs ({e})") from None
+        return hp.pchoice(label, pairs)
+    entry = SPEC_FAMILIES.get(fam)
+    if entry is None:
+        raise SpaceSpecError(
+            f"param {label!r}: unknown family {fam!r} "
+            f"(one of {sorted(SPEC_FAMILIES) + ['choice', 'pchoice']})")
+    fn, arities = entry
+    args = node.get("args", [])
+    if not isinstance(args, list) or len(args) not in arities:
+        raise SpaceSpecError(
+            f"param {label!r}: {fam} takes {' or '.join(map(str, arities))} "
+            f"args, got {args!r}")
+    try:
+        return fn(label, *[float(a) for a in args])
+    except (TypeError, ValueError) as e:
+        raise SpaceSpecError(f"param {label!r}: {e}") from None
+
+
+def _option(label, opt):
+    """A choice option: a scalar literal or a nested sub-space mapping."""
+    if isinstance(opt, dict):
+        if "dist" in opt:
+            raise SpaceSpecError(
+                f"param {label!r}: a bare distribution cannot be a choice "
+                "option — wrap it in a labeled sub-space mapping")
+        return space_from_spec(opt)
+    if isinstance(opt, (int, float, str, bool)) or opt is None:
+        return opt
+    raise SpaceSpecError(
+        f"param {label!r}: option {opt!r} is neither a scalar nor a "
+        "sub-space mapping")
+
+
+def space_from_spec(spec):
+    """Rebuild an ``hp`` space from its JSON-wire form (see module
+    docstring).  ``spec`` is a ``{label: node}`` mapping."""
+    if not isinstance(spec, dict) or not spec:
+        raise SpaceSpecError(f"space spec must be a non-empty mapping, "
+                             f"got {spec!r}")
+    return {label: _node_from_spec(label, node)
+            for label, node in spec.items()}

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 
 from . import hp
 
-__all__ = ["DomainZoo", "ZOO", "branin", "hartmann6", "rosenbrock"]
+__all__ = ["DomainZoo", "ZOO", "branin", "hartmann6", "rosenbrock",
+           "StudyMixItem", "make_study_mix"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -545,6 +546,49 @@ def _hpob_surrogate():
     # TPE mean best@100 -0.59 — the target separates TPE from random
     return DomainZoo(name="hpob_surrogate", space=space, objective=obj,
                      loss_target=-0.55, traceable=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class StudyMixItem:
+    """One study of the standing multi-study workload: a zoo domain plus
+    the study-level serving parameters (seed, budget, startup count)."""
+
+    name: str
+    domain: "DomainZoo"
+    seed: int
+    budget: int
+    n_startup_jobs: int
+
+
+#: the domains the standing mix cycles through — chosen for heterogeneous
+#: spaces (1-D uniform, 2-D, 6-D, mixed discrete HPO-B surrogate) so a mix
+#: always exercises several cohorts at once, and all cheap to evaluate
+_MIX_DOMAINS = ("quadratic1", "branin", "hartmann6", "rosenbrock4",
+                "hpob_surrogate")
+_MIX_BUDGETS = (20, 30, 40, 60, 80)
+
+
+def make_study_mix(n, seed0=0):
+    """The standing multi-study workload (ISSUE 9 satellite): ``n``
+    heterogeneous studies cycling through the HPO-B surrogate and the
+    analytic zoo domains with varied budgets and per-study seeds.
+
+    Used by the multi-study tests, ``bench.py``'s ``multi_study`` stage
+    and ``scripts/service_smoke.py`` — one definition so "1k concurrent
+    studies" means the same workload everywhere.  Deterministic in
+    ``(n, seed0)``.
+    """
+    mix = []
+    for i in range(int(n)):
+        dom = ZOO[_MIX_DOMAINS[i % len(_MIX_DOMAINS)]]
+        mix.append(StudyMixItem(
+            name=f"{dom.name}#{i}",
+            domain=dom,
+            seed=int(seed0) + i,
+            budget=_MIX_BUDGETS[(i // len(_MIX_DOMAINS)) % len(_MIX_BUDGETS)],
+            n_startup_jobs=5,
+        ))
+    return mix
 
 
 ZOO = {

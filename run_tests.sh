@@ -30,6 +30,10 @@
 # history bit-identical to the undisturbed same-seed run, leave readable
 # flight dumps in the store, and replay bitwise when resumed at a
 # different fleet size.
+# Opt-in service gate: SERVICE_GATE=1 additionally re-runs the ask/tell
+# service suites and then scripts/service_smoke.py — a real subprocess
+# server drives 100 concurrent HTTP studies to convergence, with the
+# /studies table and /metrics exposition linted.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -43,17 +47,22 @@ if [ "${TRACE_GATE:-0}" = "1" ]; then
 fi
 if [ "${DONATION_GATE:-0}" = "1" ]; then
     # tests/test_shard_suggest.py -k donation pins the SHARDED path too:
-    # per-shard buffer pointers stable across ticks, stale-handle guard
+    # per-shard buffer pointers stable across ticks, stale-handle guard;
+    # tests/test_batched_suggest.py -k donation pins the STUDY-axis
+    # cohort stack (no S x cap copy per wave)
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu DONATION_GATE=1 \
         python -m pytest tests/test_pipeline.py tests/test_shard_suggest.py \
-        -q -k donation || exit 1
+        tests/test_batched_suggest.py -q -k donation || exit 1
 fi
 if [ "${SERVE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/validate_scrape.py --self-test || exit 1
 fi
 if [ "${SHARD_GATE:-0}" = "1" ]; then
+    # test_batched_suggest.py rides along: the study-axis-sharded cohort
+    # must stay bit-identical with HYPEROPT_TPU_SHARD armed
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_sharding.py tests/test_shard_suggest.py -q || exit 1
+        python -m pytest tests/test_sharding.py tests/test_shard_suggest.py \
+        tests/test_batched_suggest.py -q || exit 1
     python scripts/shard_smoke.py || exit 1
 fi
 if [ "${PROFILE_GATE:-0}" = "1" ]; then
@@ -64,5 +73,11 @@ if [ "${CHAOS_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_membership.py tests/test_chaos.py \
         tests/test_fleet.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/chaos_smoke.py || exit 1
+fi
+if [ "${SERVICE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_service.py tests/test_batched_suggest.py \
+        -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/service_smoke.py || exit 1
 fi
 exit 0

@@ -75,15 +75,22 @@ DEFAULT_THRESHOLDS = {
     # history census is near-deterministic for a fixed config, hence tight
     "peak_hbm_bytes": 0.30,
     "history_bytes": 0.10,
+    # multi-study serving throughput (bench.py multi_study stage)
+    "studies_per_sec": 0.25,
+    "study_ask_p99_ms": 1.00,
+    "slot_utilization_frac": 0.15,
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                  "sharded_cand_per_sec",
                  "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
-                 "peak_hbm_bytes", "history_bytes")
+                 "peak_hbm_bytes", "history_bytes",
+                 "studies_per_sec", "study_ask_p99_ms",
+                 "slot_utilization_frac")
 
 # latency and peak-memory metrics regress UPWARD
 LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
+                   "study_ask_p99_ms",
                    "peak_hbm_bytes", "history_bytes")
 
 

@@ -1,0 +1,240 @@
+"""SERVICE_GATE end-to-end smoke: a REAL subprocess ask/tell server under
+100 concurrent HTTP studies driven to convergence.
+
+What it pins (the serving contract no unit test can):
+
+* the server binds as a real subprocess (``python -m
+  hyperopt_tpu.service.server --port 0 --announce``) and the handshake
+  (``SERVICE_URL <url>``) works;
+* 100 concurrent studies — heterogeneous quadratic spaces — each drive a
+  full ask→evaluate→tell loop over HTTP from a thread pool, and the
+  optimizer CONVERGES (TPE beats the prior: the median best loss across
+  studies must clear a bar random search at the same budget does not);
+* ``GET /studies`` answers a table consistent with what the clients did
+  (validated field-by-field, ``scripts/validate_scrape.py`` style);
+* ``GET /metrics`` passes the Prometheus exposition lint and carries the
+  ``service.*`` family;
+* the server dies cleanly on SIGTERM.
+
+Opt in via ``SERVICE_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_STUDIES = 100
+BUDGET = 24
+N_STARTUP = 5
+N_WORKERS = 12
+# quadratic1-family objective with per-study offset: min 0 at x = offset.
+# Prior best-of-24 over U(-5,5) has median |x-c| ~ 0.29 -> loss ~ 0.085;
+# TPE reliably lands well under this; a broken posterior does not.
+CONVERGENCE_BAR = 0.25
+
+
+def _post(url, path, body, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def validate_studies_payload(payload, expect_ids):
+    """Field-by-field lint of the ``GET /studies`` table (the
+    validate_scrape.py doctrine: structural invariants, not magic
+    values).  Returns a list of error strings."""
+    errs = []
+    for key in ("ts", "n_studies", "slot_utilization", "cohorts",
+                "studies", "cohort_cache"):
+        if key not in payload:
+            errs.append(f"/studies missing key {key!r}")
+    if errs:
+        return errs
+    if payload["n_studies"] != len(payload["studies"]):
+        errs.append("n_studies != len(studies)")
+    if not 0.0 <= payload["slot_utilization"] <= 1.0:
+        errs.append(f"slot_utilization out of [0,1]: "
+                    f"{payload['slot_utilization']}")
+    by_id = {}
+    for s in payload["studies"]:
+        for key in ("study_id", "state", "n_trials", "n_pending",
+                    "best_loss", "labels"):
+            if key not in s:
+                errs.append(f"study entry missing {key!r}")
+        by_id[s.get("study_id")] = s
+        if s.get("n_pending", 0) != 0:
+            errs.append(f"{s.get('study_id')}: {s['n_pending']} pending "
+                        "after all tells")
+    for sid, want_trials in expect_ids.items():
+        s = by_id.get(sid)
+        if s is None:
+            errs.append(f"{sid} missing from /studies")
+        elif s["n_trials"] != want_trials:
+            errs.append(f"{sid}: n_trials {s['n_trials']} != {want_trials}")
+    for c in payload["cohorts"]:
+        if c.get("n_live", 0) > c.get("n_slots", 0):
+            errs.append(f"cohort overfull: {c}")
+    cache = payload["cohort_cache"]
+    if cache.get("misses", 0) <= 0:
+        errs.append("cohort cache never compiled anything?")
+    return errs
+
+
+def main():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--port", "0", "--announce", "--max-studies", "256"],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("SERVICE_URL "):
+                url = line.split(None, 1)[1].strip()
+                break
+            if proc.poll() is not None:
+                break
+        if url is None:
+            print("service_smoke: FAIL — server never announced",
+                  file=sys.stderr)
+            print((proc.stderr.read() or "")[-2000:], file=sys.stderr)
+            return 1
+        print(f"service_smoke: server up at {url} (pid {proc.pid})")
+
+        results = {}   # sid -> (n_trials, best_loss)
+        errors = []
+        lock = threading.Lock()
+        work = list(range(N_STUDIES))
+
+        def drive():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i = work.pop()
+                offset = -4.0 + 8.0 * i / (N_STUDIES - 1)
+                try:
+                    code, r = _post(url, "/study", {
+                        "space": {"x": {"dist": "uniform",
+                                        "args": [-5, 5]}},
+                        "seed": 1000 + i,
+                        "n_startup_jobs": N_STARTUP,
+                        "max_trials": BUDGET})
+                    assert code == 200, r
+                    sid = r["study_id"]
+                    best = float("inf")
+                    for _ in range(BUDGET):
+                        code, a = _post(url, "/ask", {"study_id": sid})
+                        assert code == 200, a
+                        t = a["trials"][0]
+                        loss = (t["params"]["x"] - offset) ** 2
+                        best = min(best, loss)
+                        code, told = _post(url, "/tell", {
+                            "study_id": sid, "tid": t["tid"],
+                            "loss": loss})
+                        assert code == 200, told
+                    with lock:
+                        results[sid] = (BUDGET, best)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"study {i}: {type(e).__name__}: {e}")
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=drive) for _ in range(N_WORKERS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            print("service_smoke: FAIL — client errors:", file=sys.stderr)
+            for e in errors[:10]:
+                print("  " + e, file=sys.stderr)
+            return 1
+        bests = sorted(b for _, b in results.values())
+        median_best = bests[len(bests) // 2]
+        print(f"service_smoke: {N_STUDIES} studies x {BUDGET} trials over "
+              f"HTTP in {dt:.1f}s ({N_STUDIES * BUDGET / dt:.0f} "
+              f"asks/sec), median best loss {median_best:.4f}")
+        if median_best > CONVERGENCE_BAR:
+            print(f"service_smoke: FAIL — median best loss {median_best} "
+                  f"> {CONVERGENCE_BAR} (optimizer did not converge)",
+                  file=sys.stderr)
+            return 1
+
+        code, body = _get(url, "/studies")
+        assert code == 200
+        payload = json.loads(body)
+        errs = validate_studies_payload(
+            payload, {sid: n for sid, (n, _) in results.items()})
+        if errs:
+            print("service_smoke: FAIL — /studies lint:", file=sys.stderr)
+            for e in errs[:10]:
+                print("  " + e, file=sys.stderr)
+            return 1
+        print(f"service_smoke: /studies lint ok "
+              f"({payload['n_studies']} studies, "
+              f"util {payload['slot_utilization']:.2f}, "
+              f"cache {payload['cohort_cache']})")
+
+        code, body = _get(url, "/metrics")
+        assert code == 200
+        text = body.decode()
+        from validate_scrape import validate_metrics_text
+
+        lint = validate_metrics_text(text)
+        if lint:
+            print("service_smoke: FAIL — /metrics lint:", file=sys.stderr)
+            for e in lint[:10]:
+                print("  " + e, file=sys.stderr)
+            return 1
+        if "hyperopt_tpu_service_asks_total" not in text:
+            print("service_smoke: FAIL — service.* family missing from "
+                  "/metrics", file=sys.stderr)
+            return 1
+        print("service_smoke: /metrics exposition lint ok")
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            print("service_smoke: FAIL — server ignored SIGTERM",
+                  file=sys.stderr)
+            return 1
+        print("service_smoke: PASS")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

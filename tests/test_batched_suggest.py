@@ -1,0 +1,256 @@
+"""ISSUE 9: the study-axis batched fused tell+ask kernel.
+
+The determinism doctrine, one level up from ISSUE 6's: batching STUDIES
+is a scheduling change, not an algorithm change — a cohort of N studies
+must propose bit-identically to N independent sequential ``fmin`` runs at
+the same per-study seeds, in the replicated layout, in the study-axis-
+sharded layout, and across cohort capacity buckets (the graded-cap
+machinery slices each slot to a tight power-of-two bucket; padding is
+fully masked, so proposals are capacity-invariant).
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.parallel import sharding
+from hyperopt_tpu.service import StudyScheduler
+from hyperopt_tpu.service.scheduler import _cohort_cap
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -4, 0),
+    "k": hp.randint("k", 4),
+}
+
+CFG = {"prior_weight": 1.0, "n_EI_candidates": 24, "gamma": 0.25,
+       "LF": 25, "ei_select": "argmax", "ei_tau": 1.0, "prior_eps": 0.0}
+
+
+def obj(d):
+    return (d["x"] - 1.0) ** 2 + d["lr"] + 0.1 * d["k"]
+
+
+def _run_fmin(seed, budget, qn=2, n_startup=4):
+    t = Trials()
+    fmin(obj, SPACE, algo=functools.partial(tpe.suggest,
+                                            n_startup_jobs=n_startup),
+         max_evals=budget, max_queue_len=qn, trials=t,
+         rstate=np.random.default_rng(seed), show_progressbar=False)
+    return [d["misc"]["vals"] for d in t.trials]
+
+
+def _run_scheduler(seeds, budget, qn=2, n_startup=4):
+    sched = StudyScheduler()
+    sids = [sched.create_study(SPACE, seed=s, n_startup_jobs=n_startup)
+            for s in seeds]
+    for _ in range(budget // qn):
+        answers = sched.ask_many([(sid, qn) for sid in sids])
+        for sid in sids:
+            for a in answers[sid]:
+                sched.tell(sid, a["tid"], float(obj(a["params"])))
+    return [[d["misc"]["vals"] for d in sched._studies[sid].trials]
+            for sid in sids], sched
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 determinism pin (replicated layout)
+# ---------------------------------------------------------------------------
+
+
+def test_cohort_bit_identical_to_sequential_fmin():
+    """A batched cohort of N studies == N independent sequential fmin runs
+    at the same per-study seeds, trial for trial, bit for bit."""
+    seeds = [100, 101, 102, 103]
+    budget = 12
+    expected = [_run_fmin(s, budget) for s in seeds]
+    got, _ = _run_scheduler(seeds, budget)
+    assert got == expected
+
+
+def test_cohort_determinism_across_cap_migration():
+    """A budget crossing the graded capacity buckets (16 -> 32) migrates
+    studies between cohorts mid-run without perturbing the pin."""
+    seeds = [7, 8]
+    budget = 20  # crosses _cohort_cap's 16-slot bucket at n = 16
+    assert _cohort_cap(10) == 16 and _cohort_cap(16) == 32
+    expected = [_run_fmin(s, budget) for s in seeds]
+    got, sched = _run_scheduler(seeds, budget)
+    assert got == expected
+    caps = {c.cap for c in sched._cohorts.values()}
+    assert 32 in caps  # really migrated to the bigger bucket
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+def test_cohort_sharded_study_axis_bit_identical(monkeypatch):
+    """HYPEROPT_TPU_SHARD armed: the study axis shards across the mesh and
+    proposals stay bit-identical to the replicated layout — and hence to
+    the sequential fmin runs."""
+    seeds = list(range(60, 68))  # 8 studies: slots divide the 8-dev mesh
+    budget = 10
+    expected = [_run_fmin(s, budget) for s in seeds]
+    monkeypatch.setenv("HYPEROPT_TPU_SHARD", "8")
+    got, _ = _run_scheduler(seeds, budget)
+    assert got == expected
+
+
+# ---------------------------------------------------------------------------
+# kernel-level pins
+# ---------------------------------------------------------------------------
+
+
+def _hist_at_cap(cs, cap, n_live, rng):
+    vals = {l: np.zeros(cap, np.float32) for l in cs.labels}
+    act = {l: np.zeros(cap, bool) for l in cs.labels}
+    losses = np.full(cap, np.inf, np.float32)
+    has = np.zeros(cap, bool)
+    for i in range(n_live):
+        for l in cs.labels:
+            vals[l][i] = rng.uniform(0.1, 3)
+            act[l][i] = True
+        losses[i] = rng.uniform()
+        has[i] = True
+    return {"vals": {l: jnp.asarray(vals[l]) for l in cs.labels},
+            "active": {l: jnp.asarray(act[l]) for l in cs.labels},
+            "losses": jnp.asarray(losses), "has_loss": jnp.asarray(has)}
+
+
+def test_proposals_bitwise_capacity_invariant():
+    """The graded-cap contract: the fused kernel's proposals do not depend
+    on the padded capacity (16 vs 128) — padding is fully masked."""
+    dom = Domain(None, SPACE)
+    cs = dom.cs
+    L = len(cs.labels)
+    outs = {}
+    for cap in (16, 32, 128):
+        dev = _hist_at_cap(cs, cap, n_live=9, rng=np.random.default_rng(3))
+        run = tpe._get_suggest_jit(dom, tuple(sorted(CFG.items())), CFG,
+                                   donate=False)
+        rows = np.zeros((16, 2 * L + 3), np.float32)
+        rows[:, -1] = cap
+        out = run(dev, rows, tpe._seed_words(99),
+                  np.asarray([4, 5, 6, 7], np.uint32))
+        outs[cap] = np.asarray(out[1])
+    assert np.array_equal(outs[16], outs[32])
+    assert np.array_equal(outs[32], outs[128])
+
+
+def test_batched_kernel_matches_single_study_kernel():
+    """build_suggest_batched == the single-study fused program vmapped:
+    same fold, same key derivation, same proposals per slot."""
+    dom = Domain(None, SPACE)
+    cs = dom.cs
+    L = len(cs.labels)
+    S, cap, B = 4, 32, 2
+    rng = np.random.default_rng(11)
+    devs = [_hist_at_cap(cs, cap, n_live=5 + s, rng=rng) for s in range(S)]
+    rows = np.zeros((S, 16, 2 * L + 3), np.float32)
+    rows[:, :, -1] = cap
+    # one real pending tell row for slot 0
+    rows[0, 0, :L] = 1.5
+    rows[0, 0, L:2 * L] = 1.0
+    rows[0, 0, 2 * L] = 0.25
+    rows[0, 0, 2 * L + 1] = 1.0
+    rows[0, 0, 2 * L + 2] = 6.0
+    seeds = np.stack([tpe._seed_words(1000 + s) for s in range(S)])
+    ids = np.asarray([[3 + s, 9 + s] for s in range(S)], np.uint32)
+
+    single = tpe._get_suggest_jit(dom, tuple(sorted(CFG.items())), CFG,
+                                  donate=False)
+    expected = [np.asarray(single(devs[s], rows[s], seeds[s], ids[s])[1])
+                for s in range(S)]
+
+    stack = jax.tree.map(lambda *xs: jnp.stack(xs), *devs)
+    run = tpe.build_suggest_batched(cs, CFG, S, cap, B, donate=False)
+    _, packed = run(stack, rows, seeds, ids)
+    packed = np.asarray(packed)
+    for s in range(S):
+        assert np.array_equal(packed[s], expected[s]), s
+
+
+def test_cohort_donation_folds_in_place():
+    """DONATION pin for the study axis: across ticks the stacked history
+    buffers keep their addresses — no S×cap copy per wave."""
+    sched = StudyScheduler()
+    sids = [sched.create_study(SPACE, seed=40 + i, n_startup_jobs=2)
+            for i in range(4)]
+
+    def wave():
+        answers = sched.ask_many([(sid, 1) for sid in sids])
+        for sid in sids:
+            for a in answers[sid]:
+                sched.tell(sid, a["tid"], float(obj(a["params"])))
+
+    for _ in range(3):
+        wave()
+    cohort = next(iter(sched._cohorts.values()))
+    ptrs = {"losses": cohort._dev["losses"].unsafe_buffer_pointer(),
+            "x": cohort._dev["vals"]["x"].unsafe_buffer_pointer()}
+    for _ in range(4):
+        wave()
+        assert cohort._dev["losses"].unsafe_buffer_pointer() == ptrs["losses"]
+        assert cohort._dev["vals"]["x"].unsafe_buffer_pointer() == ptrs["x"]
+
+
+def test_cohort_cache_keyed_on_shape():
+    """The cohort-program LRU distinguishes cohort shapes and reports
+    hit/miss stats (the ``suggest.cohort_cache`` metrics source)."""
+    cs = Domain(None, SPACE).cs
+    before = tpe.cohort_cache_stats()
+    fn1 = tpe.build_suggest_batched(cs, CFG, 4, 32, 1, donate=False)
+    fn2 = tpe.build_suggest_batched(cs, CFG, 4, 32, 1, donate=False)
+    assert fn1 is fn2
+    fn3 = tpe.build_suggest_batched(cs, CFG, 8, 32, 1, donate=False)
+    assert fn3 is not fn1
+    after = tpe.cohort_cache_stats()
+    assert after["hits"] >= before["hits"] + 1
+    assert after["misses"] >= before["misses"] + 1
+
+
+# ---------------------------------------------------------------------------
+# partition rules for the study axis
+# ---------------------------------------------------------------------------
+
+
+def test_study_axis_partition_rules():
+    from jax.sharding import PartitionSpec as P
+
+    rules = sharding.suggest_partition_rules(study_axis=True)
+    tree = {"hist": {"losses": 0, "has_loss": 0,
+                     "vals": {"x": 0}, "active": {"x": 0}},
+            "ids": 0, "rows": 0, "seed_words": 0, "packed": 0}
+    specs = sharding.match_partition_rules(rules, tree)
+    batch = P((sharding.CAND_AXIS,))
+    # EVERY cohort leaf leads with the study axis and shards over it
+    assert specs["hist"]["losses"] == batch
+    assert specs["hist"]["vals"]["x"] == batch
+    assert specs["rows"] == batch
+    assert specs["seed_words"] == batch
+    assert specs["ids"] == batch
+    assert specs["packed"] == batch
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+def test_suggest_batched_shardings_build():
+    mesh = sharding.suggest_mesh(8)
+    in_sh, out_sh = sharding.suggest_batched_shardings(mesh, ("x", "lr"))
+    hist_sh, rows_sh, seeds_sh, ids_sh = in_sh
+    assert set(hist_sh["vals"]) == {"x", "lr"}
+    assert len(out_sh) == 2
+
+
+def test_cohort_cap_buckets():
+    assert _cohort_cap(0) == 16
+    assert _cohort_cap(15) == 16
+    assert _cohort_cap(16) == 32
+    assert _cohort_cap(40) == 64
+    assert _cohort_cap(200) == 256

@@ -1,0 +1,466 @@
+"""ISSUE 9: the ask/tell service layer — scheduler, space schema, HTTP.
+
+The scheduler's correctness properties (quotas, eviction/re-admission
+invariance, cohort packing, persistence) plus the serving front end's
+contract (routes, error mapping, exposition-format lint, concurrent wave
+batching).  The heavy determinism pins live in test_batched_suggest.py.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.filestore import FileTrials, new_run_id
+from hyperopt_tpu.service import (StudyQuotaError, StudyScheduler,
+                                  UnknownStudyError, space_from_spec)
+from hyperopt_tpu.service.scheduler import DuplicateTellError
+from hyperopt_tpu.service.server import ServiceHTTPServer
+from hyperopt_tpu.service.spacespec import SpaceSpecError
+from hyperopt_tpu.zoo import ZOO, make_study_mix
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def _loss(params):
+    return float((params["x"] - 2.0) ** 2)
+
+
+def _drive(sched, sid, n_iters, n=1):
+    out = []
+    for _ in range(n_iters):
+        for a in sched.ask(sid, n):
+            sched.tell(sid, a["tid"], _loss(a["params"]))
+            out.append(a["params"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scheduler basics
+# ---------------------------------------------------------------------------
+
+
+def test_create_ask_tell_flow():
+    sched = StudyScheduler()
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=3)
+    assert sid.startswith("study-")
+    params = _drive(sched, sid, 8)
+    assert len(params) == 8
+    st = sched.study_status(sid)
+    assert st["n_trials"] == 8 and st["n_pending"] == 0
+    assert st["best_loss"] is not None
+
+
+def test_run_id_opaque_and_unique():
+    ids = {new_run_id("study") for _ in range(64)}
+    assert len(ids) == 64
+    assert all(i.startswith("study-") for i in ids)
+
+
+def test_quota_max_studies():
+    sched = StudyScheduler(max_studies=2)
+    sched.create_study(SPACE, seed=0)
+    sched.create_study(SPACE, seed=1)
+    with pytest.raises(StudyQuotaError):
+        sched.create_study(SPACE, seed=2)
+    # closing one frees the quota
+    sched.close_study(sched.studies_status()["studies"][0]["study_id"])
+    sched.create_study(SPACE, seed=3)
+
+
+def test_quota_max_pending():
+    sched = StudyScheduler(max_pending=3)
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    asked = sched.ask(sid, 3)
+    with pytest.raises(StudyQuotaError):
+        sched.ask(sid, 1)
+    sched.tell(sid, asked[0]["tid"], 1.0)
+    sched.ask(sid, 1)  # freed
+
+
+def test_budget_marks_study_done():
+    sched = StudyScheduler()
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=2, max_trials=4)
+    _drive(sched, sid, 4)
+    assert sched.study_status(sid)["state"] == "done"
+    with pytest.raises((StudyQuotaError, UnknownStudyError)):
+        sched.ask(sid, 1)
+
+
+def test_unknown_study_and_double_tell():
+    sched = StudyScheduler()
+    with pytest.raises(UnknownStudyError):
+        sched.ask("study-nope")
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    a = sched.ask(sid)[0]
+    sched.tell(sid, a["tid"], 0.5)
+    with pytest.raises(DuplicateTellError):
+        sched.tell(sid, a["tid"], 0.5)
+    with pytest.raises(UnknownStudyError):
+        sched.tell(sid, 10**6, 0.5)
+
+
+def test_failed_trial_tell():
+    sched = StudyScheduler()
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    a = sched.ask(sid)[0]
+    sched.tell(sid, a["tid"], loss=None)  # no loss -> STATUS_FAIL
+    st = sched.study_status(sid)
+    assert st["n_trials"] == 1 and st["best_loss"] is None
+    # the failed trial never poisons later asks
+    _drive(sched, sid, 3)
+
+
+def test_tell_nonfinite_loss_records_fail_even_with_ok_status():
+    """status='ok' never overrides the finite-loss guard: an inf/NaN loss
+    settles as STATUS_FAIL instead of poisoning the posterior."""
+    sched = StudyScheduler()
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    asked = sched.ask(sid, 3)
+    sched.tell(sid, asked[0]["tid"], loss=float("inf"), status="ok")
+    sched.tell(sid, asked[1]["tid"], loss=float("nan"))
+    sched.tell(sid, asked[2]["tid"], loss=None, status="ok")
+    st = sched._studies[sid]
+    assert [r["status"] for r in st.trials.results] == ["fail"] * 3
+    assert sched.study_status(sid)["best_loss"] is None
+    _drive(sched, sid, 2)  # posterior still healthy
+
+
+def test_empty_cohorts_are_garbage_collected():
+    sched = StudyScheduler()
+    sid = sched.create_study(SPACE, seed=3, n_startup_jobs=2, max_trials=6)
+    _drive(sched, sid, 6)  # budget done -> evicted from its cohort
+    assert sched.study_status(sid)["state"] == "done"
+    sched._gc_cohorts()
+    assert not sched._cohorts  # no live slots -> no pinned device stacks
+
+
+def test_eviction_and_bit_identical_readmission():
+    """Evicting an idle study's slot and re-admitting it from the host
+    arrays must not perturb its proposal stream: compare against an
+    uninterrupted twin."""
+    def run(evict_mid):
+        sched = StudyScheduler()
+        sid = sched.create_study(SPACE, seed=17, n_startup_jobs=2)
+        out = []
+        for i in range(10):
+            if evict_mid and i == 6:
+                sched._evict_from_cohort(sched._studies[sid])
+            out.extend(_drive(sched, sid, 1))
+        return out
+
+    assert run(True) == run(False)
+
+
+def test_evict_idle_frees_slots():
+    sched = StudyScheduler(idle_sec=0.5)
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    _drive(sched, sid, 3)
+    assert sum(c.n_live for c in sched._cohorts.values()) == 1
+    sched.evict_idle(now=sched._studies[sid].last_active + 1.0)
+    assert sum(c.n_live for c in sched._cohorts.values()) == 0
+    _drive(sched, sid, 1)  # next ask re-admits
+
+
+def test_idle_sec_zero_means_never_evict():
+    sched = StudyScheduler(idle_sec=0)
+    sid = sched.create_study(SPACE, seed=0, n_startup_jobs=1)
+    _drive(sched, sid, 2)
+    sched.evict_idle(now=sched._studies[sid].last_active + 1e9)
+    assert sum(c.n_live for c in sched._cohorts.values()) == 1
+
+
+def test_wave_batches_one_tick_per_cohort():
+    sched = StudyScheduler()
+    sids = [sched.create_study(SPACE, seed=i, n_startup_jobs=1)
+            for i in range(6)]
+    # graduate everyone to TPE
+    answers = sched.ask_many([(sid, 1) for sid in sids])
+    for sid in sids:
+        for a in answers[sid]:
+            sched.tell(sid, a["tid"], _loss(a["params"]))
+    ticks0 = sched.metrics.counter("service.ticks").value
+    answers = sched.ask_many([(sid, 1) for sid in sids])
+    assert sum(len(v) for v in answers.values()) == 6
+    assert sched.metrics.counter("service.ticks").value == ticks0 + 1
+    assert 0.0 < sched.slot_utilization() <= 1.0
+
+
+def test_filestore_persistence_round_trip(tmp_path):
+    sched = StudyScheduler(store_root=str(tmp_path))
+    sid = sched.create_study(SPACE, seed=11, n_startup_jobs=3)
+    _drive(sched, sid, 7)
+    t2 = FileTrials(str(tmp_path / sid))
+    assert len(t2.trials) == 7
+    assert all(d["result"].get("loss") is not None for d in t2.trials)
+    # tell settled the docs: no stale new/ copies left behind
+    assert not any(p.name.endswith(".pkl")
+                   for p in (tmp_path / sid / "new").iterdir())
+
+
+# ---------------------------------------------------------------------------
+# space schema
+# ---------------------------------------------------------------------------
+
+
+def test_space_from_spec_families():
+    spec = {
+        "u": {"dist": "uniform", "args": [-1, 1]},
+        "qu": {"dist": "quniform", "args": [0, 10, 2]},
+        "ui": {"dist": "uniformint", "args": [1, 8]},
+        "lu": {"dist": "loguniform", "args": [-3, 0]},
+        "qlu": {"dist": "qloguniform", "args": [0, 3, 1]},
+        "n": {"dist": "normal", "args": [0, 1]},
+        "qn": {"dist": "qnormal", "args": [0, 1, 0.5]},
+        "ln": {"dist": "lognormal", "args": [0, 1]},
+        "qln": {"dist": "qlognormal", "args": [0, 1, 1]},
+        "ri": {"dist": "randint", "args": [5]},
+        "c": {"dist": "choice", "options": [0, 1, 2]},
+        "pc": {"dist": "pchoice", "options": [[0.2, 0], [0.8, 1]]},
+    }
+    space = space_from_spec(spec)
+    sched = StudyScheduler()
+    sid = sched.create_study(space, seed=1, n_startup_jobs=2)
+    params = _drive_any(sched, sid, 4)
+    assert len(params) == 4
+
+
+def _drive_any(sched, sid, n_iters):
+    out = []
+    for _ in range(n_iters):
+        for a in sched.ask(sid, 1):
+            loss = float(sum(float(v) for v in a["params"].values()))
+            sched.tell(sid, a["tid"], loss)
+            out.append(a["params"])
+    return out
+
+
+def test_space_from_spec_nested_choice():
+    spec = {"head": {"dist": "choice",
+                     "options": [{"w": {"dist": "uniform", "args": [0, 1]}},
+                                 "flat"]}}
+    space = space_from_spec(spec)
+    sched = StudyScheduler()
+    sid = sched.create_study(space, seed=2, n_startup_jobs=2)
+    assert len(_drive_any(sched, sid, 3)) == 3
+
+
+def test_space_from_spec_errors():
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({})
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({"x": {"dist": "warp", "args": [1]}})
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({"x": {"dist": "uniform", "args": [1]}})  # arity
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({"x": {"dist": "choice", "options": []}})
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({"x": "not-a-node"})
+
+
+# ---------------------------------------------------------------------------
+# the study mix (standing multi-study workload)
+# ---------------------------------------------------------------------------
+
+
+def test_make_study_mix_shape_and_determinism():
+    mix = make_study_mix(12)
+    assert len(mix) == 12
+    assert mix == make_study_mix(12)
+    # heterogeneous: several distinct spaces and budgets
+    assert len({m.domain.name for m in mix}) >= 3
+    assert len({m.budget for m in mix}) >= 2
+    assert all(m.domain is ZOO[m.domain.name] for m in mix)
+    assert [m.seed for m in mix] == list(range(12))
+
+
+def test_study_mix_drives_through_scheduler():
+    mix = make_study_mix(6)
+    sched = StudyScheduler()
+    sids = [sched.create_study(m.domain.space, seed=m.seed,
+                               n_startup_jobs=2) for m in mix]
+    for _ in range(4):
+        answers = sched.ask_many([(sid, 1) for sid in sids])
+        for sid, m in zip(sids, mix):
+            for a in answers[sid]:
+                sched.tell(sid, a["tid"],
+                           float(sum(float(v) for v in a["params"].values())))
+    status = sched.studies_status()
+    assert status["n_studies"] == 6
+    assert len(status["cohorts"]) >= 3  # heterogeneous spaces -> cohorts
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def test_handle_routes_without_socket():
+    srv = ServiceHTTPServer(0)
+    code, r = srv.handle("POST", "/study",
+                         {"space": {"x": {"dist": "uniform",
+                                          "args": [-5, 5]}},
+                          "seed": 3, "n_startup_jobs": 2})
+    assert code == 200 and r["ok"]
+    sid = r["study_id"]
+    code, r = srv.handle("POST", "/ask", {"study_id": sid, "n": 2})
+    assert code == 200 and len(r["trials"]) == 2
+    code, r = srv.handle("POST", "/tell", {
+        "study_id": sid,
+        "results": [{"tid": t["tid"], "loss": 1.0} for t in r["trials"]]})
+    assert code == 200 and r["told"] == 2
+    code, r = srv.handle("GET", "/studies", {})
+    assert code == 200 and r["n_studies"] == 1
+    code, r = srv.handle("GET", "/snapshot", {})
+    assert code == 200 and "service" in r["sections"]
+    code, r = srv.handle("POST", "/close", {"study_id": sid})
+    assert code == 200
+
+
+def test_handle_error_mapping():
+    srv = ServiceHTTPServer(0)
+    assert srv.handle("POST", "/ask", {"study_id": "study-x"})[0] == 404
+    assert srv.handle("POST", "/study", {})[0] == 400
+    # double tell answers 409 (permanent conflict), never a retryable 429
+    code, r = srv.handle("POST", "/study",
+                         {"space": {"x": {"dist": "uniform",
+                                          "args": [0, 1]}},
+                          "n_startup_jobs": 1})
+    sid = r["study_id"]
+    tid = srv.handle("POST", "/ask", {"study_id": sid})[1]["trials"][0]["tid"]
+    assert srv.handle("POST", "/tell", {"study_id": sid, "tid": tid,
+                                        "loss": 0.1})[0] == 200
+    assert srv.handle("POST", "/tell", {"study_id": sid, "tid": tid,
+                                        "loss": 0.1})[0] == 409
+    # a retried BATCH skips already-told tids instead of stranding the rest
+    tid2 = srv.handle("POST", "/ask",
+                      {"study_id": sid})[1]["trials"][0]["tid"]
+    code, r = srv.handle("POST", "/tell", {
+        "study_id": sid,
+        "results": [{"tid": tid, "loss": 0.1}, {"tid": tid2, "loss": 0.2}]})
+    assert code == 200 and r["told"] == 1 and r["duplicates"] == 1
+    assert srv.handle("POST", "/tell", {"study_id": sid,
+                                        "results": ["junk"]})[0] == 400
+    assert srv.handle("POST", "/study",
+                      {"space": {"x": {"dist": "bogus"}}})[0] == 400
+    assert srv.handle("POST", "/study", {"zoo": "not-a-domain"})[0] == 400
+    assert srv.handle("GET", "/nope", {})[0] == 404
+    assert srv.handle("PUT", "/ask", {})[0] == 405
+    srv2 = ServiceHTTPServer(0, scheduler=StudyScheduler(max_studies=0))
+    assert srv2.handle("POST", "/study",
+                       {"space": {"x": {"dist": "uniform",
+                                        "args": [0, 1]}}})[0] == 429
+
+
+def test_handle_zoo_study():
+    srv = ServiceHTTPServer(0)
+    code, r = srv.handle("POST", "/study",
+                         {"zoo": "branin", "n_startup_jobs": 2})
+    assert code == 200
+    code, r = srv.handle("POST", "/ask", {"study_id": r["study_id"]})
+    assert code == 200 and set(r["trials"][0]["params"]) == {"x", "y"}
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_real_http_concurrent_studies():
+    """Real sockets, concurrent clients: wave batching serves everyone,
+    /metrics passes the exposition lint, /studies reflects the drive."""
+    srv = ServiceHTTPServer(0)
+    assert srv.start()
+    url = srv.url
+    try:
+        errors = []
+
+        def drive(tag):
+            try:
+                code, r = _post(url, "/study", {
+                    "space": {"x": {"dist": "uniform", "args": [-5, 5]}},
+                    "seed": tag, "n_startup_jobs": 2})
+                assert code == 200, r
+                sid = r["study_id"]
+                for _ in range(5):
+                    code, a = _post(url, "/ask", {"study_id": sid})
+                    assert code == 200, a
+                    t = a["trials"][0]
+                    code, _r = _post(url, "/tell", {
+                        "study_id": sid, "tid": t["tid"],
+                        "loss": (t["params"]["x"] - 1) ** 2})
+                    assert code == 200, _r
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=drive, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+        with urllib.request.urlopen(url + "/studies", timeout=30) as resp:
+            studies = json.loads(resp.read())
+        assert studies["n_studies"] == 8
+        assert all(s["n_trials"] == 5 for s in studies["studies"])
+
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "hyperopt_tpu_service_asks_total" in text
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "scripts"))
+        from validate_scrape import validate_metrics_text
+
+        assert validate_metrics_text(text) == []
+    finally:
+        srv.stop()
+
+
+def test_server_fail_open_on_taken_port():
+    srv = ServiceHTTPServer(0)
+    assert srv.start()
+    try:
+        port = int(srv.url.rsplit(":", 1)[1])
+        srv2 = ServiceHTTPServer(port)
+        assert srv2.start() is False  # warns, never raises
+    finally:
+        srv.stop()
+
+
+def test_env_knob_parsing():
+    from hyperopt_tpu._env import (parse_service, parse_service_idle_sec,
+                                   parse_service_max_pending,
+                                   parse_service_max_studies)
+
+    assert parse_service({}) is None
+    assert parse_service({"HYPEROPT_TPU_SERVICE": "0"}) is None
+    assert parse_service({"HYPEROPT_TPU_SERVICE": "9200"}) == 9200
+    assert parse_service(
+        {"HYPEROPT_TPU_SERVICE": "0.0.0.0:9200"}) == "0.0.0.0:9200"
+    assert parse_service({"HYPEROPT_TPU_SERVICE": "soon"}) is None
+    assert parse_service_max_studies({}) == 4096
+    assert parse_service_max_studies(
+        {"HYPEROPT_TPU_SERVICE_MAX_STUDIES": "7"}) == 7
+    assert parse_service_max_pending({}) == 64
+    assert parse_service_idle_sec(
+        {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "30"}) == 30.0
+    assert parse_service_idle_sec(
+        {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "0.5"}) == 0.5  # fractions, CLI-like
+    assert parse_service_idle_sec(
+        {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "0"}) == float("inf")  # disabled
+    assert parse_service_idle_sec(
+        {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "soon"}) == 600.0  # warn+default
