@@ -1216,6 +1216,142 @@ def bench_multi_study(n_studies=1024, waves=4, seq_studies=128, seed=0):
     }
 
 
+def bench_service_resume(n_studies=48, waves=5, queue=8, seed=0):
+    """ISSUE 10 stage: the durable serving plane's two headline costs.
+
+    (1) ``resume_latency_sec`` — SIGKILL-equivalent restart: a scheduler
+    with a store + WAL drives ``n_studies`` through startup + ``waves``
+    TPE waves and leaves one ask pending (asked, untold) per study, then
+    a FRESH scheduler on the same root replays the journal.  The figure
+    is the full construction-to-serving wall time: JSONL replay, the
+    per-study store rescan, seed-stream realignment and the tid-counter
+    reclamation pass.  (Served asks are already durable in the store, so
+    nothing regenerates here — regeneration covers asks that died
+    mid-wave, which only the SERVICE_CHAOS_GATE's real SIGKILL can
+    produce.)
+
+    (2) ``shed_rate_frac`` — offered load at 2x ask capacity: ``2 *
+    queue`` client threads hammer the REAL ``server.handle`` path (pure,
+    no sockets) against an ``AdmissionGuard(max_queue=queue)``,
+    re-offering immediately on 429; the figure is the shed fraction of
+    offered ATTEMPTS (hot-retry weighted, so it sits near 1 under
+    saturation).  Its regression mode is a COLLAPSE toward 0 — admission
+    no longer bounding the queue — which the higher-is-better gate
+    direction catches.  Zero tells may be lost either way (asserted,
+    not just measured).
+    """
+    import tempfile
+    import threading as _th
+
+    import numpy as _np
+
+    from hyperopt_tpu import zoo as zoo_mod
+    from hyperopt_tpu.service import AdmissionGuard, StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    def cheap_loss(params):
+        return float(_np.sin(sum(float(v) for v in params.values())))
+
+    out = {}
+    mix = zoo_mod.make_study_mix(n_studies, seed0=seed)
+    with tempfile.TemporaryDirectory() as root:
+        sched = StudyScheduler(max_studies=max(n_studies, 4096),
+                               store_root=root)
+        sids = [sched.create_study(
+            m.domain.space, seed=m.seed, n_startup_jobs=m.n_startup_jobs,
+            space_spec={"zoo": m.domain.name})
+            for m in mix]
+        for _ in range(mix[0].n_startup_jobs + 1):
+            answers = sched.ask_many([(sid, 1) for sid in sids])
+            for sid in sids:
+                for a in answers[sid]:
+                    sched.tell(sid, a["tid"], cheap_loss(a["params"]))
+        for _ in range(waves):
+            answers = sched.ask_many([(sid, 1) for sid in sids])
+            for sid in sids:
+                for a in answers[sid]:
+                    sched.tell(sid, a["tid"], cheap_loss(a["params"]))
+        # leave one ask pending per study: the resume regenerates it
+        sched.ask_many([(sid, 1) for sid in sids])
+        del sched  # the crash (no drain, no compaction)
+
+        t0 = time.perf_counter()
+        resumed = StudyScheduler(max_studies=max(n_studies, 4096),
+                                 store_root=root)
+        resume_sec = time.perf_counter() - t0
+        stats = resumed.last_resume or {}
+        out["resume_latency_sec"] = resume_sec
+        out["resume_replay_sec"] = stats.get("replay_sec")
+        out["resume_studies"] = stats.get("studies")
+        out["resume_asks"] = stats.get("asks")
+        out["resume_regenerated"] = stats.get("regenerated")
+        out["resume_errors"] = stats.get("errors")
+
+    # -- shed rate at 2x capacity over the real handler path ---------------
+    sched = StudyScheduler(max_studies=4096, wal=False, wave_window=0.002)
+    guard = AdmissionGuard(max_queue=queue, metrics=sched.metrics)
+    server = ServiceHTTPServer(0, scheduler=sched, guard=guard)
+    n_clients = 2 * queue
+    per_client = 6
+    spec = {"x": {"dist": "uniform", "args": [-5, 5]}}
+    csids = [server.handle("POST", "/study", {
+        "space": spec, "seed": 9000 + i, "n_startup_jobs": 2})[1]
+        ["study_id"] for i in range(n_clients)]
+    offered = [0]
+    shed = [0]
+    lost_tells = [0]
+    client_errors = []
+    lock = _th.Lock()
+
+    def client(i):
+        # any failure must surface after join() — a dead worker thread
+        # would otherwise leave plausible-but-corrupt shed figures to
+        # feed the trajectory gate
+        try:
+            sid = csids[i]
+            done = 0
+            while done < per_client:
+                with lock:
+                    offered[0] += 1
+                code, payload = server.handle("POST", "/ask",
+                                              {"study_id": sid})
+                if code == 429:
+                    with lock:
+                        shed[0] += 1
+                    time.sleep(0.002)
+                    continue
+                assert code == 200, payload
+                t = payload["trials"][0]
+                code, told = server.handle("POST", "/tell", {
+                    "study_id": sid, "tid": t["tid"],
+                    "loss": cheap_loss(t["params"])})
+                if code != 200:
+                    with lock:
+                        lost_tells[0] += 1
+                done += 1
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                client_errors.append(f"client {i}: "
+                                     f"{type(e).__name__}: {e}")
+
+    threads = [_th.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if client_errors:
+        raise RuntimeError("service_resume shed clients failed: "
+                           + "; ".join(client_errors[:5]))
+    out["shed_rate_frac"] = shed[0] / max(1, offered[0])
+    out["shed_offered"] = offered[0]
+    out["shed_429"] = shed[0]
+    out["lost_tells"] = lost_tells[0]
+    out["served_asks"] = offered[0] - shed[0]
+    assert lost_tells[0] == 0, "tells must never shed below 4x bound"
+    return out
+
+
 def bench_pallas_ei(n=8192, reps=5, seed=0):
     """jnp-vs-pallas crossover for the fused two-model EI score
     (``pallas_ei.ei_diff``) by COMPONENT COUNT — the axis the MEASURED
@@ -1315,6 +1451,10 @@ _JAX_STAGES = (
     # ISSUE 9 headline: 1k concurrent studies batched onto cohort ticks —
     # studies/sec, per-ask p99, slot utilization, vs the sequential loop
     ("multi_study", bench_multi_study),
+    # ISSUE 10: durable serving plane — crash-restart availability gap
+    # (WAL replay + in-flight regeneration) and the shed rate at 2x ask
+    # capacity through the real handler path
+    ("service_resume", bench_service_resume),
 )
 
 _PROBE_SNIPPET = (
@@ -1537,6 +1677,15 @@ def main():
             k: rec["result"].get(k)
             for k in ("n_studies", "studies_per_sec", "study_ask_p99_ms",
                       "slot_utilization_frac", "vs_sequential_x")}
+    # the durable-serving stage (ISSUE 10) rides along: crash-restart
+    # availability gap + overload shed rate at 2x ask capacity
+    rec = stages.get("service_resume")
+    if rec and rec.get("ok"):
+        obs_summary["service_resume"] = {
+            k: rec["result"].get(k)
+            for k in ("resume_latency_sec", "resume_studies",
+                      "resume_regenerated", "shed_rate_frac",
+                      "lost_tells")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact
@@ -1590,6 +1739,10 @@ def main():
                                            "study_ask_p99_ms"),
             "slot_utilization_frac": _stage_val("multi_study",
                                                 "slot_utilization_frac"),
+            "resume_latency_sec": _stage_val("service_resume",
+                                             "resume_latency_sec"),
+            "shed_rate_frac": _stage_val("service_resume",
+                                         "shed_rate_frac"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

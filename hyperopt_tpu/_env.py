@@ -29,6 +29,10 @@ __all__ = [
     "parse_service_max_studies",
     "parse_service_max_pending",
     "parse_service_idle_sec",
+    "parse_service_wal",
+    "parse_service_deadline_ms",
+    "parse_service_queue",
+    "parse_service_degrade",
 ]
 
 logger = logging.getLogger(__name__)
@@ -280,6 +284,98 @@ def parse_service_idle_sec(env=None):
                    "a non-negative duration")
         return 600.0
     return sec
+
+
+# -- durable/overload-safe serving knobs (ISSUE 10) -------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# service it would have hardened.
+
+
+def parse_service_wal(env=None):
+    """``HYPEROPT_TPU_SERVICE_WAL`` → the write-ahead-journal arming mode
+    for the ask/tell service:
+
+    * unset / ``1`` / ``on`` → ``"auto"`` — journal under the store root
+      when the scheduler has one (``<store>/service.wal.jsonl``), off
+      otherwise (an in-memory scheduler has nowhere durable to resume
+      from anyway);
+    * ``0`` / ``off`` → ``None`` — never journal, even with a store;
+    * anything else → an explicit journal PATH (arms the WAL with or
+      without a store; without one, replay regenerates every ask from
+      the journal alone).
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_WAL", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        return "auto"
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    return raw
+
+
+DEFAULT_SERVICE_DEADLINE_MS = 30000.0
+
+
+def parse_service_deadline_ms(env=None):
+    """``HYPEROPT_TPU_SERVICE_DEADLINE_MS`` → the server-side default
+    request deadline in milliseconds (a request may tighten it with an
+    ``X-Deadline-Ms`` header, never loosen it past this).  ``0``/``off``
+    disables the default deadline; default 30000."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_DEADLINE_MS", "").strip()
+    if not raw:
+        return DEFAULT_SERVICE_DEADLINE_MS
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_SERVICE_DEADLINE_MS", raw,
+                   "a deadline in milliseconds (or 0/off)")
+        return DEFAULT_SERVICE_DEADLINE_MS
+    if not ms > 0:
+        _warn_once("HYPEROPT_TPU_SERVICE_DEADLINE_MS", raw,
+                   "a positive deadline")
+        return DEFAULT_SERVICE_DEADLINE_MS
+    return ms
+
+
+def parse_service_queue(env=None):
+    """``HYPEROPT_TPU_SERVICE_QUEUE`` → the bounded admission queue: how
+    many asks may be admitted (queued or in a wave) before new asks shed
+    with 429 + ``Retry-After`` (default 256).  Tells shed only past 4x
+    this bound — they are cheap and preserve state, so the breaker sheds
+    the expensive path first."""
+    return _parse_pos_int("HYPEROPT_TPU_SERVICE_QUEUE", 256, env)
+
+
+DEFAULT_DEGRADE_RECOVER_WAVES = 8
+
+
+def parse_service_degrade(env=None):
+    """``HYPEROPT_TPU_SERVICE_DEGRADE`` → the device-fault degrade
+    ladder: ``None`` when disabled (``0``/``off`` — a tick fault then
+    fails the asks it served, the pre-ladder behavior), else the number
+    of CLEAN waves after which the ladder probes one level back up
+    (unset/``on`` → default 8; any positive integer — including ``1``
+    — picks the recovery patience directly)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_DEGRADE", "").strip()
+    if raw.lower() in ("", "on", "true", "yes", "auto"):
+        return DEFAULT_DEGRADE_RECOVER_WAVES
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_SERVICE_DEGRADE", raw,
+                   "a clean-wave count (or 0/off)")
+        return DEFAULT_DEGRADE_RECOVER_WAVES
+    if n < 1:
+        _warn_once("HYPEROPT_TPU_SERVICE_DEGRADE", raw,
+                   "a positive clean-wave count")
+        return DEFAULT_DEGRADE_RECOVER_WAVES
+    return n
 
 
 _CACHE_CONFIGURED = False

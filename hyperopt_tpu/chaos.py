@@ -26,6 +26,14 @@ Sites are plain strings named by the instrumented call sites:
 ``publish``     before a fleet shard-result publish (parallel/fleet.py)
 ``trial``       before each objective evaluation (worker.py / fleet eval)
 ``io``          inside ``filestore._atomic_write`` (``ioerr`` rules only)
+``admit``       service study admission (service/scheduler.create_study)
+``ask``         service ask ingress (service/scheduler.ask)
+``tell``        service tell ingress (service/scheduler.tell)
+``wal``         service journal append/compact (``ioerr`` raises as a
+                JournalError — the failed request errors, state holds)
+``tick``        before each cohort-tick device dispatch (``ioerr`` here is
+                the OOM-shaped fault the degrade ladder absorbs; ``kill``
+                is the mid-wave crash the WAL resume gate exercises)
 ==============  ============================================================
 
 Determinism: every probabilistic rule owns a ``random.Random`` seeded from

@@ -248,6 +248,30 @@ class FileStore:
                 fcntl.flock(f, fcntl.LOCK_UN)
         return list(range(start, start + n))
 
+    def reset_counter(self, value):
+        """Clamp the tid allocator DOWN to ``value`` (no-op if it is
+        already at or below).  WAL resume uses this to reclaim ids an
+        ask consumed before dying un-journaled mid-wave: the TPE kernel
+        keys per-trial PRNG streams off the id VALUE, so a counter gap
+        would make every post-restart proposal diverge from the
+        uninterrupted run the crash-resume pin compares against.  Only
+        safe when the caller owns the store exclusively (the service
+        scheduler does; worker fleets never call this)."""
+        path = os.path.join(self.root, "counter")
+        value = int(value)
+        with open(path, "r+") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                cur = int(f.read().strip() or "0")
+                if value < cur:
+                    f.seek(0)
+                    f.truncate()
+                    f.write(str(value))
+                    f.flush()
+                    os.fsync(f.fileno())
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+
     # -- attachments ------------------------------------------------------
 
     def set_attachment(self, name, blob: bytes):

@@ -358,6 +358,79 @@ def _resilience_section(metrics, out):
                    "checkpoint-and-shrink path taken")
 
 
+def _service_section(metrics, out):
+    """Serving-plane health (ISSUE 10): traffic, shed/backpressure,
+    degrade-ladder state, WAL durability and HTTP error classes —
+    rendered only when the stream recorded ``service.*`` metrics (a
+    non-serving run keeps its report unchanged)."""
+    svc = {k: v for k, v in metrics.items() if k.startswith("service.")}
+    if not svc:
+        return
+    out.append("")
+    out.append("== service health " + "=" * 46)
+    asks = int(svc.get("service.asks", 0))
+    tells = int(svc.get("service.tells", 0))
+    ticks = int(svc.get("service.ticks", 0))
+    if asks or tells:
+        wave = svc.get("service.wave_sec") or {}
+        line = (f"  traffic  asks {asks}  tells {tells}  ticks {ticks}"
+                f"  studies {int(svc.get('service.studies_created', 0))}")
+        if wave.get("count"):
+            line += (f"  wave p50 {_fmt_sec(wave.get('p50', 0))}"
+                     f"  p99 {_fmt_sec(wave.get('p99', 0))}")
+        out.append(line)
+    shed_ask = int(svc.get("service.shed.ask", 0))
+    shed_tell = int(svc.get("service.shed.tell", 0))
+    shed_ddl = int(svc.get("service.shed.deadline", 0))
+    if shed_ask or shed_tell:
+        frac = shed_ask / max(1, shed_ask + asks)
+        out.append(f"  shed     asks {shed_ask} ({100 * frac:.1f}% of "
+                   f"offered)  tells {shed_tell}"
+                   f"  deadline-unservable {shed_ddl}")
+    level = svc.get("service.degraded")
+    downs = int(svc.get("service.degrade.down", 0))
+    if level or downs:
+        out.append(
+            f"  degrade  level {int(level or 0)}"
+            f"  faults {int(svc.get('service.degrade.faults', 0))}"
+            f"  down x{downs}"
+            f"  up x{int(svc.get('service.degrade.up', 0))}"
+            f"  rand-served asks "
+            f"{int(svc.get('service.degraded_asks', 0))}")
+        if level:
+            out.append("  DEGRADED: serving below full quality — see "
+                       "service.degrade.* transitions")
+    wal_keys = [k for k in svc if k.startswith("service.wal.")]
+    if wal_keys:
+        out.append(
+            f"  wal      replayed studies "
+            f"{int(svc.get('service.wal.replay_studies', 0))}"
+            f"  asks {int(svc.get('service.wal.replay_asks', 0))}"
+            f" ({int(svc.get('service.wal.replay_regenerated', 0))} "
+            f"regenerated)"
+            f"  dup tells "
+            f"{int(svc.get('service.wal.replay_duplicate_tells', 0))}"
+            f"  compactions "
+            f"{int(svc.get('service.wal.compactions', 0))}")
+        sync_errs = int(svc.get("service.wal.sync_errors", 0))
+        if sync_errs or svc.get("service.wal.replay_errors"):
+            out.append(
+                f"  WAL TROUBLE: sync errors {sync_errs}  replay errors "
+                f"{int(svc.get('service.wal.replay_errors', 0))}")
+    http = {}
+    for k, v in svc.items():
+        if k.startswith("service.http."):
+            _, _, rest = k.partition("service.http.")
+            ep, _, cls = rest.rpartition(".")
+            http.setdefault(cls, {})[ep] = int(v)
+    for cls in sorted(http):
+        if cls in ("4xx", "5xx") or cls == "2xx":
+            total = sum(http[cls].values())
+            detail = "  ".join(f"{ep} {n}" for ep, n
+                               in sorted(http[cls].items()))
+            out.append(f"  http     {cls} x{total}  ({detail})")
+
+
 def _devmem_section(devmem_recs, out):
     """HBM watermark over the run's devmem samples (obs/devmem.py) + the
     last live-array census, so "how much memory did it hold" is answerable
@@ -682,6 +755,7 @@ def render(records, top=5):
     _phase_section(spans, out)
     _pipeline_section(spans, _last_snapshot_metrics(records), out)
     _resilience_section(_last_snapshot_metrics(records), out)
+    _service_section(_last_snapshot_metrics(records), out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)
     out.append("")

@@ -116,6 +116,20 @@ KEY_DIRECTIONS = {
     # deterministic for a fixed mix (pow2 slot padding is the only
     # slack), so a drop means the packer started stranding slots
     "slot_utilization_frac": {"direction": "higher", "threshold": 0.15},
+    # crash-restart availability gap (bench.py service_resume stage):
+    # fresh-scheduler construction on a crashed store root — WAL replay
+    # + store rescan + regenerating one in-flight ask per study.
+    # Dominated by per-cohort XLA compiles on the regeneration waves;
+    # the loose bar catches replay going accidentally quadratic, not
+    # compile-time noise
+    "resume_latency_sec": {"direction": "lower", "threshold": 1.00},
+    # shed fraction of offered asks at 2x sustained capacity through
+    # the real handler path: healthy backpressure sits near the excess
+    # fraction (~0.5); a collapse toward zero means the bounded
+    # admission queue stopped bounding (the overload pin's regression
+    # mode — latency explodes instead of clients being told to back
+    # off).  Direction "higher" so the gate fires on that collapse.
+    "shed_rate_frac": {"direction": "higher", "threshold": 0.60},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -127,7 +141,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "peak_hbm_bytes", "history_bytes",
                 "profiler_overhead_frac", "recovery_latency_sec",
                 "studies_per_sec", "study_ask_p99_ms",
-                "slot_utilization_frac")
+                "slot_utilization_frac",
+                "resume_latency_sec", "shed_rate_frac")
 
 
 def trajectory_path(root=None):

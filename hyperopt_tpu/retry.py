@@ -57,6 +57,15 @@ class RetryPolicy:
         rng = random.Random(f"{key}:{attempt}")
         return d * (1.0 - self.jitter * rng.random())
 
+    def delay_after(self, attempt, key=0, floor=0.0):
+        """Backoff before retry ``attempt`` honoring a server hint:
+        the jittered exponential delay, raised to ``floor`` when the
+        server's ``Retry-After`` asks the client to stay away longer
+        (the ask/tell service computes it from live wave latency —
+        overriding it downward would re-create the stampede the hint
+        exists to spread)."""
+        return max(float(floor), self.delay(attempt, key=key))
+
     def retries_left(self, attempts):
         """True while a trial that has already made ``attempts`` attempts
         may run again (``attempts`` counts the first try)."""

@@ -10,8 +10,14 @@ fused tell+ask device program per ask wave, and
 into.
 """
 
-from .scheduler import StudyScheduler, StudyQuotaError, UnknownStudyError
+from .client import ServiceClient
+from .journal import StudyJournal
+from .overload import AdmissionGuard, Deadline, DegradeLadder, OverloadError
+from .scheduler import (DrainingError, StudyQuotaError, StudyScheduler,
+                        UnknownStudyError)
 from .spacespec import space_from_spec
 
 __all__ = ["StudyScheduler", "StudyQuotaError", "UnknownStudyError",
+           "DrainingError", "StudyJournal", "AdmissionGuard", "Deadline",
+           "DegradeLadder", "OverloadError", "ServiceClient",
            "space_from_spec"]

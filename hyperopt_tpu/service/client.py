@@ -1,0 +1,178 @@
+"""Retry-aware HTTP client for the ask/tell service.
+
+The smoke scripts and tests used to drive the server with ad-hoc
+``urllib`` calls and bare ``time.sleep`` loops; every harness
+re-invented (differently) what to do about a 429, a draining 503 or a
+connection reset.  This helper wires :class:`~hyperopt_tpu.retry.RetryPolicy`
+into one place:
+
+* **Retryable**: 429 and 503 responses (honoring the server's
+  ``Retry-After`` as a FLOOR under the policy's jittered exponential
+  backoff — ``RetryPolicy.delay_after``), connection-level failures
+  (refused / reset / timeout — the crash-restart window the WAL resume
+  gate drives traffic through).
+* **Not retryable**: every other status.  A 409 on ``tell`` deserves a
+  special note: it means "already told" — for a client retrying a tell
+  whose RESPONSE was lost, that is success, and :meth:`tell` reports it
+  as such (``duplicate=True``) instead of raising.
+* **Deterministic**: backoff jitter comes from the policy's
+  ``(key, attempt)`` scheme — two clients hammering a shed server
+  spread out, and tests replay exact schedules with an injected
+  ``sleep``.
+
+``ServiceClient`` is deliberately tiny — a serving-protocol helper for
+harnesses, not an SDK.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from ..retry import RetryPolicy
+
+__all__ = ["ServiceClient", "ServiceUnavailable"]
+
+
+class ServiceUnavailable(RuntimeError):
+    """Retries exhausted against a shedding/unreachable server; carries
+    the last status code (or None for connection-level failures)."""
+
+    def __init__(self, message, status=None):
+        super().__init__(message)
+        self.status = status
+
+
+#: connection-level failures worth retrying: the server restarting
+#: (refused), dying mid-response (reset/aborted), or wedged (timeout)
+_CONN_ERRORS = (ConnectionError, TimeoutError, urllib.error.URLError,
+                OSError)
+
+
+class ServiceClient:
+    """One service endpoint + one retry policy.  ``retry`` coerces like
+    every other retry knob in the repo (None/int/policy); the default
+    absorbs a server restart (5 retries, 0.2s base ≈ 6s worst case)."""
+
+    def __init__(self, url, retry=None, timeout=60.0, deadline_ms=None,
+                 sleep=time.sleep, key=0):
+        self.url = str(url).rstrip("/")
+        self.retry = (RetryPolicy(max_retries=5, base_delay=0.2,
+                                  max_delay=5.0)
+                      if retry is None else RetryPolicy.coerce(retry))
+        self.timeout = float(timeout)
+        self.deadline_ms = deadline_ms
+        self._sleep = sleep
+        self._key = key
+        self.retries = 0  # total backoffs taken (harness assertions)
+
+    # -- transport ---------------------------------------------------------
+
+    def _once(self, method, path, body):
+        """One HTTP exchange → ``(status, payload, retry_after)``."""
+        headers = {"Content-Type": "application/json"}
+        if self.deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(self.deadline_ms)
+        data = (json.dumps(body).encode()
+                if method == "POST" else None)
+        req = urllib.request.Request(self.url + path, data=data,
+                                     headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, json.loads(r.read()), None
+        except urllib.error.HTTPError as e:
+            retry_after = e.headers.get("Retry-After")
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {"ok": False, "error": f"HTTP {e.code}"}
+            return e.code, payload, retry_after
+
+    def request(self, method, path, body=None, retryable=(429, 503)):
+        """One logical request with retry/backoff.  Returns
+        ``(status, payload)`` for any non-retryable answer; raises
+        :class:`ServiceUnavailable` when retries run out."""
+        last_status, last_err = None, None
+        attempt = 0
+        while True:
+            try:
+                status, payload, retry_after = self._once(
+                    method, path, body or {})
+            except _CONN_ERRORS as e:
+                status, payload, retry_after = None, None, None
+                last_err = e
+            if status is not None and status not in retryable:
+                return status, payload
+            last_status = status
+            if not self.retry.retries_left(attempt + 1):
+                raise ServiceUnavailable(
+                    f"{method} {path}: retries exhausted "
+                    f"(last status {last_status}, last error {last_err})",
+                    status=last_status)
+            # the JSON payload carries the precise hint; the header is
+            # RFC delta-seconds (integer, rounded up) — prefer precise
+            if isinstance(payload, dict) \
+                    and payload.get("retry_after") is not None:
+                retry_after = payload["retry_after"]
+            floor = 0.0
+            if retry_after is not None:
+                try:
+                    floor = float(retry_after)
+                except (TypeError, ValueError):
+                    pass
+            self._sleep(self.retry.delay_after(
+                attempt, key=f"{self._key}:{path}", floor=floor))
+            self.retries += 1
+            attempt += 1
+
+    # -- protocol helpers --------------------------------------------------
+
+    def create_study(self, space=None, zoo=None, **kwargs):
+        body = dict(kwargs)
+        if space is not None:
+            body["space"] = space
+        if zoo is not None:
+            body["zoo"] = zoo
+        status, payload = self.request("POST", "/study", body)
+        if status != 200:
+            raise ServiceUnavailable(
+                f"/study failed: {payload.get('error')}", status=status)
+        return payload["study_id"]
+
+    def ask(self, study_id, n=1):
+        """Returns the response payload's ``trials`` list (each entry
+        carries ``degraded``/``algo`` flags when the ladder served it)."""
+        status, payload = self.request(
+            "POST", "/ask", {"study_id": study_id, "n": n})
+        if status != 200:
+            raise ServiceUnavailable(
+                f"/ask failed: {payload.get('error')}", status=status)
+        return payload["trials"]
+
+    def tell(self, study_id, tid, loss=None, status=None):
+        """Returns ``{"duplicate": bool}`` — a 409 from a RETRIED tell
+        means the first attempt landed and its response was lost, which
+        is success, not an error."""
+        code, payload = self.request(
+            "POST", "/tell",
+            {"study_id": study_id, "tid": tid, "loss": loss,
+             "status": status})
+        if code == 409:
+            return {"duplicate": True}
+        if code != 200:
+            raise ServiceUnavailable(
+                f"/tell failed: {payload.get('error')}", status=code)
+        return {"duplicate": False}
+
+    def close_study(self, study_id):
+        status, payload = self.request("POST", "/close",
+                                       {"study_id": study_id})
+        return status == 200
+
+    def studies(self):
+        status, payload = self.request("GET", "/studies")
+        if status != 200:
+            raise ServiceUnavailable("/studies failed", status=status)
+        return payload

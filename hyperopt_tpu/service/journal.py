@@ -1,0 +1,231 @@
+"""Write-ahead journal for the ask/tell service (the durable half of
+ISSUE 10).
+
+The scheduler's in-memory state — which studies exist, where each study's
+seed stream is, which asks were issued — dies with the process; even with
+``--store`` (per-study :class:`~hyperopt_tpu.filestore.FileTrials`) a
+restart forgets every live study.  The journal closes that gap with the
+cheapest durable structure that works on the filesystems TPU pods
+actually mount (NFS / GCS-fuse): an append-only JSONL file under the
+store root, read back through the torn-line-tolerant
+:func:`~hyperopt_tpu.obs.trace.iter_jsonl` (a half-written final line —
+the normal crash artifact — is skipped, never fatal).
+
+Record kinds (one JSON object per line; every record carries ``kind``
+and ``sid``)::
+
+    admit     {spec, seed, kwargs}            study admitted (spec is the
+                                              JSON-wire space schema, or
+                                              {"zoo": name})
+    ask       {tids, seed, algo}              an ask was SERVED: the ids it
+                                              issued, the suggest seed it
+                                              drew, and the algo that
+                                              produced the docs ("tpe",
+                                              "rand" for startup/degraded)
+    tell      {tid, loss, status}             one result reported
+    close     {}                              study closed by the client
+    snapshot  {spec, seed, kwargs, rstate,    compaction record: the
+               n_asked, n_told, state}        study's registry entry + RNG
+                                              position; its trials live in
+                                              the FileStore
+
+Ordering and idempotency (the replay argument, DESIGN.md §17): records
+append in the order the scheduler applied them, and studies are
+independent — a study's proposals depend only on its own ask/tell
+history.  Replay therefore walks the journal once, per record:
+
+* ``admit``/``snapshot`` re-create the study (bypassing the admission
+  quota — resumed studies are grandfathered; the quota is admission
+  control for NEW work, not an excuse to drop journaled state);
+* ``ask`` advances the study's seed stream by exactly one draw and
+  re-lands any doc the store does not already hold, regenerated through
+  the SAME code path that served it (the PR-9 determinism pins make the
+  regenerated docs bit-identical — the exactly-once argument the fleet
+  uses for duplicate shard publishes);
+* ``tell`` applies only if the trial is not already DONE — a duplicate
+  (journaled AND settled into the store before the crash) is skipped,
+  never double-applied.
+
+fsync is batched per wave: ask records flush+fsync once at the end of
+the wave that served them (before any asker unblocks), tell records
+before the tell returns.  Compaction (:meth:`StudyJournal.rewrite`)
+replaces the file atomically (tmp + ``os.replace``) with one
+``snapshot`` record per live study; it runs only when the scheduler has
+a store (without one the ask records ARE the trial data) and only at
+quiescent points (no wave in flight — a snapshot taken after a pending
+ask's seed draw but before its ask record would replay that draw twice).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from .. import chaos
+from ..obs.trace import iter_jsonl
+
+__all__ = ["StudyJournal", "JournalError", "wal_path_for"]
+
+logger = logging.getLogger(__name__)
+
+#: journal file name under a store root (``wal_path_for``)
+WAL_BASENAME = "service.wal.jsonl"
+
+
+class JournalError(OSError):
+    """The journal could not be written.  Raised back through the serving
+    path so the failed request errors (client retries) instead of the
+    scheduler advancing past state the journal never captured."""
+
+
+def wal_path_for(store_root):
+    """The default journal location for a scheduler persisting into
+    ``store_root`` (the WAL shares the store's durability story)."""
+    return os.path.join(str(store_root), WAL_BASENAME)
+
+
+class StudyJournal:
+    """Append-side + replay-side of the WAL.  Not thread-safe by itself —
+    the scheduler already serializes every mutation under its lock, and
+    the journal is only touched there."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = None
+        self._dirty = False
+        self.appends = 0
+        self.syncs = 0
+        self.compactions = 0
+
+    # -- append side -------------------------------------------------------
+
+    def _handle(self):
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def append(self, rec):
+        """One record onto the journal (buffered — call :meth:`sync` at
+        the durability point).  Any OSError surfaces as
+        :class:`JournalError` so the serving path fails THIS request
+        instead of silently losing the record."""
+        chaos.io_point("wal")
+        try:
+            fh = self._handle()
+            fh.write(json.dumps(rec, sort_keys=True,
+                                separators=(",", ":")) + "\n")
+        except OSError as e:
+            self._drop_handle()
+            raise JournalError(f"journal append failed: {e}") from e
+        self._dirty = True
+        self.appends += 1
+
+    def sync(self):
+        """Flush + fsync everything appended since the last sync (the
+        batched per-wave durability point)."""
+        if not self._dirty or self._fh is None:
+            return
+        try:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except OSError as e:
+            self._drop_handle()
+            raise JournalError(f"journal fsync failed: {e}") from e
+        self._dirty = False
+        self.syncs += 1
+
+    def _drop_handle(self):
+        fh, self._fh = self._fh, None
+        self._dirty = False
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    def close(self):
+        try:
+            self.sync()
+        finally:
+            self._drop_handle()
+
+    # -- replay / compaction side -----------------------------------------
+
+    def records(self):
+        """Every parseable record, in append order.  Torn lines (the
+        crash artifact batched fsync allows at the tail) are skipped by
+        ``iter_jsonl`` — a WAL is readable after ANY crash."""
+        if not os.path.exists(self.path):
+            return
+        yield from iter_jsonl(self.path)
+
+    def size_bytes(self):
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def rewrite(self, records):
+        """Atomically replace the journal with ``records`` (compaction).
+        The append handle reopens on the next :meth:`append`, so a
+        concurrent-append-after-compact lands in the NEW file."""
+        chaos.io_point("wal")
+        self._drop_handle()
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in records:
+                    f.write(json.dumps(rec, sort_keys=True,
+                                       separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise JournalError(f"journal compaction failed: {e}") from e
+        self.compactions += 1
+
+    # -- record constructors (one place owns the schema) -------------------
+
+    @staticmethod
+    def admit_rec(study_id, spec, seed, kwargs):
+        return {"kind": "admit", "sid": study_id, "spec": spec,
+                "seed": int(seed), "kwargs": dict(kwargs), "ts": time.time()}
+
+    @staticmethod
+    def ask_rec(study_id, tids, seed, algo):
+        return {"kind": "ask", "sid": study_id,
+                "tids": [int(t) for t in tids], "seed": int(seed),
+                "algo": str(algo)}
+
+    @staticmethod
+    def tell_rec(study_id, tid, loss, status):
+        return {"kind": "tell", "sid": study_id, "tid": int(tid),
+                "loss": None if loss is None else float(loss),
+                "status": status}
+
+    @staticmethod
+    def close_rec(study_id):
+        return {"kind": "close", "sid": study_id}
+
+    @staticmethod
+    def snapshot_rec(study):
+        """Compaction record for one study: registry entry + exact RNG
+        position (``numpy`` Generator state is a JSON-clean dict of
+        bigints) so replay resumes the seed stream mid-flight."""
+        return {
+            "kind": "snapshot", "sid": study.study_id,
+            "spec": study.space_spec, "seed": study.seed,
+            "kwargs": study.admit_kwargs,
+            "rstate": study.rstate.bit_generator.state,
+            "n_asked": study.n_asked, "n_told": study.n_told,
+            "state": study.state, "ts": time.time(),
+        }

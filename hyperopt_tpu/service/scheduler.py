@@ -30,10 +30,22 @@ capacity bucket, and ask widths pad to a power of two — so the compiled
 program LRU (``tpe._cohort_jit_cache``, surfaced as the
 ``suggest.cohort_cache`` metrics) sees a handful of shapes, not one per
 wave.
+
+Durability & device-fault tolerance (ISSUE 10): when a write-ahead
+journal is armed (``service/journal.py`` — automatic with a store root),
+every admit/ask/tell appends a WAL record before the scheduler's state
+advances, and :meth:`StudyScheduler.resume` replays the journal on
+construction so a restarted service re-admits every study and proposes
+bit-identically to an uninterrupted run.  Device faults during a cohort
+tick (OOM, compile failure, non-finite proposals, injected chaos) walk
+the :class:`~hyperopt_tpu.service.overload.DegradeLadder` instead of
+failing the wave — down to a per-study ``rand.suggest`` fallback, never
+killing the server — and climb back after clean waves.
 """
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 import time
@@ -43,6 +55,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import chaos
 from ..algos import rand, tpe
 from ..base import (
     JOB_STATE_DONE,
@@ -54,9 +67,12 @@ from ..base import (
     spec_from_misc,
 )
 from ..obs.metrics import get_metrics
+from .journal import JournalError, StudyJournal, wal_path_for
+from .overload import (LADDER_LEVELS, DeadlineExceeded, DegradeLadder,
+                       NonFiniteProposal, is_device_fault)
 
 __all__ = ["StudyScheduler", "Study", "StudyQuotaError",
-           "UnknownStudyError", "DuplicateTellError"]
+           "UnknownStudyError", "DuplicateTellError", "DrainingError"]
 
 
 class UnknownStudyError(KeyError):
@@ -65,6 +81,12 @@ class UnknownStudyError(KeyError):
 
 class StudyQuotaError(RuntimeError):
     """An admission or per-study quota would be exceeded (HTTP 429)."""
+
+
+class DrainingError(RuntimeError):
+    """The service is draining (SIGTERM received): new studies and asks
+    are refused (HTTP 503 + ``Retry-After`` — come back after the
+    restart), tells still land."""
 
 
 class DuplicateTellError(RuntimeError):
@@ -86,12 +108,26 @@ class Study:
     loop, which is what makes the cohort determinism pin possible."""
 
     def __init__(self, study_id, space, seed=0, n_startup_jobs=None,
-                 max_trials=None, trials=None, **tpe_kwargs):
+                 max_trials=None, trials=None, space_spec=None,
+                 **tpe_kwargs):
         self.study_id = study_id
         self.domain = Domain(None, space)
         self.trials = trials if trials is not None else Trials()
         self.rstate = np.random.default_rng(seed)
         self.seed = int(seed)
+        # the WAL registry entry: the JSON-wire space schema (or zoo
+        # wrapper) this study can be rebuilt from, plus the admit kwargs
+        # verbatim.  None spec = not resumable (direct API studies that
+        # never crossed the wire) — journaled anyway so replay can COUNT
+        # what it had to skip.
+        self.space_spec = space_spec
+        self.admit_kwargs = {}
+        if n_startup_jobs is not None:
+            self.admit_kwargs["n_startup_jobs"] = int(n_startup_jobs)
+        if max_trials is not None:
+            self.admit_kwargs["max_trials"] = int(max_trials)
+        self.admit_kwargs.update(
+            {k: v for k, v in tpe_kwargs.items()})
         self.n_startup_jobs = int(n_startup_jobs
                                   if n_startup_jobs is not None
                                   else tpe._default_n_startup_jobs)
@@ -164,16 +200,29 @@ class Study:
 
 
 class _AskReq:
-    """One TPE ask waiting for a cohort tick."""
+    """One TPE ask waiting for a cohort tick.  ``algo`` records what
+    actually served it ("tpe", or "rand" under the degrade ladder) — it
+    rides into the WAL record and the flagged ask response; ``replay``
+    marks a WAL-regeneration req (already journaled — must not journal
+    again); ``deadline`` is the request's monotonic budget."""
 
-    __slots__ = ("study", "new_ids", "seed", "docs", "error")
+    __slots__ = ("study", "new_ids", "seed", "docs", "error", "algo",
+                 "degraded", "replay", "deadline", "journaled")
 
-    def __init__(self, study, new_ids, seed):
+    def __init__(self, study, new_ids, seed, deadline=None, replay=False):
         self.study = study
         self.new_ids = new_ids
         self.seed = seed
         self.docs = None
         self.error = None
+        self.algo = "tpe"
+        self.degraded = False
+        self.replay = replay
+        self.deadline = deadline
+        # True once the served-ask record is in the WAL: a later failure
+        # (doc landing) must NOT also journal a void record — two
+        # records would replay the one seed draw twice
+        self.journaled = False
 
 
 #: smallest cohort slot capacity.  Serving-scale studies are SMALL (tens
@@ -302,7 +351,7 @@ class _Cohort:
             "has_loss": put(has_loss, False),
         }
 
-    def tick(self, demand, donate=True, mesh=None):
+    def tick(self, demand, donate=True, mesh=None, cand_scale=1.0):
         """One batched fused tell+ask DISPATCH for the whole cohort.
 
         ``demand``: ``{slot: (ids_uint32, seed)}`` — at most one ask per
@@ -313,6 +362,11 @@ class _Cohort:
         cohort's host-side doc building overlaps the next cohort's
         device compute (the wave-level analog of PR 4's
         dispatch/readback overlap).
+
+        ``cand_scale < 1`` is the degrade ladder shrinking
+        ``n_EI_candidates`` for this tick (half/quarter the EI batch —
+        the memory- and compute-heavy axis) without touching the
+        cohort's identity; the scaled program gets its own LRU entry.
         """
         self.ticks += 1
         L = len(self.cs.labels)
@@ -363,8 +417,13 @@ class _Cohort:
             if len(slot_ids) < B:  # pad by repeating the last id
                 ids[slot, len(slot_ids):] = slot_ids[-1]
 
+        cfg = self.cfg
+        if cand_scale != 1.0:
+            cfg = dict(cfg)
+            cfg["n_EI_candidates"] = max(
+                1, int(cfg["n_EI_candidates"] * cand_scale))
         run = tpe.build_suggest_batched(
-            self.cs, self.cfg, S, self.cap, B, donate=donate, mesh=mesh)
+            self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh)
         try:
             new_dev, packed = run(self._dev, rows, seed_words, ids)
         except BaseException:
@@ -400,13 +459,33 @@ class StudyScheduler:
     ``store_root`` persists every study through the existing
     ``FileStore`` (one subdirectory per study id); default is in-memory
     :class:`~hyperopt_tpu.base.Trials`.
+
+    ``wal`` arms the write-ahead journal: ``None`` resolves
+    ``HYPEROPT_TPU_SERVICE_WAL`` (auto = journal under ``store_root``
+    when there is one), ``False`` disarms, a path or
+    :class:`~hyperopt_tpu.service.journal.StudyJournal` arms explicitly.
+    An armed journal replays automatically on construction
+    (``auto_resume=False`` defers to an explicit :meth:`resume`).
+
+    ``degrade`` is the device-fault ladder patience (clean waves before
+    a recovery probe): ``None`` resolves ``HYPEROPT_TPU_SERVICE_DEGRADE``
+    (default 8), ``False`` disarms (a tick fault then errors the asks it
+    was serving, the pre-ladder behavior).
+
+    ``overload`` is an optional
+    :class:`~hyperopt_tpu.service.overload.AdmissionGuard`; the
+    scheduler feeds it wave latencies (the ``Retry-After`` EWMA) — the
+    HTTP server owns admission itself.
     """
 
     def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
-                 store_root=None, wave_window=0.0):
-        from .._env import (parse_service_idle_sec,
+                 store_root=None, wave_window=0.0, wal=None, degrade=None,
+                 overload=None, auto_resume=True):
+        from .._env import (parse_service_degrade,
+                            parse_service_idle_sec,
                             parse_service_max_pending,
-                            parse_service_max_studies)
+                            parse_service_max_studies,
+                            parse_service_wal)
 
         self.max_studies = (parse_service_max_studies()
                             if max_studies is None else int(max_studies))
@@ -427,19 +506,61 @@ class StudyScheduler:
         self._cohorts = {}  # (sig, cfg_key, cap) -> _Cohort
         self._wave_reqs = []
         self._tick_running = False
+        self._draining = False
         self.metrics = get_metrics("service")
+        self.overload = overload
+
+        if wal is None:
+            mode = parse_service_wal()
+            if mode == "auto":
+                self.journal = (StudyJournal(wal_path_for(store_root))
+                                if store_root is not None else None)
+            elif mode is None:
+                self.journal = None
+            else:
+                self.journal = StudyJournal(mode)
+        elif wal is False:
+            self.journal = None
+        elif isinstance(wal, StudyJournal):
+            self.journal = wal
+        else:
+            self.journal = StudyJournal(wal)
+
+        if degrade is None:
+            patience = parse_service_degrade()
+        elif degrade is False:
+            patience = None
+        else:
+            patience = int(degrade)
+        self.degrade = (DegradeLadder(patience, metrics=self.metrics)
+                        if patience is not None else None)
+
+        self.last_resume = None  # stats dict of the latest WAL replay
+        if auto_resume and self.journal is not None:
+            self.resume()
 
     # -- study lifecycle ---------------------------------------------------
 
-    def create_study(self, space, seed=0, study_id=None, **kwargs):
+    def create_study(self, space, seed=0, study_id=None, space_spec=None,
+                     _replay=False, **kwargs):
         """Admit a new study; returns its id (``filestore.new_run_id``).
-        Raises :class:`StudyQuotaError` past the ``max_studies`` quota."""
+        Raises :class:`StudyQuotaError` past the ``max_studies`` quota.
+        ``space_spec`` (the JSON-wire schema the space was built from)
+        makes the study WAL-resumable; the HTTP front end always passes
+        it.  Replayed admissions (``_replay``) bypass the quota — the
+        quota is admission control for NEW work, and a restart with a
+        smaller ``HYPEROPT_TPU_SERVICE_MAX_STUDIES`` must not silently
+        drop journaled studies."""
         from ..filestore import FileTrials, new_run_id
 
+        chaos.point("admit", self.metrics)
         with self._lock:
+            if self._draining and not _replay:
+                raise DrainingError("service is draining; not admitting "
+                                    "new studies")
             live = sum(1 for s in self._studies.values()
                        if s.state == "active")
-            if live >= self.max_studies:
+            if live >= self.max_studies and not _replay:
                 raise StudyQuotaError(
                     f"study quota reached ({self.max_studies} live studies)")
             study_id = study_id or new_run_id("study")
@@ -450,7 +571,12 @@ class StudyScheduler:
                 import os
 
                 trials = FileTrials(os.path.join(self.store_root, study_id))
-            st = Study(study_id, space, seed=seed, trials=trials, **kwargs)
+            st = Study(study_id, space, seed=seed, trials=trials,
+                       space_spec=space_spec, **kwargs)
+            if self.journal is not None and not _replay:
+                self.journal.append(StudyJournal.admit_rec(
+                    study_id, space_spec, st.seed, st.admit_kwargs))
+                self.journal.sync()  # admits are rare; durable immediately
             self._studies[study_id] = st
             self.metrics.counter("service.studies_created").inc()
             self.metrics.gauge("service.studies_live").set(live + 1)
@@ -458,15 +584,21 @@ class StudyScheduler:
 
     def close_study(self, study_id):
         """Mark a study done and free its cohort slot (its trials stay
-        queryable; the admission quota counts only active studies)."""
+        queryable; the admission quota counts only active studies).  A
+        settled study triggers WAL compaction — its records are dead
+        weight for every future replay."""
         with self._lock:
             st = self._get(study_id)
             st.state = "closed"
+            if self.journal is not None:
+                self.journal.append(StudyJournal.close_rec(study_id))
+                self.journal.sync()
             self._evict_from_cohort(st)
             self._gc_cohorts()
             self.metrics.gauge("service.studies_live").set(
                 sum(1 for s in self._studies.values()
                     if s.state == "active"))
+            self._maybe_compact()
 
     def _get(self, study_id):
         st = self._studies.get(study_id)
@@ -529,12 +661,15 @@ class StudyScheduler:
 
     # -- ask / tell --------------------------------------------------------
 
-    def _prepare_ask(self, st, n):
+    def _prepare_ask(self, st, n, deadline=None):
         """Draw ids + seed for one ask, exactly as ``FMinIter`` would.
         Returns finished docs (startup random search, served inline) or an
         :class:`_AskReq` awaiting a cohort tick."""
         if st.state != "active":
             raise UnknownStudyError(f"{st.study_id} is {st.state}")
+        if self._draining:
+            raise DrainingError("service is draining; not admitting "
+                                "new asks")
         n = int(n)
         if n < 1:
             raise ValueError("ask n must be >= 1")
@@ -554,26 +689,194 @@ class StudyScheduler:
         st.n_asked += n
         self.metrics.counter("service.asks").inc()
         if len(st.trials.trials) < st.n_startup_jobs:
-            docs = rand.suggest(new_ids, st.domain, st.trials, seed)
-            self._land(st, docs)
+            journaled = False
+            try:
+                docs = rand.suggest(new_ids, st.domain, st.trials, seed)
+                self._journal_ask(st, new_ids, seed, "rand")
+                journaled = True
+                self._land(st, docs)
+                if self.journal is not None:
+                    self.journal.sync()
+            except Exception:
+                st.n_asked -= n  # release the reserved pending quota
+                if not journaled:
+                    # the draw is burned either way; keep replay's seed
+                    # stream aligned (a journaled-but-unlanded record
+                    # already accounts for the draw — never void twice)
+                    self._journal_void_ask(st, new_ids, seed)
+                raise
             return docs
-        return _AskReq(st, new_ids, seed)
+        return _AskReq(st, new_ids, seed, deadline=deadline)
+
+    def _journal_ask(self, st, new_ids, seed, algo):
+        """WAL the served ask (ids + seed + serving algo) BEFORE its docs
+        land — crash-ordering argument in ``journal.py``."""
+        if self.journal is not None:
+            self.journal.append(StudyJournal.ask_rec(
+                st.study_id, new_ids, seed, algo))
+
+    def _journal_void_ask(self, st, new_ids, seed):
+        """A FAILED/SHED ask still consumed one seed draw from the
+        study's RNG stream AND its allocated trial ids (both
+        irreversibly); record them as a ``void`` ask so replay advances
+        the stream and retires the same ids identically.  Best effort:
+        if the WAL itself is down, losing one draw record is logged,
+        not fatal (the serving path already failed)."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(StudyJournal.ask_rec(
+                st.study_id, new_ids, seed, "void"))
+            self.journal.sync()
+        except JournalError as e:
+            logging.getLogger(__name__).warning(
+                "service: could not journal void ask for %s: %s",
+                st.study_id, e)
 
     def _land(self, st, docs):
         st.trials.insert_trial_docs(docs)
         st.trials.refresh()
 
-    def _answers(self, st, docs):
-        return [{"study_id": st.study_id, "tid": d["tid"],
-                 "params": spec_from_misc(d["misc"])} for d in docs]
+    def _answers(self, st, docs, algo="tpe", degraded=False):
+        out = [{"study_id": st.study_id, "tid": d["tid"],
+                "params": spec_from_misc(d["misc"])} for d in docs]
+        if degraded:
+            # flag degraded service in-band: the client learns its
+            # proposal came from the ladder (possibly plain random
+            # search) instead of silently getting worse suggestions
+            for a in out:
+                a["degraded"] = True
+                a["algo"] = algo
+        return out
+
+    def _ladder_spec(self):
+        return (self.degrade.spec() if self.degrade is not None
+                else LADDER_LEVELS[0])
+
+    def _serve_rand_fallback(self, r):
+        """The degrade ladder's floor: serve one TPE ask host-side via
+        ``rand.suggest`` with the SAME recorded ids + seed — the device
+        is never touched, the response is flagged, and the WAL records
+        ``algo="rand"`` so a replay regenerates the same docs."""
+        docs = rand.suggest(r.new_ids, r.study.domain, r.study.trials,
+                            r.seed)
+        r.algo = "rand"
+        r.degraded = True
+        self.metrics.counter("service.degraded_asks").inc(len(r.new_ids))
+        return docs
+
+    def _finish_req(self, r, docs):
+        """Journal (write-ahead) + land one served ask.  Replay reqs are
+        already in the WAL and must not journal twice."""
+        if not r.replay:
+            self._journal_ask(r.study, r.new_ids, r.seed, r.algo)
+            r.journaled = True
+        self._land(r.study, docs)
+        r.docs = docs
+
+    def _dispatch_cohort(self, cohort, cohort_reqs, mesh, spec):
+        """One cohort tick dispatch at ladder level ``spec``.  Returns the
+        in-flight packed array, or None when this level serves the
+        cohort host-side (rand floor / capacity bucket over the level's
+        limit)."""
+        if spec["rand"] or (spec["cap_limit"] is not None
+                            and cohort.cap > spec["cap_limit"]):
+            return None
+        chaos.io_point("tick", self.metrics)
+        demand = {}
+        for r in cohort_reqs:
+            slot = cohort.slot_of[r.study.study_id]
+            demand[slot] = (np.asarray(
+                [int(i) & 0xFFFFFFFF for i in r.new_ids],
+                np.uint32), r.seed)
+        return cohort.tick(demand, donate=tpe._donation_enabled(),
+                           mesh=mesh, cand_scale=spec["cand_scale"])
+
+    def _readback_cohort(self, cohort, cohort_reqs, packed):
+        """Block on one cohort's tick and build + land every req's docs
+        (per-req isolation for landing failures).  Raises on readback
+        failure or non-finite proposals — the ladder's caller decides
+        whether to retry down-ladder."""
+        try:
+            mat = np.asarray(packed)
+        except BaseException:
+            cohort.abandon_device()
+            raise
+        live = [mat[cohort.slot_of[r.study.study_id]][: len(r.new_ids)]
+                for r in cohort_reqs
+                if r.study.study_id in cohort.slot_of]
+        if live and not all(np.all(np.isfinite(x)) for x in live):
+            cohort.abandon_device()
+            raise NonFiniteProposal(
+                "cohort tick read back non-finite proposals")
+        for r in cohort_reqs:
+            # per-req isolation: a landing failure (e.g. a full disk
+            # under --store) must error THIS ask, not strand the rest
+            # of the wave unresolved
+            try:
+                slot = cohort.slot_of[r.study.study_id]
+                flats = rand.unpack_flats(
+                    cohort.cs, mat[slot], len(r.new_ids))
+                docs = rand.flat_to_new_trial_docs(
+                    r.study.domain, r.study.trials, r.new_ids, flats)
+                if self.degrade is not None and self.degrade.degraded:
+                    r.degraded = True
+                self._finish_req(r, docs)
+            except Exception as e:  # noqa: BLE001
+                r.error = e
+        self.metrics.counter("service.ticks").inc()
+        self.metrics.counter("service.tick_asks").inc(len(cohort_reqs))
+
+    def _serve_cohort_host_side(self, cohort_reqs):
+        """Serve a cohort's reqs entirely host-side (the rand floor)."""
+        for r in cohort_reqs:
+            try:
+                docs = self._serve_rand_fallback(r)
+                self._finish_req(r, docs)
+            except Exception as e:  # noqa: BLE001
+                r.error = e
+
+    def _retry_cohort_down_ladder(self, cohort, cohort_reqs, mesh, exc):
+        """A cohort tick device-faulted: walk the ladder down and retry
+        synchronously until the cohort serves (the rand floor always
+        does) or the fault stops looking like device pressure.  Returns
+        the number of faults absorbed; req errors are set on a
+        non-device failure."""
+        faults = 0
+        while True:
+            if self.degrade is None or not is_device_fault(exc):
+                for r in cohort_reqs:
+                    if r.docs is None and r.error is None:
+                        r.error = exc
+                return faults
+            faults += 1
+            self.degrade.record_fault()
+            spec = self._ladder_spec()
+            try:
+                packed = self._dispatch_cohort(
+                    cohort, cohort_reqs, mesh, spec)
+                if packed is None:
+                    self._serve_cohort_host_side(cohort_reqs)
+                else:
+                    self._readback_cohort(cohort, cohort_reqs, packed)
+                return faults
+            except Exception as e:  # noqa: BLE001
+                exc = e
 
     def _run_wave(self, reqs):
         """Group pending asks by cohort and run one tick per cohort (a
         study asked twice in one wave falls to a follow-up round so each
-        tick carries at most one ask per slot)."""
+        tick carries at most one ask per slot).  Device faults walk the
+        degrade ladder (never failing the wave while the rand floor can
+        serve it); the wave's wall time feeds the overload guard's
+        ``Retry-After`` EWMA; served asks journal before landing and the
+        WAL fsyncs ONCE per wave, before any asker unblocks."""
         from .._env import parse_shard
         from ..parallel import sharding as _sh
 
+        t_wave = time.perf_counter()
+        wave_faults = 0
+        served_any = False
         self.evict_idle()
         while reqs:
             this_round, leftover, seen = [], [], set()
@@ -592,7 +895,9 @@ class StudyScheduler:
             n_shard = parse_shard()
             # dispatch phase: every cohort's fused program goes onto the
             # device queue before any readback, so the Python doc building
-            # below overlaps the remaining cohorts' device compute
+            # below overlaps the remaining cohorts' device compute.  A
+            # dispatch-time device fault retries down-ladder synchronously
+            # (overlap is sacrificed only in fault scenarios).
             dispatched = []
             for cohort, cohort_reqs in by_cohort.values():
                 mesh = None
@@ -603,49 +908,46 @@ class StudyScheduler:
                     # stay single-device rather than padding slots
                     if n_dev > 1 and cohort.n_slots % n_dev == 0:
                         mesh = m
-                demand = {}
-                for r in cohort_reqs:
-                    slot = cohort.slot_of[r.study.study_id]
-                    demand[slot] = (np.asarray(
-                        [int(i) & 0xFFFFFFFF for i in r.new_ids],
-                        np.uint32), r.seed)
+                spec = self._ladder_spec()
                 try:
-                    packed = cohort.tick(demand,
-                                         donate=tpe._donation_enabled(),
-                                         mesh=mesh)
+                    packed = self._dispatch_cohort(
+                        cohort, cohort_reqs, mesh, spec)
                 except Exception as e:  # noqa: BLE001
-                    for r in cohort_reqs:
-                        r.error = e
+                    wave_faults += self._retry_cohort_down_ladder(
+                        cohort, cohort_reqs, mesh, e)
+                    served_any = True
                     continue
-                dispatched.append((cohort, cohort_reqs, packed))
+                if packed is None:  # ladder floor: host-side service
+                    self._serve_cohort_host_side(cohort_reqs)
+                    served_any = True
+                    continue
+                dispatched.append((cohort, cohort_reqs, mesh, packed))
             # readback phase: block per cohort, build and land the docs
-            for cohort, cohort_reqs, packed in dispatched:
+            for cohort, cohort_reqs, mesh, packed in dispatched:
+                served_any = True
                 try:
-                    mat = np.asarray(packed)
+                    self._readback_cohort(cohort, cohort_reqs, packed)
                 except Exception as e:  # noqa: BLE001 - runtime XLA error
-                    cohort.abandon_device()
-                    for r in cohort_reqs:
-                        r.error = e
-                    continue
-                for r in cohort_reqs:
-                    # per-req isolation: a landing failure (e.g. a full
-                    # disk under --store) must error THIS ask, not strand
-                    # the rest of the wave unresolved
-                    try:
-                        slot = cohort.slot_of[r.study.study_id]
-                        flats = rand.unpack_flats(
-                            cohort.cs, mat[slot], len(r.new_ids))
-                        docs = rand.flat_to_new_trial_docs(
-                            r.study.domain, r.study.trials, r.new_ids,
-                            flats)
-                        self._land(r.study, docs)
-                        r.docs = docs
-                    except Exception as e:  # noqa: BLE001
-                        r.error = e
-                self.metrics.counter("service.ticks").inc()
-                self.metrics.counter("service.tick_asks").inc(
-                    len(cohort_reqs))
+                    wave_faults += self._retry_cohort_down_ladder(
+                        cohort, cohort_reqs, mesh, e)
             reqs = leftover
+        if self.journal is not None:
+            try:
+                self.journal.sync()
+            except JournalError as e:
+                # docs already landed; failing the responses now would
+                # desync clients from served state.  Count loudly — a
+                # failing WAL fsync is a disk-level event the operator
+                # must see, not a reason to abandon a served wave.
+                logging.getLogger(__name__).warning(
+                    "service: WAL sync failed after wave: %s", e)
+                self.metrics.counter("service.wal.sync_errors").inc()
+        if self.degrade is not None and served_any and not wave_faults:
+            self.degrade.record_clean_wave()
+        dt = time.perf_counter() - t_wave
+        self.metrics.histogram("service.wave_sec").observe(dt)
+        if self.overload is not None:
+            self.overload.observe_wave(dt)
         self._gc_cohorts()
         stats = tpe.cohort_cache_stats()
         self.metrics.gauge("suggest.cohort_cache.hits").set(stats["hits"])
@@ -654,15 +956,21 @@ class StudyScheduler:
         self.metrics.gauge("service.slot_utilization").set(
             self.slot_utilization())
 
-    def ask(self, study_id, n=1):
+    def ask(self, study_id, n=1, deadline=None):
         """Propose ``n`` new trials for one study.  Concurrent callers
         coalesce: the first thread to reach a quiescent scheduler becomes
         the wave ticker and serves every enqueued ask in one batched
-        device tick per cohort."""
+        device tick per cohort.  ``deadline`` (an
+        :class:`~hyperopt_tpu.service.overload.Deadline`) sheds the ask
+        while it is still QUEUED once expired — a req already inside a
+        wave completes and answers (the work is done and journaled)."""
+        chaos.point("ask", self.metrics)
         t0 = time.perf_counter()
+        if deadline is not None:
+            deadline.check("ask")
         with self._cond:
             st = self._get(study_id)
-            res = self._prepare_ask(st, n)
+            res = self._prepare_ask(st, n, deadline=deadline)
             if not isinstance(res, _AskReq):  # startup random search
                 self.metrics.histogram("service.ask_sec").observe(
                     time.perf_counter() - t0)
@@ -670,6 +978,15 @@ class StudyScheduler:
             req = res
             self._wave_reqs.append(req)
             while req.docs is None and req.error is None:
+                if (req.deadline is not None and req.deadline.expired()
+                        and req in self._wave_reqs):
+                    # still queued: shed cleanly (nothing served, nothing
+                    # journaled; the seed draw is released with the quota
+                    # in the error path below, matching any failed ask)
+                    self._wave_reqs.remove(req)
+                    req.error = DeadlineExceeded(
+                        f"{study_id}: ask deadline expired while queued")
+                    break
                 if self._tick_running:
                     self._cond.wait(timeout=0.25)
                     continue
@@ -690,13 +1007,22 @@ class StudyScheduler:
                 finally:
                     self._tick_running = False
                     self._cond.notify_all()
-        if req.error is not None:
-            with self._lock:  # release the reserved pending quota
+            if req.error is not None:
+                # release the quota and journal the burned draw INSIDE
+                # the lock scope: a concurrent tell/close could
+                # otherwise compact (snapshot the post-draw rstate) in
+                # the window before the void record lands, making
+                # replay draw the failed seed twice
                 req.study.n_asked -= len(req.new_ids)
+                if not req.journaled:
+                    self._journal_void_ask(req.study, req.new_ids,
+                                           req.seed)
+        if req.error is not None:
             raise req.error
         self.metrics.histogram("service.ask_sec").observe(
             time.perf_counter() - t0)
-        return self._answers(req.study, req.docs)
+        return self._answers(req.study, req.docs, algo=req.algo,
+                             degraded=req.degraded)
 
     def ask_many(self, requests):
         """Explicit wave: ``[(study_id, n), ...]`` asked in ONE batched
@@ -710,8 +1036,6 @@ class StudyScheduler:
         away the other studies' already-landed trials, orphaning NEW
         docs the caller could never tell.  Only an all-failed wave
         raises."""
-        import logging
-
         with self._lock:
             out = {}
             reqs = []
@@ -730,10 +1054,13 @@ class StudyScheduler:
                     # release the failed req's pending quota, else
                     # repeated failures wedge the study at 429
                     r.study.n_asked -= len(r.new_ids)
+                    if not r.journaled:
+                        self._journal_void_ask(r.study, r.new_ids, r.seed)
                     failed.append(r)
                 else:
                     out.setdefault(r.study.study_id, []).extend(
-                        self._answers(r.study, r.docs))
+                        self._answers(r.study, r.docs, algo=r.algo,
+                                      degraded=r.degraded))
             if failed:
                 if not out:
                     raise failed[0].error
@@ -748,7 +1075,10 @@ class StudyScheduler:
         """Report one trial's result.  ``status`` defaults to ok with a
         finite loss, fail otherwise; the doc settles DONE and folds into
         the study's posterior at its next ask (the tell half of the fused
-        tell+ask program)."""
+        tell+ask program).  The WAL record appends (and fsyncs) before
+        the state mutates: a tell is never acknowledged un-durably, and
+        never lost to a crash after acknowledgment."""
+        chaos.point("tell", self.metrics)
         with self._lock:
             st = self._get(study_id)
             tid = int(tid)
@@ -760,30 +1090,274 @@ class StudyScheduler:
             if doc["state"] == JOB_STATE_DONE:
                 raise DuplicateTellError(
                     f"{study_id}: trial {tid} was already told")
-            # a finite loss is REQUIRED for an ok record even when the
-            # caller says status="ok" — an inf/NaN loss folded into the
-            # posterior would poison every later EI split for the study
-            ok = (loss is not None and math.isfinite(float(loss))
-                  and (status is None or status == STATUS_OK))
-            doc["result"] = ({"loss": float(loss), "status": STATUS_OK}
-                             if ok else {"status": STATUS_FAIL})
-            doc["state"] = JOB_STATE_DONE
-            doc["refresh_time"] = coarse_utcnow()
-            store = getattr(st.trials, "store", None)
-            if store is not None:
-                store.settle(doc)
-            # base-class refresh on purpose: the doc was mutated in place
-            # and written through above, so only the _trials view needs
-            # rebuilding — FileTrials.refresh would rescan and unpickle
-            # the study's whole on-disk store on every tell (O(n) files)
-            Trials.refresh(st.trials)
-            st.n_told += 1
-            st.touch()
-            self.metrics.counter("service.tells").inc()
-            if (st.max_trials is not None
-                    and st.n_trials >= st.max_trials and st.n_pending == 0):
-                st.state = "done"
-                self._evict_from_cohort(st)
+            if self.journal is not None:
+                self.journal.append(StudyJournal.tell_rec(
+                    study_id, tid, loss, status))
+                self.journal.sync()
+            self._apply_tell(st, doc, loss, status)
+            if st.state == "done":
+                self._maybe_compact()
+
+    def _apply_tell(self, st, doc, loss, status):
+        """Settle one told doc into the study (shared by the live path
+        and WAL replay — replay must fold results identically)."""
+        # a finite loss is REQUIRED for an ok record even when the
+        # caller says status="ok" — an inf/NaN loss folded into the
+        # posterior would poison every later EI split for the study
+        ok = (loss is not None and math.isfinite(float(loss))
+              and (status is None or status == STATUS_OK))
+        doc["result"] = ({"loss": float(loss), "status": STATUS_OK}
+                         if ok else {"status": STATUS_FAIL})
+        doc["state"] = JOB_STATE_DONE
+        doc["refresh_time"] = coarse_utcnow()
+        store = getattr(st.trials, "store", None)
+        if store is not None:
+            store.settle(doc)
+        # base-class refresh on purpose: the doc was mutated in place
+        # and written through above, so only the _trials view needs
+        # rebuilding — FileTrials.refresh would rescan and unpickle
+        # the study's whole on-disk store on every tell (O(n) files)
+        Trials.refresh(st.trials)
+        st.n_told += 1
+        st.touch()
+        self.metrics.counter("service.tells").inc()
+        if (st.max_trials is not None
+                and st.n_trials >= st.max_trials and st.n_pending == 0):
+            st.state = "done"
+            self._evict_from_cohort(st)
+
+    # -- WAL resume / compaction / drain -----------------------------------
+
+    def _space_from_admit(self, rec):
+        """Rebuild the ``hp`` space from an admit/snapshot record's spec
+        wrapper (``{"space": <schema>}`` or ``{"zoo": <name>}``), or None
+        when the study was never resumable (direct API admission)."""
+        spec = rec.get("spec")
+        if not isinstance(spec, dict):
+            return None
+        if "zoo" in spec:
+            from ..zoo import ZOO
+
+            zrec = ZOO.get(str(spec["zoo"]))
+            return zrec.space if zrec is not None else None
+        if "space" in spec:
+            from .spacespec import space_from_spec
+
+            return space_from_spec(spec["space"])
+        return None
+
+    def resume(self):
+        """Replay the WAL into this (fresh) scheduler: re-admit every
+        journaled study, advance each seed stream draw-for-draw, re-land
+        any doc the store does not already hold (regenerated through the
+        same serving path — bit-identical by the PR-9 determinism pins)
+        and re-apply un-settled tells idempotently.  Returns a stats
+        dict (also kept as ``last_resume``); None when no WAL is armed.
+        Safe on an empty/missing journal (no-op stats)."""
+        if self.journal is None:
+            return None
+        t0 = time.perf_counter()
+        stats = {"studies": 0, "asks": 0, "regenerated": 0, "tells": 0,
+                 "duplicate_tells": 0, "skipped": 0, "errors": 0,
+                 "seed_mismatches": 0}
+        # replay-scoped context: which (sid, tid) tells this replay has
+        # accounted (store-ahead vs genuine duplicate), and the highest
+        # VOID tid per study (ids a failed ask retired — the tid
+        # allocator must stay past them, exactly as the live run's did)
+        self._replay_ctx = {"told": set(), "void_max": {}}
+        with self._lock:
+            for rec in self.journal.records():
+                try:
+                    self._replay_record(rec, stats)
+                except Exception as e:  # noqa: BLE001 - per-record isolation
+                    stats["errors"] += 1
+                    logging.getLogger(__name__).warning(
+                        "service: WAL replay failed for %r: %s", rec, e)
+            self.metrics.gauge("service.studies_live").set(
+                sum(1 for s in self._studies.values()
+                    if s.state == "active"))
+            for st in self._studies.values():
+                # reclaim tid-allocator gaps left by asks that died
+                # un-journaled mid-wave: per-trial PRNG streams key off
+                # the id VALUE, so a gap would diverge every later
+                # proposal from the uninterrupted reference.  VOID ids
+                # (failed asks the live run survived) stay retired —
+                # the live run's allocator is past them too.
+                store = getattr(st.trials, "store", None)
+                if store is not None:
+                    tids = [d["tid"] for d in st.trials._dynamic_trials]
+                    nxt = max(max(tids, default=-1),
+                              self._replay_ctx["void_max"].get(
+                                  st.study_id, -1)) + 1
+                    store.reset_counter(nxt)
+            self._maybe_compact()
+        del self._replay_ctx
+        stats["replay_sec"] = time.perf_counter() - t0
+        for key in ("studies", "asks", "regenerated", "tells",
+                    "duplicate_tells", "skipped", "errors"):
+            if stats[key]:
+                self.metrics.counter(f"service.wal.replay_{key}").inc(
+                    stats[key])
+        self.metrics.gauge("service.wal.replay_sec").set(
+            stats["replay_sec"])
+        self.last_resume = stats
+        if stats["studies"] or stats["errors"]:
+            logging.getLogger(__name__).warning(
+                "service: WAL resume: %d studies, %d asks "
+                "(%d regenerated), %d tells (%d duplicates skipped), "
+                "%d skipped, %d errors in %.3fs",
+                stats["studies"], stats["asks"], stats["regenerated"],
+                stats["tells"], stats["duplicate_tells"],
+                stats["skipped"], stats["errors"], stats["replay_sec"])
+        return stats
+
+    def _replay_record(self, rec, stats):
+        kind = rec.get("kind")
+        sid = rec.get("sid")
+        if kind in ("admit", "snapshot"):
+            if sid in self._studies:
+                return  # duplicate admit (compaction raced a crash)
+            space = self._space_from_admit(rec)
+            if space is None:
+                stats["skipped"] += 1
+                logging.getLogger(__name__).warning(
+                    "service: WAL study %s has no resumable space spec; "
+                    "skipping it", sid)
+                return
+            self.create_study(space, seed=rec.get("seed", 0),
+                              study_id=sid, space_spec=rec.get("spec"),
+                              _replay=True, **(rec.get("kwargs") or {}))
+            st = self._studies[sid]
+            if kind == "snapshot":
+                st.rstate.bit_generator.state = rec["rstate"]
+                st.n_asked = int(rec.get("n_asked", 0))
+                st.n_told = int(rec.get("n_told", 0))
+                st.state = rec.get("state", "active")
+            stats["studies"] += 1
+            return
+        st = self._studies.get(sid)
+        if st is None:
+            stats["skipped"] += 1
+            return
+        if kind == "ask":
+            drawn = st.next_seed()  # the live draw, replayed exactly
+            seed = int(rec.get("seed", drawn))
+            if drawn != seed:
+                # trust the RECORD (it is what produced the served
+                # docs); a mismatch means journal/stream skew and is
+                # worth counting loudly
+                stats["seed_mismatches"] += 1
+            tids = [int(t) for t in rec.get("tids") or []]
+            if rec.get("algo") == "void" or not tids:
+                # a failed ask the live run survived: the draw is
+                # replayed (above) and its ids stay retired — in-memory
+                # allocation counts known ids, the store counter floor
+                # is applied after replay
+                if tids:
+                    st.trials._ids.update(tids)
+                    self._replay_ctx["void_max"][sid] = max(
+                        max(tids),
+                        self._replay_ctx["void_max"].get(sid, -1))
+                return
+            st.n_asked += len(tids)
+            existing = {d["tid"] for d in st.trials._dynamic_trials}
+            if all(t in existing for t in tids):
+                stats["asks"] += 1
+                return  # the store already holds this ask's docs
+            # in-flight at the crash: regenerate through the algo that
+            # served it (recorded — never re-derived: replay-time trial
+            # counts include later store docs)
+            if rec.get("algo") == "rand":
+                docs = rand.suggest(tids, st.domain, st.trials, seed)
+                self._land(st, docs)
+            else:
+                req = _AskReq(st, tids, seed, replay=True)
+                self._run_wave([req])
+                if req.error is not None:
+                    raise req.error
+            stats["asks"] += 1
+            stats["regenerated"] += 1
+        elif kind == "tell":
+            tid = int(rec["tid"])
+            key = (sid, tid)
+            doc = next((d for d in st.trials._dynamic_trials
+                        if d["tid"] == tid), None)
+            if doc is None:
+                stats["skipped"] += 1
+            elif key in self._replay_ctx["told"]:
+                # the SAME tell twice in the journal (crash between the
+                # append and the client's retry): exactly-once — skip
+                stats["duplicate_tells"] += 1
+            elif doc["state"] == JOB_STATE_DONE:
+                # store-ahead: the tell settled into the FileStore
+                # before the crash.  The result is already folded; only
+                # the scheduler-side bookkeeping needs replaying.
+                self._replay_ctx["told"].add(key)
+                st.n_told += 1
+                stats["tells"] += 1
+                if (st.max_trials is not None
+                        and st.n_trials >= st.max_trials
+                        and st.n_pending == 0):
+                    st.state = "done"
+            else:
+                self._replay_ctx["told"].add(key)
+                self._apply_tell(st, doc, rec.get("loss"),
+                                 rec.get("status"))
+                stats["tells"] += 1
+        elif kind == "close":
+            st.state = "closed"
+            self._evict_from_cohort(st)
+        # unknown kinds: forward-compat, ignored
+
+    def _maybe_compact(self):
+        """Compact the WAL to one snapshot record per live study — only
+        with a store (without one the ask records ARE the trial data)
+        and only at quiescent points (a pending ask's seed draw is not
+        yet journaled; snapshotting the advanced RNG would replay that
+        draw twice).  Settled/closed studies drop out of the journal —
+        their trials stay on disk, but a restart forgets the registry
+        entry (by design: the WAL bounds at O(live studies))."""
+        if self.journal is None or self.store_root is None:
+            return False
+        if self._tick_running or self._wave_reqs:
+            return False
+        recs = [StudyJournal.snapshot_rec(s)
+                for s in self._studies.values() if s.state == "active"]
+        try:
+            self.journal.rewrite(recs)
+        except JournalError as e:
+            logging.getLogger(__name__).warning(
+                "service: WAL compaction failed: %s", e)
+            self.metrics.counter("service.wal.compact_errors").inc()
+            return False
+        self.metrics.counter("service.wal.compactions").inc()
+        return True
+
+    def drain(self, timeout=30.0):
+        """Graceful-drain half of SIGTERM handling: stop admitting (new
+        studies AND new asks answer 429 via ``_draining``; tells keep
+        landing — they preserve client work), wait for in-flight waves
+        to finish, then compact and close the WAL.  Per-study stores
+        need no settling pass — every mutation wrote through at tell
+        time.  Returns True when the scheduler quiesced within
+        ``timeout``."""
+        with self._cond:
+            self._draining = True
+            deadline = time.monotonic() + float(timeout)
+            while self._tick_running or self._wave_reqs:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(0.25, left))
+            quiesced = not (self._tick_running or self._wave_reqs)
+            if self.journal is not None:
+                if quiesced:
+                    self._maybe_compact()
+                try:
+                    self.journal.close()
+                except JournalError:
+                    pass
+            return quiesced
 
     # -- status ------------------------------------------------------------
 
@@ -802,7 +1376,7 @@ class StudyScheduler:
                 "n_live": c.n_live,
                 "ticks": c.ticks,
             } for key, c in self._cohorts.items()]
-            return {
+            out = {
                 "ts": time.time(),
                 "n_studies": len(self._studies),
                 "slot_utilization": self.slot_utilization(),
@@ -810,4 +1384,16 @@ class StudyScheduler:
                 "cohorts": cohorts,
                 "studies": [s.status_dict()
                             for s in self._studies.values()],
+                "draining": self._draining,
             }
+            if self.degrade is not None:
+                out["degrade"] = self.degrade.status()
+            if self.journal is not None:
+                out["wal"] = {
+                    "path": self.journal.path,
+                    "appends": self.journal.appends,
+                    "compactions": self.journal.compactions,
+                    "size_bytes": self.journal.size_bytes(),
+                    "last_resume": self.last_resume,
+                }
+            return out

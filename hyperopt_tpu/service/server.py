@@ -21,14 +21,29 @@ same ``ThreadingHTTPServer`` + daemon-thread shape, now serving
   family rides along) and a JSON snapshot with the study table.
 
 Error mapping is in-band and typed: schema errors answer 400, unknown
-studies 404, quota exhaustion 429 — all as ``{"ok": false, "error":
-...}`` JSON.  A handler bug answers 500 once per request and never
-propagates into the scheduler (the obs/serve.py contract).
+studies 404, quota exhaustion and load sheds 429 (+ ``Retry-After``
+from the live wave-latency EWMA), draining 503 — all as ``{"ok":
+false, "error": ...}`` JSON.  A handler bug answers 500 once per
+request and never propagates into the scheduler (the obs/serve.py
+contract); every response increments a per-endpoint status-class
+counter (``service.http.<endpoint>.<c>xx``) and a 500 records the
+exception in the flight ring, so handler failures are observable
+instead of vanishing into the fail-open path.
+
+Overload control (ISSUE 10): ``POST /ask`` passes through a bounded
+admission queue (``HYPEROPT_TPU_SERVICE_QUEUE``) and a per-request
+monotonic deadline (``X-Deadline-Ms`` header, clamped by
+``HYPEROPT_TPU_SERVICE_DEADLINE_MS``); past the bound — or when the
+deadline cannot cover the predicted wait — the server sheds with 429
+instead of queuing unboundedly.  Tells shed only at 4x the ask bound
+(they are cheap and preserve client work).
 
 Arming: ``python -m hyperopt_tpu.service.server [--port P]`` (or
 ``HYPEROPT_TPU_SERVICE=<port>`` with no ``--port``); ``--port 0`` binds
 an ephemeral port and ``--announce`` prints ``SERVICE_URL <url>`` for
-harnesses (``scripts/service_smoke.py``).
+harnesses (``scripts/service_smoke.py``).  SIGTERM drains gracefully:
+stop admitting, finish in-flight waves, compact + close the WAL, exit
+0.
 """
 
 from __future__ import annotations
@@ -39,8 +54,9 @@ import threading
 import time
 
 from ..obs.serve import prometheus_text, split_hostport
-from .scheduler import (DuplicateTellError, StudyQuotaError, StudyScheduler,
-                        UnknownStudyError)
+from .overload import AdmissionGuard, Deadline, OverloadError
+from .scheduler import (DrainingError, DuplicateTellError, StudyQuotaError,
+                        StudyScheduler, UnknownStudyError)
 from .spacespec import SpaceSpecError, space_from_spec
 
 __all__ = ["ServiceHTTPServer", "main"]
@@ -66,7 +82,10 @@ class ServiceHTTPServer:
     ``start()`` warns and returns False on a bind failure instead of
     raising, ``stop()`` is idempotent."""
 
-    def __init__(self, port, scheduler=None, host=None, store_root=None):
+    def __init__(self, port, scheduler=None, host=None, store_root=None,
+                 guard=None):
+        from .._env import parse_service_deadline_ms
+
         try:
             if host is None:
                 host, port = split_hostport(port)
@@ -76,15 +95,61 @@ class ServiceHTTPServer:
         self.host = host or "127.0.0.1"
         self.scheduler = scheduler if scheduler is not None else (
             StudyScheduler(store_root=store_root, wave_window=0.005))
+        self.guard = (guard if guard is not None
+                      else AdmissionGuard(metrics=self.scheduler.metrics))
+        if self.scheduler.overload is None:
+            # the scheduler feeds the guard its wave latencies — that
+            # EWMA is what sizes every Retry-After hint
+            self.scheduler.overload = self.guard
+        self.default_deadline_ms = parse_service_deadline_ms()
         self._httpd = None
         self._thread = None
         self._stopped = False
 
     # -- request handling --------------------------------------------------
 
-    def handle(self, method, path, body):
+    def handle(self, method, path, body, headers=None):
         """Route one request; returns ``(status, payload dict)``.  Pure
-        (no socket I/O) so tests can drive it directly."""
+        (no socket I/O) so tests can drive it directly.  ``headers`` is
+        a lower-cased mapping (the deadline header rides in it); a 429/
+        503 payload carries ``retry_after`` seconds, which the HTTP
+        layer also emits as a ``Retry-After`` header."""
+        status, payload = self._handle(method, path, body, headers or {})
+        self._count_response(method, path, status)
+        return status, payload
+
+    @staticmethod
+    def _endpoint_label(method, path):
+        """Metric-friendly endpoint label: known routes by name, the
+        rest pooled (an attacker probing random paths must not mint
+        unbounded metric families)."""
+        known = ("/study", "/ask", "/tell", "/close", "/studies",
+                 "/metrics", "/snapshot", "/")
+        if path in known:
+            return path.strip("/") or "root"
+        return "other"
+
+    def _count_response(self, method, path, status):
+        ep = self._endpoint_label(method, path)
+        cls = int(status) // 100
+        self.scheduler.metrics.counter(
+            f"service.http.{ep}.{cls}xx").inc()
+
+    def _record_failure(self, method, path, exc):
+        """A handler exception became a 500: record it in the flight
+        ring (it used to vanish into the fail-open path — invisible to
+        every post-mortem)."""
+        try:
+            from ..obs.flight import get_flight
+
+            get_flight().record({
+                "kind": "service_error", "ts": time.time(),
+                "method": method, "path": path,
+                "error": f"{type(exc).__name__}: {exc}"})
+        except Exception:  # noqa: BLE001 - forensics must never cascade
+            pass
+
+    def _handle(self, method, path, body, headers):
         sched = self.scheduler
         try:
             if method == "GET":
@@ -107,36 +172,52 @@ class ServiceHTTPServer:
             if path == "/ask":
                 study_id = self._required(body, "study_id")
                 n = int(body.get("n", 1))
-                trials = sched.ask(study_id, n)
-                return 200, {"ok": True, "study_id": study_id,
-                             "trials": [{"tid": t["tid"],
-                                         "params": t["params"]}
-                                        for t in trials]}
+                deadline = Deadline.from_request(
+                    headers.get("x-deadline-ms"), self.default_deadline_ms)
+                token = self.guard.admit_ask(deadline)
+                try:
+                    trials = sched.ask(study_id, n, deadline=deadline)
+                finally:
+                    self.guard.release(token)
+                out = {"ok": True, "study_id": study_id,
+                       "trials": [{k: t[k] for k in
+                                   ("tid", "params", "degraded", "algo")
+                                   if k in t}
+                                  for t in trials]}
+                if any(t.get("degraded") for t in trials):
+                    out["degraded"] = True
+                return 200, out
             if path == "/tell":
                 study_id = self._required(body, "study_id")
-                results = body.get("results")
-                batch = results is not None
-                if not batch:
-                    results = [{"tid": self._required(body, "tid"),
-                                "loss": body.get("loss"),
-                                "status": body.get("status")}]
-                told = dups = 0
-                for r in results:
-                    if not isinstance(r, dict) or r.get("tid") is None:
-                        raise _RequestError(
-                            400, f"each result needs a 'tid': {r!r}")
-                    try:
-                        sched.tell(study_id, r["tid"], loss=r.get("loss"),
-                                   status=r.get("status"))
-                        told += 1
-                    except DuplicateTellError:
-                        # a retried BATCH must not strand its untold
-                        # tail behind one already-settled tid — skip and
-                        # report; a single-tid duplicate still answers
-                        # 409 so the client learns the conflict
-                        if not batch:
-                            raise
-                        dups += 1
+                token = self.guard.admit_tell()
+                try:
+                    results = body.get("results")
+                    batch = results is not None
+                    if not batch:
+                        results = [{"tid": self._required(body, "tid"),
+                                    "loss": body.get("loss"),
+                                    "status": body.get("status")}]
+                    told = dups = 0
+                    for r in results:
+                        if not isinstance(r, dict) or r.get("tid") is None:
+                            raise _RequestError(
+                                400, f"each result needs a 'tid': {r!r}")
+                        try:
+                            sched.tell(study_id, r["tid"],
+                                       loss=r.get("loss"),
+                                       status=r.get("status"))
+                            told += 1
+                        except DuplicateTellError:
+                            # a retried BATCH must not strand its untold
+                            # tail behind one already-settled tid — skip
+                            # and report; a single-tid duplicate still
+                            # answers 409 so the client learns the
+                            # conflict
+                            if not batch:
+                                raise
+                            dups += 1
+                finally:
+                    self.guard.release(token)
                 return 200, {"ok": True, "study_id": study_id,
                              "told": told, "duplicates": dups}
             if path == "/close":
@@ -152,6 +233,14 @@ class ServiceHTTPServer:
             # 409, not 429: "already told" is permanent — a client
             # retrying a lost tell response must not back off forever
             return 409, {"ok": False, "error": str(e)}
+        except DrainingError as e:
+            # 503: the process is going away; retry against the restart
+            return 503, {"ok": False, "error": str(e), "retry_after": 1.0}
+        except OverloadError as e:
+            # load shed (queue full / deadline unservable / expired):
+            # the retry_after hint is measured from live wave latency
+            return 429, {"ok": False, "error": str(e),
+                         "retry_after": e.retry_after}
         except StudyQuotaError as e:
             return 429, {"ok": False, "error": str(e)}
         # ValueError/TypeError here are request-shape problems (bad n,
@@ -163,6 +252,7 @@ class ServiceHTTPServer:
                          "error": f"{type(e).__name__}: {e}"}
         except Exception as e:  # noqa: BLE001 - fail-open contract
             logger.warning("service: %s %s failed: %s", method, path, e)
+            self._record_failure(method, path, e)
             return 500, {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
     @staticmethod
@@ -175,6 +265,7 @@ class ServiceHTTPServer:
     def _create_study(self, body):
         if "space" in body:
             space = space_from_spec(body["space"])
+            space_spec = {"space": body["space"]}
         elif "zoo" in body:
             from ..zoo import ZOO
 
@@ -184,11 +275,15 @@ class ServiceHTTPServer:
                     400, f"unknown zoo domain {body['zoo']!r} "
                          f"(one of {sorted(ZOO)})")
             space = rec.space
+            space_spec = {"zoo": str(body["zoo"])}
         else:
             raise _RequestError(400, "POST /study needs 'space' or 'zoo'")
         kwargs = {k: body[k] for k in _STUDY_KWARGS if k in body}
+        # the wire schema IS the WAL registry entry: every HTTP-created
+        # study is crash-resumable
         study_id = self.scheduler.create_study(
-            space, seed=int(body.get("seed", 0)), **kwargs)
+            space, seed=int(body.get("seed", 0)), space_spec=space_spec,
+            **kwargs)
         return {"ok": True, "study_id": study_id}
 
     def snapshot_dict(self):
@@ -239,6 +334,16 @@ class ServiceHTTPServer:
         logger.info("ask/tell service listening on %s", self.url)
         return True
 
+    def drain(self, timeout=30.0):
+        """Graceful shutdown (the SIGTERM path): stop admitting (new
+        studies and asks answer 503/``DrainingError`` immediately, tells
+        keep landing), wait for in-flight waves to finish, compact +
+        close the WAL, then stop serving.  Returns True when the
+        scheduler quiesced within ``timeout``."""
+        quiesced = self.scheduler.drain(timeout=timeout)
+        self.stop()
+        return quiesced
+
     def stop(self):
         if self._stopped:
             return
@@ -266,6 +371,17 @@ def _make_handler(server):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            if (status in (429, 503) and isinstance(payload, dict)
+                    and payload.get("retry_after") is not None):
+                # RFC 7231 delta-seconds is an INTEGER — a fractional
+                # header is discarded by standard clients/proxies.  The
+                # wire header rounds up; the JSON payload keeps the
+                # precise float for service/client.py
+                import math
+
+                self.send_header(
+                    "Retry-After",
+                    str(max(1, math.ceil(float(payload["retry_after"])))))
             self.end_headers()
             self.wfile.write(data)
 
@@ -273,6 +389,7 @@ def _make_handler(server):
             path = self.path.partition("?")[0]
             try:
                 if method == "GET" and path == "/metrics":
+                    server._count_response(method, path, 200)
                     self._answer(
                         200, prometheus_text().encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
@@ -292,7 +409,9 @@ def _make_handler(server):
                                            "error": "body must be a JSON "
                                                     "object"})
                         return
-                status, payload = server.handle(method, path, body)
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                status, payload = server.handle(method, path, body,
+                                                headers=headers)
                 self._answer(status, payload)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # client went away mid-write
@@ -339,6 +458,10 @@ def main(argv=None):
                    help="evict a study's cohort slot after this much "
                         "inactivity (default: "
                         "$HYPEROPT_TPU_SERVICE_IDLE_SEC or 600)")
+    p.add_argument("--wal", default=None,
+                   help="write-ahead journal: 'auto' (default — under "
+                        "--store when given), 'off', or an explicit path "
+                        "(default: $HYPEROPT_TPU_SERVICE_WAL)")
     p.add_argument("--announce", action="store_true",
                    help="print 'SERVICE_URL <url>' once bound (harness "
                         "handshake)")
@@ -347,23 +470,45 @@ def main(argv=None):
     port = args.port if args.port is not None else parse_service()
     if port is None:
         p.error("no port: pass --port or set HYPEROPT_TPU_SERVICE")
+    wal = None  # env-resolved
+    if args.wal is not None:
+        # the SAME token sets as _env.parse_service_wal — '--wal true'
+        # must not create a journal file literally named 'true'
+        raw = args.wal.strip().lower()
+        if raw in ("auto", "", "1", "on", "true", "yes"):
+            wal = None
+        elif raw in ("off", "0", "false", "no"):
+            wal = False
+        else:
+            wal = args.wal
     sched = StudyScheduler(max_studies=args.max_studies,
                            max_pending=args.max_pending,
                            idle_sec=args.idle_sec,
                            store_root=args.store,
+                           wal=wal,
                            wave_window=0.005)
     server = ServiceHTTPServer(port, scheduler=sched)
     if not server.start():
         return 1
     if args.announce:
         print(f"SERVICE_URL {server.url}", flush=True)
+
+    # graceful drain on SIGTERM: stop admitting, finish in-flight waves,
+    # compact + close the WAL, exit 0 — a supervised restart (or spot
+    # preemption with notice) must not look like a crash
+    import signal
+
+    stop = threading.Event()
+    prev = signal.signal(signal.SIGTERM, lambda _s, _f: stop.set())
     try:
-        while True:
-            time.sleep(1.0)
+        while not stop.is_set():
+            stop.wait(0.5)
     except KeyboardInterrupt:
         pass
     finally:
-        server.stop()
+        signal.signal(signal.SIGTERM, prev)
+        quiesced = server.drain()
+        logger.info("service: drained (quiesced=%s); exiting", quiesced)
     return 0
 
 

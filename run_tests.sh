@@ -34,6 +34,13 @@
 # service suites and then scripts/service_smoke.py — a real subprocess
 # server drives 100 concurrent HTTP studies to convergence, with the
 # /studies table and /metrics exposition linted.
+# Opt-in service chaos gate: SERVICE_CHAOS_GATE=1 additionally re-runs
+# the durability suites and then scripts/service_chaos_smoke.py — a
+# real subprocess server is SIGKILLed mid-wave under concurrent HTTP
+# traffic and restarted on the same store root; every study must finish
+# bit-identical to an undisturbed reference, 2x-capacity overload must
+# shed with 429/Retry-After and lose zero tells, and injected tick
+# faults must walk the degrade ladder without killing the server.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -79,5 +86,11 @@ if [ "${SERVICE_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_service.py tests/test_batched_suggest.py \
         -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/service_smoke.py || exit 1
+fi
+if [ "${SERVICE_CHAOS_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_journal.py tests/test_overload.py \
+        tests/test_service.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/service_chaos_smoke.py || exit 1
 fi
 exit 0

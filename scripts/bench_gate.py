@@ -79,6 +79,11 @@ DEFAULT_THRESHOLDS = {
     "studies_per_sec": 0.25,
     "study_ask_p99_ms": 1.00,
     "slot_utilization_frac": 0.15,
+    # durable serving plane (bench.py service_resume stage): restart
+    # availability gap (compile-dominated, loose) and the 2x-capacity
+    # shed fraction (a collapse toward zero = backpressure broke)
+    "resume_latency_sec": 1.00,
+    "shed_rate_frac": 0.60,
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
@@ -86,12 +91,14 @@ _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                  "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                  "peak_hbm_bytes", "history_bytes",
                  "studies_per_sec", "study_ask_p99_ms",
-                 "slot_utilization_frac")
+                 "slot_utilization_frac",
+                 "resume_latency_sec", "shed_rate_frac")
 
 # latency and peak-memory metrics regress UPWARD
 LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                    "study_ask_p99_ms",
-                   "peak_hbm_bytes", "history_bytes")
+                   "peak_hbm_bytes", "history_bytes",
+                   "resume_latency_sec")
 
 
 def bench_files(root):

@@ -28,7 +28,6 @@ import subprocess
 import sys
 import threading
 import time
-import urllib.error
 import urllib.request
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,17 +42,6 @@ N_WORKERS = 12
 # Prior best-of-24 over U(-5,5) has median |x-c| ~ 0.29 -> loss ~ 0.085;
 # TPE reliably lands well under this; a broken posterior does not.
 CONVERGENCE_BAR = 0.25
-
-
-def _post(url, path, body, timeout=60):
-    req = urllib.request.Request(
-        url + path, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
 
 
 def _get(url, path, timeout=60):
@@ -133,7 +121,14 @@ def main():
         lock = threading.Lock()
         work = list(range(N_STUDIES))
 
+        from hyperopt_tpu.service import ServiceClient
+
         def drive():
+            # the retry-aware client (service/client.py): 429/503 +
+            # Retry-After and connection resets are honored with
+            # deterministic jittered backoff — no ad-hoc sleep loops
+            client = ServiceClient(url, retry=8,
+                                   key=threading.get_ident())
             while True:
                 with lock:
                     if not work:
@@ -141,25 +136,16 @@ def main():
                     i = work.pop()
                 offset = -4.0 + 8.0 * i / (N_STUDIES - 1)
                 try:
-                    code, r = _post(url, "/study", {
-                        "space": {"x": {"dist": "uniform",
-                                        "args": [-5, 5]}},
-                        "seed": 1000 + i,
-                        "n_startup_jobs": N_STARTUP,
-                        "max_trials": BUDGET})
-                    assert code == 200, r
-                    sid = r["study_id"]
+                    sid = client.create_study(
+                        space={"x": {"dist": "uniform", "args": [-5, 5]}},
+                        seed=1000 + i, n_startup_jobs=N_STARTUP,
+                        max_trials=BUDGET)
                     best = float("inf")
                     for _ in range(BUDGET):
-                        code, a = _post(url, "/ask", {"study_id": sid})
-                        assert code == 200, a
-                        t = a["trials"][0]
+                        t = client.ask(sid)[0]
                         loss = (t["params"]["x"] - offset) ** 2
                         best = min(best, loss)
-                        code, told = _post(url, "/tell", {
-                            "study_id": sid, "tid": t["tid"],
-                            "loss": loss})
-                        assert code == 200, told
+                        client.tell(sid, t["tid"], loss)
                     with lock:
                         results[sid] = (BUDGET, best)
                 except Exception as e:  # noqa: BLE001

@@ -1,0 +1,43 @@
+"""Child process for the WAL crash-resume tier-1 test (test_journal.py).
+
+Drives a store+WAL-backed :class:`StudyScheduler` through ask/tell
+traffic with a chaos ``kill@tick`` schedule armed via the environment —
+the process SIGKILLs ITSELF mid-wave (inside a cohort-tick dispatch:
+after the id allocation and seed draw, before anything journals or
+lands).  The parent then resumes on the same store root and pins the
+combined history bitwise against an undisturbed reference.
+
+Usage: python _service_child.py <store_root> <n_studies> <budget>
+(HYPEROPT_TPU_CHAOS armed by the parent.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperopt_tpu import hp  # noqa: E402
+from hyperopt_tpu.service import StudyScheduler  # noqa: E402
+
+
+def main():
+    store_root, n_studies, budget = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    space = {"x": hp.uniform("x", -5, 5)}
+    spec = {"space": {"x": {"dist": "uniform", "args": [-5, 5]}}}
+    sched = StudyScheduler(store_root=store_root, max_studies=64)
+    sids = [sched.create_study(space, seed=500 + i, n_startup_jobs=3,
+                               study_id=f"study-child{i}",
+                               space_spec=spec)
+            for i in range(n_studies)]
+    for _ in range(budget):
+        for i, sid in enumerate(sids):
+            a = sched.ask(sid)[0]  # chaos kill@tick fires in here
+            loss = float((a["params"]["x"] - (i - 1.0)) ** 2)
+            sched.tell(sid, a["tid"], loss)
+    print("CHILD_FINISHED_WITHOUT_KILL", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

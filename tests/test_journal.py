@@ -1,0 +1,404 @@
+"""ISSUE 10: the service write-ahead journal — record round trip, torn
+lines, crash-resume bitwise pins, duplicate-tell idempotency, quota
+grandfathering, compaction, and the real-SIGKILL subprocess resume.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.service import StudyJournal, StudyQuotaError, StudyScheduler
+from hyperopt_tpu.service.journal import JournalError, wal_path_for
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPEC = {"space": {"x": {"dist": "uniform", "args": [-5, 5]}}}
+
+
+def _drive(sched, sid, n):
+    """n serial ask->tell rounds; returns [(tid, repr(x))] (repr is the
+    bitwise float comparison)."""
+    seq = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        loss = float((a["params"]["x"] - 2.0) ** 2)
+        sched.tell(sid, a["tid"], loss)
+        seq.append((a["tid"], repr(a["params"]["x"])))
+    return seq
+
+
+def _reference(seed, n, n_startup=3):
+    ref = StudyScheduler(wal=False)
+    sid = ref.create_study(SPACE, seed=seed, n_startup_jobs=n_startup)
+    return _drive(ref, sid, n)
+
+
+# ---------------------------------------------------------------------------
+# journal mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_journal_round_trip(tmp_path):
+    j = StudyJournal(str(tmp_path / "wal.jsonl"))
+    recs = [StudyJournal.admit_rec("s1", SPEC, 7, {"max_trials": 4}),
+            StudyJournal.ask_rec("s1", [0, 1], 1234, "tpe"),
+            StudyJournal.tell_rec("s1", 0, 0.5, None),
+            StudyJournal.close_rec("s1")]
+    for r in recs:
+        j.append(r)
+    j.sync()
+    back = list(j.records())
+    assert [r["kind"] for r in back] == ["admit", "ask", "tell", "close"]
+    assert back[1]["tids"] == [0, 1] and back[1]["seed"] == 1234
+    assert back[2]["loss"] == 0.5
+    assert j.appends == 4 and j.syncs == 1
+
+
+def test_journal_torn_final_line(tmp_path):
+    """The crash artifact batched fsync allows: a half-written last line
+    is skipped by the reader, never fatal."""
+    path = str(tmp_path / "wal.jsonl")
+    j = StudyJournal(path)
+    j.append(StudyJournal.admit_rec("s1", SPEC, 7, {}))
+    j.append(StudyJournal.ask_rec("s1", [0], 99, "rand"))
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"kind": "tell", "sid": "s1", "tid": 0, "lo')  # torn
+    back = list(StudyJournal(path).records())
+    assert [r["kind"] for r in back] == ["admit", "ask"]
+
+
+def test_journal_rewrite_then_append(tmp_path):
+    """Compaction-vs-concurrent-append: an append after rewrite lands in
+    the NEW file (the handle reopens), and the reader sees snapshot
+    followed by the append."""
+    path = str(tmp_path / "wal.jsonl")
+    j = StudyJournal(path)
+    for i in range(10):
+        j.append(StudyJournal.ask_rec("s1", [i], i, "tpe"))
+    j.sync()
+    j.rewrite([{"kind": "snapshot", "sid": "s1"}])
+    j.append(StudyJournal.tell_rec("s1", 3, 1.0, None))
+    j.sync()
+    kinds = [r["kind"] for r in j.records()]
+    assert kinds == ["snapshot", "tell"]
+    assert j.compactions == 1
+
+
+def test_journal_append_failure_is_typed(tmp_path):
+    d = tmp_path / "gone"
+    j = StudyJournal(str(d / "wal.jsonl"))
+    os.rmdir(str(d))  # journal dir vanishes under it
+    with pytest.raises(JournalError):
+        j.append({"kind": "ask"})
+
+
+# ---------------------------------------------------------------------------
+# crash-resume bitwise pins
+# ---------------------------------------------------------------------------
+
+
+def test_crash_resume_bitwise_wal_only(tmp_path):
+    """Without a store the WAL alone regenerates every ask: resumed
+    proposals continue bit-identically to an uninterrupted run."""
+    ref = _reference(7, 12)
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    sid = s1.create_study(SPACE, seed=7, n_startup_jobs=3,
+                          space_spec=SPEC, study_id="study-a")
+    first = _drive(s1, sid, 7)
+    del s1  # crash: no drain, no compaction
+    s2 = StudyScheduler(wal=wal)
+    assert s2.last_resume["studies"] == 1
+    assert s2.last_resume["regenerated"] == 7
+    assert s2.last_resume["errors"] == 0
+    rest = _drive(s2, sid, 5)
+    assert first + rest == ref
+
+
+def test_crash_resume_bitwise_with_store(tmp_path):
+    """With a store the WAL re-admits + realigns the seed stream; docs
+    come from disk (nothing regenerated) and a pending (asked, untold)
+    trial survives the crash."""
+    ref_sched = StudyScheduler(wal=False)
+    ref_sid = ref_sched.create_study(SPACE, seed=9, n_startup_jobs=3)
+    ref_first = _drive(ref_sched, ref_sid, 6)
+    ref_pend = ref_sched.ask(ref_sid)[0]
+    ref_sched.tell(ref_sid, ref_pend["tid"], 0.25)
+    ref_rest = _drive(ref_sched, ref_sid, 4)
+
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    assert s1.journal is not None
+    assert s1.journal.path == wal_path_for(root)
+    sid = s1.create_study(SPACE, seed=9, n_startup_jobs=3,
+                          space_spec=SPEC, study_id=ref_sid)
+    first = _drive(s1, sid, 6)
+    pend = s1.ask(sid)[0]  # in-flight at the crash
+    del s1
+    s2 = StudyScheduler(store_root=root)
+    st = s2.study_status(sid)
+    assert st["n_pending"] == 1 and st["n_trials"] == 7
+    assert s2.last_resume["regenerated"] == 0  # store had every doc
+    assert (pend["tid"], repr(pend["params"]["x"])) == \
+        (ref_pend["tid"], repr(ref_pend["params"]["x"]))
+    s2.tell(sid, pend["tid"], 0.25)
+    rest = _drive(s2, sid, 4)
+    assert first == ref_first and rest == ref_rest
+
+
+def test_resume_twice_is_idempotent(tmp_path):
+    """Resuming, crashing again immediately and resuming again replays
+    to the same state (duplicate tells skipped, nothing double-folds)."""
+    ref = _reference(11, 10)
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    sid = s1.create_study(SPACE, seed=11, n_startup_jobs=3,
+                          space_spec=SPEC, study_id="study-b")
+    first = _drive(s1, sid, 6)
+    del s1
+    s2 = StudyScheduler(wal=wal)  # resume #1, crash untouched
+    del s2
+    s3 = StudyScheduler(wal=wal)  # resume #2
+    assert s3.last_resume["errors"] == 0
+    rest = _drive(s3, sid, 4)
+    assert first + rest == ref
+
+
+def test_duplicate_tell_replay_skipped(tmp_path):
+    """A tell journaled AND settled into the store before the crash
+    replays as a no-op (exactly-once: the posterior never folds it
+    twice, n_told stays correct)."""
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    sid = s1.create_study(SPACE, seed=3, n_startup_jobs=2,
+                          space_spec=SPEC)
+    _drive(s1, sid, 4)
+    # simulate the crash window: duplicate the last tell record in the
+    # WAL (journal says it twice, store settled it once)
+    with open(s1.journal.path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    last_tell = next(ln for ln in reversed(lines)
+                     if json.loads(ln)["kind"] == "tell")
+    with open(s1.journal.path, "a") as f:
+        f.write(last_tell + "\n")
+    del s1
+    s2 = StudyScheduler(store_root=root)
+    assert s2.last_resume["duplicate_tells"] >= 1
+    assert s2.last_resume["errors"] == 0
+    st = s2.study_status(sid)
+    assert st["n_told"] == 4 and st["n_pending"] == 0
+
+
+def test_resume_with_smaller_max_studies(tmp_path):
+    """Journaled studies are grandfathered past a SHRUNKEN admission
+    quota (resume must not silently drop state); the quota still blocks
+    NEW admissions."""
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root, max_studies=8)
+    sids = [s1.create_study(SPACE, seed=i, n_startup_jobs=2,
+                            space_spec=SPEC) for i in range(4)]
+    for sid in sids:
+        _drive(s1, sid, 3)
+    del s1
+    s2 = StudyScheduler(store_root=root, max_studies=2)
+    assert s2.last_resume["studies"] == 4
+    assert {s["study_id"] for s in s2.studies_status()["studies"]} \
+        == set(sids)
+    with pytest.raises(StudyQuotaError):
+        s2.create_study(SPACE, seed=99)
+    # the grandfathered studies still serve
+    a = s2.ask(sids[0])[0]
+    s2.tell(sids[0], a["tid"], 0.1)
+
+
+def test_compaction_on_settle(tmp_path):
+    """A settled (max_trials reached) study compacts the WAL: live
+    studies become one snapshot record each, the settled study's
+    records drop, and a resume from the compacted WAL continues
+    bit-identically."""
+    ref = _reference(21, 12)
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    done_sid = s1.create_study(SPACE, seed=50, n_startup_jobs=2,
+                               max_trials=3, space_spec=SPEC)
+    live_sid = s1.create_study(SPACE, seed=21, n_startup_jobs=3,
+                               space_spec=SPEC, study_id="study-live")
+    first = _drive(s1, live_sid, 7)
+    _drive(s1, done_sid, 3)  # settles -> compaction
+    recs = list(s1.journal.records())
+    kinds = {r["kind"] for r in recs}
+    assert kinds == {"snapshot"}, kinds
+    assert [r["sid"] for r in recs] == [live_sid]
+    del s1
+    s2 = StudyScheduler(store_root=root)
+    rest = _drive(s2, live_sid, 5)
+    assert first + rest == ref
+    # the settled study's registry entry is forgotten by design
+    assert done_sid not in {s["study_id"]
+                            for s in s2.studies_status()["studies"]}
+
+
+def test_void_ask_keeps_streams_aligned(tmp_path, monkeypatch):
+    """A failed ask consumed a seed draw; the void WAL record replays
+    that draw, so post-resume proposals match the live-continued run."""
+    from hyperopt_tpu.service import scheduler as sched_mod
+
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal, degrade=False)
+    sid = s1.create_study(SPACE, seed=13, n_startup_jobs=2,
+                          space_spec=SPEC, study_id="study-v")
+    first = _drive(s1, sid, 4)
+    # one ask fails host-side (NOT a device fault: ladder disarmed and
+    # the error is a host bug class) -> void record
+    orig = sched_mod._Cohort.tick
+
+    def boom(self, *a, **k):
+        raise ValueError("host bug")
+
+    monkeypatch.setattr(sched_mod._Cohort, "tick", boom)
+    with pytest.raises(ValueError):
+        s1.ask(sid)
+    monkeypatch.setattr(sched_mod._Cohort, "tick", orig)
+    live_rest = _drive(s1, sid, 3)
+
+    s2 = StudyScheduler(wal=wal, degrade=False)
+    assert s2.last_resume["errors"] == 0
+    # both the live scheduler and the resumed one now continue from the
+    # same post-failure state: their NEXT proposals must be identical
+    # (same wasted draw, same retired tid, same history)
+    live_more = _drive(s1, sid, 3)
+    resumed_more = _drive(s2, "study-v", 3)
+    assert resumed_more == live_more
+    assert first and live_rest  # shape guard: both phases really ran
+
+
+def test_unresumable_study_is_counted(tmp_path, caplog):
+    """A study admitted without a wire spec journals spec=None; replay
+    skips it and counts it instead of erroring the whole resume."""
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    s1.create_study(SPACE, seed=1, n_startup_jobs=2)  # no space_spec
+    sid2 = s1.create_study(SPACE, seed=2, n_startup_jobs=2,
+                           space_spec=SPEC)
+    del s1
+    s2 = StudyScheduler(wal=wal)
+    assert s2.last_resume["studies"] == 1
+    assert s2.last_resume["skipped"] >= 1
+    assert [s["study_id"] for s in s2.studies_status()["studies"]] \
+        == [sid2]
+
+
+def test_wal_disabled_modes(tmp_path, monkeypatch):
+    assert StudyScheduler(wal=False).journal is None
+    assert StudyScheduler().journal is None  # no store, auto mode
+    monkeypatch.setenv("HYPEROPT_TPU_SERVICE_WAL", "off")
+    assert StudyScheduler(store_root=str(tmp_path)).journal is None
+    monkeypatch.setenv("HYPEROPT_TPU_SERVICE_WAL",
+                       str(tmp_path / "explicit.jsonl"))
+    s = StudyScheduler()
+    assert s.journal is not None
+    assert s.journal.path == str(tmp_path / "explicit.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL mid-wave in a subprocess, resume in-process
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_subprocess_resume_bitwise(tmp_path):
+    """The acceptance pin: a real process is SIGKILLed inside a cohort
+    tick (chaos ``kill@tick``), the parent resumes on the same store
+    root, finishes every study's budget, and the complete per-study
+    histories are bit-identical to an undisturbed reference."""
+    from hyperopt_tpu._env import forced_cpu_env
+
+    n_studies, budget = 3, 8
+    root = str(tmp_path / "store")
+    env = forced_cpu_env(os.environ)
+    env["HYPEROPT_TPU_CHAOS"] = "13:kill@tick:4"
+    child = os.path.join(os.path.dirname(__file__), "_service_child.py")
+    proc = subprocess.run(
+        [sys.executable, child, root, str(n_studies), str(budget)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stdout, proc.stderr)
+    assert "CHILD_FINISHED_WITHOUT_KILL" not in proc.stdout
+
+    # resume on the same root and drive every study to its budget
+    sched = StudyScheduler(store_root=root, max_studies=64)
+    assert sched.last_resume["studies"] == n_studies
+    assert sched.last_resume["errors"] == 0
+    for i in range(n_studies):
+        sid = f"study-child{i}"
+        st = sched._studies[sid]
+        # tell any pending (asked-untold) docs first, as the child would
+        for d in list(st.trials._dynamic_trials):
+            if d["state"] == 0:  # JOB_STATE_NEW
+                x = float(d["misc"]["vals"]["x"][0])
+                sched.tell(sid, d["tid"], float((x - (i - 1.0)) ** 2))
+        while sched.study_status(sid)["n_trials"] < budget:
+            a = sched.ask(sid)[0]
+            loss = float((a["params"]["x"] - (i - 1.0)) ** 2)
+            sched.tell(sid, a["tid"], loss)
+
+    # undisturbed reference, same seeds/order as the child
+    ref = StudyScheduler(wal=False, max_studies=64)
+    for i in range(n_studies):
+        rsid = ref.create_study(SPACE, seed=500 + i, n_startup_jobs=3,
+                                study_id=f"study-ref{i}")
+        for _ in range(budget):
+            a = ref.ask(rsid)[0]
+            loss = float((a["params"]["x"] - (i - 1.0)) ** 2)
+            ref.tell(rsid, a["tid"], loss)
+
+    for i in range(n_studies):
+        mine = sched._studies[f"study-child{i}"].trials
+        theirs = ref._studies[f"study-ref{i}"].trials
+        got = sorted((d["tid"], repr(float(d["misc"]["vals"]["x"][0])))
+                     for d in mine._dynamic_trials)
+        want = sorted((d["tid"], repr(float(d["misc"]["vals"]["x"][0])))
+                      for d in theirs._dynamic_trials)
+        assert got == want, f"study {i} diverged after SIGKILL resume"
+
+
+def test_land_failure_never_double_journals(tmp_path, monkeypatch):
+    """A doc-landing failure AFTER the served-ask record is journaled
+    must not also journal a void record: two records would replay the
+    one seed draw twice and diverge every later proposal."""
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal, degrade=False)
+    sid = s1.create_study(SPACE, seed=31, n_startup_jobs=2,
+                          space_spec=SPEC, study_id="study-lf")
+    first = _drive(s1, sid, 4)
+
+    orig_land = StudyScheduler._land
+    fail_once = {"armed": True}
+
+    def flaky_land(self, st, docs):
+        if fail_once.pop("armed", False):
+            raise OSError("disk full")
+        return orig_land(self, st, docs)
+
+    monkeypatch.setattr(StudyScheduler, "_land", flaky_land)
+    with pytest.raises(OSError):
+        s1.ask(sid)
+    live_rest = _drive(s1, sid, 3)
+
+    # exactly ONE ask record per draw for this study (no void shadow
+    # behind the journaled-but-unlanded record)
+    draws = [r for r in StudyJournal(wal).records()
+             if r["kind"] == "ask" and r["sid"] == sid]
+    assert len(draws) == 4 + 1 + 3
+    assert sum(1 for r in draws if r.get("algo") == "void") == 0
+
+    s2 = StudyScheduler(wal=wal, degrade=False)
+    assert s2.last_resume["errors"] == 0
+    live_more = _drive(s1, sid, 3)
+    resumed_more = _drive(s2, "study-lf", 3)
+    assert resumed_more == live_more
+    assert first and live_rest

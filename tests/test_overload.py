@@ -1,0 +1,423 @@
+"""ISSUE 10: overload control (deadlines, bounded admission, shed
+breaker, Retry-After EWMA) and the device-fault degrade ladder —
+policy-object tests with fake clocks plus scheduler/server integration
+pins (disarmed behavior bit-identical; faults walk the ladder and
+recover; drain refuses admissions but lands tells).
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.obs.metrics import get_metrics, reset_metrics
+from hyperopt_tpu.service import StudyScheduler
+from hyperopt_tpu.service.overload import (LADDER_LEVELS, AdmissionGuard,
+                                           Deadline, DeadlineExceeded,
+                                           DegradeLadder, NonFiniteProposal,
+                                           OverloadError, is_device_fault)
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_header_tightens_never_loosens():
+    clk = FakeClock()
+    d = Deadline.from_request("500", 30000.0, clock=clk)
+    assert d.remaining() == pytest.approx(0.5)
+    d = Deadline.from_request("60000", 30000.0, clock=clk)
+    assert d.remaining() == pytest.approx(30.0)  # server default wins
+    d = Deadline.from_request(None, None, clock=clk)
+    assert d.remaining() is None and not d.expired()
+    d = Deadline.from_request("garbage", 1000.0, clock=clk)
+    assert d.remaining() == pytest.approx(1.0)  # bad header ignored
+    d = Deadline.from_request("-5", None, clock=clk)
+    assert d.remaining() is None  # non-positive header ignored
+
+
+def test_deadline_is_monotonic_and_checks():
+    clk = FakeClock()
+    d = Deadline(100.0, clock=clk)
+    assert not d.expired()
+    clk.t += 0.2
+    assert d.expired() and d.remaining() == 0.0
+    with pytest.raises(DeadlineExceeded):
+        d.check("ask")
+
+
+# ---------------------------------------------------------------------------
+# AdmissionGuard
+# ---------------------------------------------------------------------------
+
+
+def test_guard_bounds_asks_and_releases():
+    g = AdmissionGuard(max_queue=2)
+    t1 = g.admit_ask()
+    t2 = g.admit_ask()
+    with pytest.raises(OverloadError):
+        g.admit_ask()
+    g.release(t1)
+    t3 = g.admit_ask()  # freed slot admits again
+    g.release(t2)
+    g.release(t3)
+
+
+def test_guard_sheds_ask_before_tell():
+    """The breaker: tells get TELL_SLACK x the ask bound."""
+    g = AdmissionGuard(max_queue=1)
+    g.admit_ask()
+    with pytest.raises(OverloadError):
+        g.admit_ask()
+    tokens = [g.admit_tell() for _ in range(g.TELL_SLACK)]
+    with pytest.raises(OverloadError):
+        g.admit_tell()
+    for t in tokens:
+        g.release(t)
+
+
+def test_guard_retry_after_tracks_wave_ewma():
+    g = AdmissionGuard(max_queue=2)
+    for _ in range(10):
+        g.observe_wave(0.8)
+    assert g.wave_ewma() == pytest.approx(0.8, rel=0.05)
+    g.admit_ask()
+    g.admit_ask()
+    with pytest.raises(OverloadError) as ei:
+        g.admit_ask()
+    assert ei.value.retry_after == pytest.approx(0.8, rel=0.05)
+    # measured from the EWMA, not the 50ms cold floor
+    g2 = AdmissionGuard(max_queue=2)
+    g2.admit_ask()
+    g2.admit_ask()
+    with pytest.raises(OverloadError) as ei:
+        g2.admit_ask()
+    assert ei.value.retry_after == pytest.approx(0.05)  # cold floor
+
+
+def test_guard_sheds_unservable_deadline():
+    clk = FakeClock()
+    g = AdmissionGuard(max_queue=8, clock=clk)
+    for _ in range(10):
+        g.observe_wave(2.0)  # waves take ~2s
+    tight = Deadline(100.0, clock=clk)  # 100ms budget
+    with pytest.raises(OverloadError):
+        g.admit_ask(tight)
+    roomy = Deadline(10000.0, clock=clk)
+    g.release(g.admit_ask(roomy))
+    # cold guard (no EWMA yet) admits and learns
+    g2 = AdmissionGuard(max_queue=8, clock=clk)
+    g2.release(g2.admit_ask(Deadline(1.0, clock=clk)))
+
+
+def test_guard_counts_sheds_in_metrics():
+    reset_metrics("ovl_test")
+    m = get_metrics("ovl_test")
+    g = AdmissionGuard(max_queue=1, metrics=m)
+    g.admit_ask()
+    with pytest.raises(OverloadError):
+        g.admit_ask()
+    snap = m.snapshot()["metrics"]
+    assert snap["service.shed.ask"] == 1
+    assert snap["service.queue_depth"] == 1
+
+
+# ---------------------------------------------------------------------------
+# DegradeLadder
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_walks_down_and_recovers():
+    lad = DegradeLadder(recover_after=3)
+    assert lad.level() == 0 and not lad.degraded
+    assert lad.record_fault() == 1
+    assert lad.record_fault() == 2
+    assert lad.record_fault() == 3
+    assert lad.record_fault() == 3  # floor holds
+    assert lad.spec()["rand"] is True
+    for _ in range(2):
+        assert lad.record_clean_wave() == 3
+    assert lad.record_clean_wave() == 2  # probe up after patience
+    assert lad.record_fault() == 3  # probe failed: straight back down
+    for _ in range(3 * 3):
+        lad.record_clean_wave()
+    assert lad.level() == 0
+    assert ("down", 0, 1) in lad.transitions
+    assert ("up", 3, 2) in lad.transitions
+
+
+def test_ladder_levels_shape():
+    assert LADDER_LEVELS[0]["cand_scale"] == 1.0
+    assert LADDER_LEVELS[1]["cand_scale"] == 0.5
+    assert LADDER_LEVELS[2]["cap_limit"] == 64
+    assert LADDER_LEVELS[3]["rand"] is True
+
+
+def test_is_device_fault_classification():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert is_device_fault(OSError("chaos: injected I/O error at tick"))
+    assert is_device_fault(NonFiniteProposal("nan"))
+    assert is_device_fault(XlaRuntimeError("boom"))
+    assert is_device_fault(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert is_device_fault(RuntimeError("INVALID_ARGUMENT: lowering"))
+    assert not is_device_fault(ValueError("host bug"))
+    assert not is_device_fault(KeyError("host bug"))
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration
+# ---------------------------------------------------------------------------
+
+
+def _drive(sched, sid, n):
+    out = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], float((a["params"]["x"]) ** 2))
+        out.append((a["tid"], repr(a["params"]["x"]), a.get("degraded")))
+    return out
+
+
+def test_no_faults_means_bit_identical_to_unarmed():
+    """The determinism pin: an armed ladder that never faults serves
+    proposals bit-identical to a ladder-free scheduler."""
+    plain = StudyScheduler(wal=False, degrade=False)
+    armed = StudyScheduler(wal=False, degrade=8)
+    ps = plain.create_study(SPACE, seed=77, n_startup_jobs=3)
+    as_ = armed.create_study(SPACE, seed=77, n_startup_jobs=3)
+    a = _drive(plain, ps, 10)
+    b = _drive(armed, as_, 10)
+    assert [x[:2] for x in a] == [x[:2] for x in b]
+    assert not any(x[2] for x in b)  # nothing flagged degraded
+    assert armed.degrade.level() == 0 and armed.degrade.faults == 0
+
+
+def test_tick_faults_walk_to_rand_and_recover(monkeypatch):
+    """Persistent device faults degrade to flagged rand service without
+    ever failing an ask; clean waves climb back to full quality."""
+    from hyperopt_tpu.service import scheduler as sched_mod
+
+    sched = StudyScheduler(wal=False, degrade=2)
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2)
+    _drive(sched, sid, 2)  # startup rand
+
+    orig = sched_mod._Cohort.tick
+
+    def oom(self, *a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                           "allocating")
+
+    monkeypatch.setattr(sched_mod._Cohort, "tick", oom)
+    seen_degraded = []
+    for _ in range(5):
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], 1.0)
+        seen_degraded.append(a.get("degraded"))
+    assert all(seen_degraded), seen_degraded
+    assert sched.degrade.level() == 3  # rand floor under permanent OOM
+    a = sched.ask(sid)[0]
+    assert a["algo"] == "rand"
+    sched.tell(sid, a["tid"], 1.0)
+
+    monkeypatch.setattr(sched_mod._Cohort, "tick", orig)
+    flags = []
+    for _ in range(12):
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], 1.0)
+        flags.append(bool(a.get("degraded")))
+    assert sched.degrade.level() == 0  # fully recovered
+    assert flags[-1] is False
+    snap = sched.metrics.snapshot()["metrics"]
+    assert snap["service.degrade.down"] >= 3
+    assert snap["service.degrade.up"] >= 3
+    assert snap["service.degraded"] == 0
+
+
+def test_non_finite_proposals_are_a_fault(monkeypatch):
+    """NaN readback (poisoned posterior) is treated as a device fault:
+    the wave retries down-ladder and ultimately serves finite rand
+    proposals instead of handing the client NaN."""
+    from hyperopt_tpu.service import scheduler as sched_mod
+
+    sched = StudyScheduler(wal=False, degrade=4)
+    sid = sched.create_study(SPACE, seed=6, n_startup_jobs=2)
+    _drive(sched, sid, 2)
+
+    orig = sched_mod._Cohort.tick
+
+    def nan_tick(self, demand, **k):
+        L = len(self.cs.labels)
+        B = max(len(ids) for ids, _ in demand.values())
+        return np.full((self.n_slots, B, L), np.nan, np.float32)
+
+    monkeypatch.setattr(sched_mod._Cohort, "tick", nan_tick)
+    a = sched.ask(sid)[0]
+    assert np.isfinite(a["params"]["x"])
+    assert a.get("degraded") and a.get("algo") == "rand"
+    assert sched.degrade.faults >= 1
+    monkeypatch.setattr(sched_mod._Cohort, "tick", orig)
+
+
+def test_ladder_disabled_fails_the_ask(monkeypatch):
+    from hyperopt_tpu.service import scheduler as sched_mod
+
+    sched = StudyScheduler(wal=False, degrade=False)
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2)
+    _drive(sched, sid, 2)
+    monkeypatch.setattr(
+        sched_mod._Cohort, "tick",
+        lambda self, *a, **k: (_ for _ in ()).throw(
+            RuntimeError("RESOURCE_EXHAUSTED")))
+    with pytest.raises(RuntimeError):
+        sched.ask(sid)
+    assert sched.study_status(sid)["n_pending"] == 0  # quota released
+
+
+def test_ask_deadline_expired_sheds_cleanly():
+    clk = FakeClock()
+    sched = StudyScheduler(wal=False)
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2)
+    d = Deadline(50.0, clock=clk)
+    clk.t += 1.0  # expired before entry
+    with pytest.raises(DeadlineExceeded):
+        sched.ask(sid, deadline=d)
+    assert sched.study_status(sid)["n_pending"] == 0
+
+
+def test_drain_refuses_admissions_but_lands_tells(tmp_path):
+    from hyperopt_tpu.service import DrainingError
+
+    sched = StudyScheduler(store_root=str(tmp_path))
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2,
+                             space_spec={"space": {
+                                 "x": {"dist": "uniform",
+                                       "args": [-5, 5]}}})
+    a = sched.ask(sid)[0]
+    assert sched.drain(timeout=5.0) is True
+    with pytest.raises(DrainingError):
+        sched.ask(sid)
+    with pytest.raises(DrainingError):
+        sched.create_study(SPACE, seed=9)
+    sched.tell(sid, a["tid"], 0.5)  # the in-flight result still lands
+    assert sched.study_status(sid)["n_pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# server integration
+# ---------------------------------------------------------------------------
+
+
+def test_server_sheds_with_retry_after():
+    sched = StudyScheduler(wal=False)
+    guard = AdmissionGuard(max_queue=1, metrics=sched.metrics)
+    server = ServiceHTTPServer(0, scheduler=sched, guard=guard)
+    code, r = server.handle("POST", "/study", {
+        "space": {"x": {"dist": "uniform", "args": [-5, 5]}},
+        "seed": 1, "n_startup_jobs": 1})
+    assert code == 200
+    sid = r["study_id"]
+    guard.admit_ask()  # occupy the only slot
+    code, r = server.handle("POST", "/ask", {"study_id": sid})
+    assert code == 429
+    assert r["ok"] is False and r["retry_after"] > 0
+
+
+def test_server_deadline_header_is_honored():
+    clk = FakeClock()
+    sched = StudyScheduler(wal=False)
+    guard = AdmissionGuard(max_queue=8, metrics=sched.metrics, clock=clk)
+    for _ in range(10):
+        guard.observe_wave(5.0)  # very slow waves
+    server = ServiceHTTPServer(0, scheduler=sched, guard=guard)
+    code, r = server.handle("POST", "/study", {
+        "space": {"x": {"dist": "uniform", "args": [-5, 5]}},
+        "seed": 1, "n_startup_jobs": 1})
+    sid = r["study_id"]
+    code, r = server.handle("POST", "/ask", {"study_id": sid},
+                            headers={"x-deadline-ms": "100"})
+    assert code == 429  # predicted wait 5s >> 100ms budget
+    assert "deadline" in r["error"]
+
+
+def test_server_counts_status_classes_and_draining_503():
+    sched = StudyScheduler(wal=False)
+    server = ServiceHTTPServer(0, scheduler=sched)
+    server.handle("GET", "/studies", {})
+    server.handle("POST", "/ask", {"study_id": "nope"})
+    sched.drain(timeout=1.0)
+    code, r = server.handle("POST", "/study", {
+        "space": {"x": {"dist": "uniform", "args": [-5, 5]}}})
+    assert code == 503 and r["retry_after"] is not None
+    snap = sched.metrics.snapshot()["metrics"]
+    assert snap["service.http.studies.2xx"] >= 1
+    assert snap["service.http.ask.4xx"] >= 1
+    assert snap["service.http.study.5xx"] >= 1
+
+
+def test_server_500_lands_in_flight_ring(monkeypatch):
+    from hyperopt_tpu.obs.flight import get_flight
+
+    sched = StudyScheduler(wal=False)
+    server = ServiceHTTPServer(0, scheduler=sched)
+    monkeypatch.setattr(sched, "studies_status",
+                        lambda: (_ for _ in ()).throw(KeyError("bug")))
+    code, r = server.handle("GET", "/studies", {})
+    assert code == 500
+    recs = [r_ for r_ in get_flight().records()
+            if r_.get("kind") == "service_error"]
+    assert recs and "KeyError" in recs[-1]["error"]
+
+
+# ---------------------------------------------------------------------------
+# obs.report service-health section
+# ---------------------------------------------------------------------------
+
+
+def test_report_service_section_renders():
+    from hyperopt_tpu.obs import report
+
+    metrics = {
+        "service.asks": 120, "service.tells": 118, "service.ticks": 40,
+        "service.studies_created": 12,
+        "service.wave_sec": {"count": 40, "p50": 0.02, "p99": 0.09},
+        "service.shed.ask": 30, "service.shed.tell": 0,
+        "service.shed.deadline": 4,
+        "service.degraded": 2, "service.degrade.down": 3,
+        "service.degrade.up": 1, "service.degrade.faults": 3,
+        "service.degraded_asks": 9,
+        "service.wal.replay_studies": 12, "service.wal.replay_asks": 80,
+        "service.wal.replay_regenerated": 5,
+        "service.wal.replay_duplicate_tells": 2,
+        "service.wal.compactions": 1, "service.wal.sync_errors": 0,
+        "service.http.ask.2xx": 90, "service.http.ask.4xx": 30,
+        "service.http.study.5xx": 1,
+    }
+    records = [{"kind": "metrics", "snapshot": {"metrics": metrics}}]
+    text = report.render(records)
+    assert "service health" in text
+    assert "asks 120" in text and "tells 118" in text
+    assert "shed" in text and "30" in text
+    assert "degrade  level 2" in text and "DEGRADED" in text
+    assert "replayed studies 12" in text and "compactions 1" in text
+    assert "4xx x30" in text and "5xx x1" in text
+
+
+def test_report_without_service_metrics_unchanged():
+    from hyperopt_tpu.obs import report
+
+    records = [{"kind": "metrics",
+                "snapshot": {"metrics": {"trials": 5}}}]
+    assert "service health" not in report.render(records)

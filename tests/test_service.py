@@ -464,3 +464,211 @@ def test_env_knob_parsing():
         {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "0"}) == float("inf")  # disabled
     assert parse_service_idle_sec(
         {"HYPEROPT_TPU_SERVICE_IDLE_SEC": "soon"}) == 600.0  # warn+default
+
+
+# ---------------------------------------------------------------------------
+# spacespec robustness (ISSUE 10 satellite): hostile schemas answer 400,
+# never 500
+# ---------------------------------------------------------------------------
+
+
+def _deep_choice_spec(depth):
+    node = {"dist": "uniform", "args": [0, 1]}
+    spec = {"leaf": node}
+    for i in range(depth):
+        spec = {f"c{i}": {"dist": "choice", "options": [spec, 0]}}
+    return spec
+
+
+def _hostile_specs():
+    """Fuzz-style corpus: every shape a confused or hostile client can
+    put on the wire (plus Python-API-only shapes like cyclic dicts)."""
+    cyclic = {"x": {"dist": "choice", "options": []}}
+    cyclic["x"]["options"].append(cyclic)  # truly cyclic via options
+    huge_label = "x" * 10_000
+    return [
+        None,
+        [],
+        "a string",
+        42,
+        {},                                        # empty mapping
+        {"x": None},
+        {"x": []},
+        {"x": "not-a-node"},
+        {"x": {}},                                 # no dist
+        {"x": {"dist": None}},
+        {"x": {"dist": 7}},                        # non-string family
+        {"x": {"dist": "warp", "args": [1]}},      # unknown family
+        {"x": {"dist": "uniform"}},                # missing args
+        {"x": {"dist": "uniform", "args": "ab"}},
+        {"x": {"dist": "uniform", "args": [1]}},   # arity
+        {"x": {"dist": "uniform", "args": [1, 2, 3, 4]}},
+        {"x": {"dist": "uniform", "args": [None, 2]}},
+        {"x": {"dist": "uniform", "args": ["a", "b"]}},
+        {"x": {"dist": "choice"}},                 # no options
+        {"x": {"dist": "choice", "options": []}},
+        {"x": {"dist": "choice", "options": "ab"}},
+        {"x": {"dist": "choice", "options": [["nested", "list"]]}},
+        {"x": {"dist": "choice",
+               "options": [{"dist": "uniform", "args": [0, 1]}]}},
+        {"x": {"dist": "pchoice", "options": [0, 1]}},  # not pairs
+        {"x": {"dist": "pchoice", "options": [["p", 0]]}},
+        {huge_label: {"dist": "uniform", "args": [0, 1]}},  # label len
+        {"": {"dist": "uniform", "args": [0, 1]}},          # empty label
+        {"x": {"dist": "choice",
+               "options": list(range(5000))}},     # huge option list
+        _deep_choice_spec(64),                     # over-deep nesting
+        cyclic,                                    # cyclic (API-only)
+        {f"p{i}": {"dist": "uniform", "args": [0, 1]}
+         for i in range(1000)},                    # too many params
+    ]
+
+
+def test_spacespec_fuzz_raises_typed_errors():
+    for spec in _hostile_specs():
+        with pytest.raises(SpaceSpecError):
+            space_from_spec(spec)
+
+
+def test_spacespec_fuzz_answers_400_never_500():
+    server = ServiceHTTPServer(0)
+    for spec in _hostile_specs():
+        code, payload = server.handle("POST", "/study", {"space": spec})
+        assert code == 400, (code, payload, spec if not isinstance(
+            spec, dict) or len(spec) < 5 else "large spec")
+        assert payload["ok"] is False and payload["error"]
+
+
+def test_spacespec_limits_leave_sane_specs_alone():
+    from hyperopt_tpu.service.spacespec import MAX_DEPTH
+
+    space = space_from_spec(_deep_choice_spec(MAX_DEPTH - 2))
+    assert space  # deep-but-legal still builds
+    labels = {f"p{i}": {"dist": "uniform", "args": [0, 1]}
+              for i in range(64)}
+    assert space_from_spec(labels)
+
+
+def test_non_string_label_rejected():
+    with pytest.raises(SpaceSpecError):
+        space_from_spec({7: {"dist": "uniform", "args": [0, 1]}})
+
+
+# ---------------------------------------------------------------------------
+# ServiceClient retry/backoff (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_client_honors_retry_after_and_conn_resets(monkeypatch):
+    from hyperopt_tpu.retry import RetryPolicy
+    from hyperopt_tpu.service.client import ServiceClient, ServiceUnavailable
+
+    sleeps = []
+    client = ServiceClient("http://127.0.0.1:1", sleep=sleeps.append,
+                           retry=RetryPolicy(max_retries=4, base_delay=0.1,
+                                             max_delay=2.0, jitter=0.5))
+    script = [
+        (429, {"ok": False, "error": "shed"}, "0.8"),
+        ConnectionResetError("mid-restart"),
+        (503, {"ok": False, "error": "draining", "retry_after": 0.3}, "0.3"),
+        (200, {"ok": True, "study_id": "s1"}, None),
+    ]
+
+    def fake_once(method, path, body):
+        step = script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+    monkeypatch.setattr(client, "_once", fake_once)
+    status, payload = client.request("POST", "/study", {})
+    assert status == 200 and payload["study_id"] == "s1"
+    assert len(sleeps) == 3 and client.retries == 3
+    # Retry-After floors the first backoff (0.8 > base jittered delay)
+    assert sleeps[0] >= 0.8
+    # deterministic jitter: replaying the schedule gives the same sleeps
+    sleeps2 = []
+    client2 = ServiceClient("http://127.0.0.1:1", sleep=sleeps2.append,
+                            retry=RetryPolicy(max_retries=4, base_delay=0.1,
+                                              max_delay=2.0, jitter=0.5))
+    script[:] = [
+        (429, {"ok": False, "error": "shed"}, "0.8"),
+        ConnectionResetError("mid-restart"),
+        (503, {"ok": False, "error": "draining", "retry_after": 0.3}, "0.3"),
+        (200, {"ok": True, "study_id": "s1"}, None),
+    ]
+    monkeypatch.setattr(client2, "_once", fake_once)
+    client2.request("POST", "/study", {})
+    assert sleeps == sleeps2
+
+
+def test_client_exhausts_retries(monkeypatch):
+    from hyperopt_tpu.retry import RetryPolicy
+    from hyperopt_tpu.service.client import ServiceClient, ServiceUnavailable
+
+    client = ServiceClient("http://127.0.0.1:1", sleep=lambda _s: None,
+                           retry=RetryPolicy(max_retries=2, base_delay=0.01))
+    monkeypatch.setattr(
+        client, "_once",
+        lambda *a: (429, {"ok": False, "error": "shed"}, "0.1"))
+    with pytest.raises(ServiceUnavailable) as ei:
+        client.request("POST", "/ask", {})
+    assert ei.value.status == 429
+
+
+def test_client_tell_409_is_success(monkeypatch):
+    from hyperopt_tpu.service.client import ServiceClient
+
+    client = ServiceClient("http://127.0.0.1:1", sleep=lambda _s: None)
+    monkeypatch.setattr(
+        client, "_once",
+        lambda *a: (409, {"ok": False, "error": "already told"}, None))
+    assert client.tell("s1", 3, 0.5) == {"duplicate": True}
+
+
+def test_client_does_not_retry_permanent_errors(monkeypatch):
+    from hyperopt_tpu.service.client import ServiceClient
+
+    calls = []
+
+    def fake_once(method, path, body):
+        calls.append(path)
+        return 404, {"ok": False, "error": "no such study"}, None
+
+    client = ServiceClient("http://127.0.0.1:1", sleep=lambda _s: None)
+    monkeypatch.setattr(client, "_once", fake_once)
+    status, payload = client.request("POST", "/ask", {})
+    assert status == 404 and len(calls) == 1
+
+
+def test_issue10_env_knob_parsing():
+    from hyperopt_tpu._env import (parse_service_deadline_ms,
+                                   parse_service_degrade,
+                                   parse_service_queue,
+                                   parse_service_wal)
+
+    assert parse_service_wal({}) == "auto"
+    assert parse_service_wal({"HYPEROPT_TPU_SERVICE_WAL": "on"}) == "auto"
+    assert parse_service_wal({"HYPEROPT_TPU_SERVICE_WAL": "off"}) is None
+    assert parse_service_wal({"HYPEROPT_TPU_SERVICE_WAL": "0"}) is None
+    assert parse_service_wal(
+        {"HYPEROPT_TPU_SERVICE_WAL": "/tmp/x.jsonl"}) == "/tmp/x.jsonl"
+    assert parse_service_deadline_ms({}) == 30000.0
+    assert parse_service_deadline_ms(
+        {"HYPEROPT_TPU_SERVICE_DEADLINE_MS": "off"}) is None
+    assert parse_service_deadline_ms(
+        {"HYPEROPT_TPU_SERVICE_DEADLINE_MS": "1500"}) == 1500.0
+    assert parse_service_deadline_ms(
+        {"HYPEROPT_TPU_SERVICE_DEADLINE_MS": "soon"}) == 30000.0
+    assert parse_service_queue({}) == 256
+    assert parse_service_queue({"HYPEROPT_TPU_SERVICE_QUEUE": "8"}) == 8
+    assert parse_service_queue({"HYPEROPT_TPU_SERVICE_QUEUE": "-1"}) == 256
+    assert parse_service_degrade({}) == 8
+    assert parse_service_degrade(
+        {"HYPEROPT_TPU_SERVICE_DEGRADE": "off"}) is None
+    assert parse_service_degrade(
+        {"HYPEROPT_TPU_SERVICE_DEGRADE": "3"}) == 3
+    assert parse_service_degrade(
+        {"HYPEROPT_TPU_SERVICE_DEGRADE": "1"}) == 1  # fastest recovery
+    assert parse_service_degrade(
+        {"HYPEROPT_TPU_SERVICE_DEGRADE": "soon"}) == 8
