@@ -473,6 +473,56 @@ def bench_profiler_overhead(max_evals=60, repeats=3, seed=0):
     return out
 
 
+def bench_trace_overhead(n_asks=40, repeats=3, seed=0):
+    """Request-trace plane acceptance bar (ISSUE 11): parsing/minting/
+    echoing trace context and stamping it on spans + WAL records must
+    cost ~nothing per served ask.  Drives the REAL handler path
+    (``ServiceHTTPServer.handle`` — route, admission, wave tick, doc
+    build) with tracing armed (inbound ``traceparent`` on every request)
+    vs disarmed, same seed, and reports the per-ask delta.  The
+    fractional delta rides the headline as ``trace_overhead_frac``
+    (gated absolute, lower-is-better, by scripts/bench_gate.py — the
+    loose bar catches the plane growing a per-ask serialization or I/O
+    cost, not scheduler noise)."""
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    space_spec = {"x": {"dist": "uniform", "args": [-5, 10]},
+                  "y": {"dist": "uniform", "args": [0, 15]}}
+    tp = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+
+    def once(armed):
+        srv = ServiceHTTPServer(0, scheduler=StudyScheduler(wal=False),
+                                trace=armed, slo=armed)
+        code, r = srv.handle("POST", "/study", {
+            "space": space_spec, "seed": seed, "n_startup_jobs": 4})
+        assert code == 200, r
+        sid = r["study_id"]
+        headers = {"traceparent": tp} if armed else None
+        t0 = time.perf_counter()
+        for i in range(n_asks):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid},
+                                 headers=headers)
+            assert code == 200, a
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": a["trials"][0]["tid"],
+                "loss": float(i % 7)})
+            assert code == 200
+        return time.perf_counter() - t0
+
+    once(False)  # warm: the cohort jit cache is shared by both sides
+    out = {"n_asks": n_asks, "repeats": repeats,
+           "bar": "trace/SLO plane ~free per served ask"}
+    out["trace_off_sec"] = min(once(False) for _ in range(repeats))
+    out["trace_on_sec"] = min(once(True) for _ in range(repeats))
+    out["trace_overhead_frac"] = (
+        (out["trace_on_sec"] - out["trace_off_sec"])
+        / max(out["trace_off_sec"], 1e-9))
+    out["trace_overhead_us_per_ask"] = (
+        (out["trace_on_sec"] - out["trace_off_sec"]) / n_asks * 1e6)
+    return out
+
+
 def bench_fleet_recovery(reps=5, lease_ttl=0.25, poll=0.01):
     """Elastic-fleet recovery latency (ISSUE 8): wall seconds from a
     controller dying mid-shard (claimed lease, heartbeats stop) to a
@@ -1432,6 +1482,9 @@ _JAX_STAGES = (
     ("flight_overhead", bench_flight_overhead),
     # capture-plane overhead bar: armed-but-idle profiler vs off (ISSUE 7)
     ("profiler_overhead", bench_profiler_overhead),
+    # request-trace + SLO plane overhead bar: armed vs disarmed per-ask
+    # delta through the real handler path (ISSUE 11)
+    ("trace_overhead", bench_trace_overhead),
     # elastic-fleet recovery latency: dead controller -> survivor holds the
     # reclaimed shard lease (ISSUE 8; bench_gate key recovery_latency_sec)
     ("fleet_recovery", bench_fleet_recovery),
@@ -1648,6 +1701,15 @@ def main():
             k: rec["result"].get(k)
             for k in ("profiler_off_sec", "profiler_on_sec",
                       "profiler_overhead_frac")}
+    # the request-trace/SLO plane delta rides the headline line: the
+    # armed-vs-disarmed per-ask cost through the real handler path
+    # (ISSUE 11), gated absolute lower-is-better (trace_overhead_frac)
+    rec = stages.get("trace_overhead")
+    if rec and rec.get("ok"):
+        obs_summary["trace_overhead"] = {
+            k: rec["result"].get(k)
+            for k in ("trace_off_sec", "trace_on_sec",
+                      "trace_overhead_frac", "trace_overhead_us_per_ask")}
     # peak device memory rides the headline line (lower-is-better, gated by
     # scripts/bench_gate.py): a leaked cap-sized buffer fails the gate
     rec = stages.get("devmem")
@@ -1734,6 +1796,8 @@ def main():
             "history_bytes": _stage_val("devmem", "history_bytes"),
             "profiler_overhead_frac": _stage_val(
                 "profiler_overhead", "profiler_overhead_frac"),
+            "trace_overhead_frac": _stage_val(
+                "trace_overhead", "trace_overhead_frac"),
             "studies_per_sec": _stage_val("multi_study", "studies_per_sec"),
             "study_ask_p99_ms": _stage_val("multi_study",
                                            "study_ask_p99_ms"),

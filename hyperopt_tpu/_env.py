@@ -33,6 +33,9 @@ __all__ = [
     "parse_service_deadline_ms",
     "parse_service_queue",
     "parse_service_degrade",
+    "parse_reqtrace",
+    "parse_service_access_log",
+    "parse_service_slo",
 ]
 
 logger = logging.getLogger(__name__)
@@ -376,6 +379,90 @@ def parse_service_degrade(env=None):
                    "a positive clean-wave count")
         return DEFAULT_DEGRADE_RECOVER_WAVES
     return n
+
+
+# -- request-scoped observability knobs (ISSUE 11) --------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# service it would have observed.
+
+
+def parse_reqtrace(env=None):
+    """``HYPEROPT_TPU_REQTRACE`` → whether the request-trace context
+    plane (``obs/reqtrace.py``) is armed.  Default ON — trace ids are
+    pure metadata (no threads, never touch proposals), and a serving
+    fleet without request correlation is undebuggable.  ``0``/``off``
+    disarms everything: no minting, no header, no WAL ``trace`` field
+    (the bench ``trace_overhead`` stage measures the armed-vs-disarmed
+    per-ask delta)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_REQTRACE", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def parse_service_access_log(env=None):
+    """``HYPEROPT_TPU_SERVICE_ACCESS_LOG=<path>`` → JSONL access-log
+    path for the ask/tell server (one record per request: method, path,
+    status, latency ms, trace id, shed/degrade reason), or None when
+    unset/disabled.  Opt-in: the default server keeps its
+    ``log_message``-swallowing silence."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_ACCESS_LOG", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    return raw
+
+
+def parse_service_slo(env=None):
+    """``HYPEROPT_TPU_SERVICE_SLO`` → SLO-plane targets for the serving
+    front end (``obs/slo.py``), or None when disabled:
+
+    * unset / ``1`` / ``on`` → the default objectives
+      (availability 99.9%, 99% of asks under 500ms, ≤5% shed);
+    * ``0`` / ``off`` → None — no plane, no gauges, no escalation;
+    * a spec string tunes targets:
+      ``avail=99.9,ask_p99_ms=250,ask_pct=99,shed=2`` — ``avail`` and
+      ``ask_pct`` in percent, ``ask_p99_ms`` the latency threshold in
+      milliseconds, ``shed`` the allowed shed percentage.  Unknown or
+      malformed tokens warn once and keep that objective's default.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_SERVICE_SLO", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        from .obs.slo import DEFAULT_TARGETS
+
+        return {k: dict(v) for k, v in DEFAULT_TARGETS.items()}
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    from .obs.slo import DEFAULT_TARGETS
+
+    targets = {k: dict(v) for k, v in DEFAULT_TARGETS.items()}
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        key, _, val = token.partition("=")
+        key = key.strip().lower()
+        try:
+            v = float(val)
+        except ValueError:
+            _warn_once("HYPEROPT_TPU_SERVICE_SLO", token,
+                       "a key=number token")
+            continue
+        if key in ("avail", "availability") and 0 < v < 100:
+            targets["availability"]["target"] = v / 100.0
+        elif key in ("ask_p99_ms", "ask_ms") and v > 0:
+            targets["ask_latency"]["threshold_ms"] = v
+        elif key in ("ask_pct",) and 0 < v < 100:
+            targets["ask_latency"]["target"] = v / 100.0
+        elif key in ("shed",) and 0 <= v < 100:
+            # shed=0 means "any shed burns budget" — clamp under 1.0 so
+            # the objective stays a valid (0,1) target
+            targets["shed_rate"]["target"] = min(0.9999, 1.0 - v / 100.0)
+        else:
+            _warn_once("HYPEROPT_TPU_SERVICE_SLO", token,
+                       "one of avail=/ask_p99_ms=/ask_pct=/shed= with a "
+                       "sane value")
+    return targets
 
 
 _CACHE_CONFIGURED = False

@@ -22,6 +22,15 @@ armed:
 * :mod:`~hyperopt_tpu.obs.export` — Chrome/Perfetto trace-event export
   (``obs.report --export-trace out.json run.jsonl``).
 
+And the request-scoped plane for the serving fleet (ISSUE 11):
+
+* :mod:`~hyperopt_tpu.obs.reqtrace` — W3C-traceparent-style trace
+  context (one trace id per logical client request, contextvar-carried)
+  threaded client → handler → wave → tick → WAL.
+* :mod:`~hyperopt_tpu.obs.slo` — declarative SLO objectives evaluated
+  as multi-window burn rates (``slo_*`` gauges on ``/metrics``, an
+  escalation hook into the device profiler).
+
 One flag arms everything: ``HYPEROPT_TPU_OBS=<run.jsonl>`` (or the ``obs=``
 kwarg on ``fmin``/``fmin_multihost``) turns on the JSONL stream, and the
 pre-existing ``HYPEROPT_TPU_PROFILE=<dir>`` ``jax.profiler`` hook now rides

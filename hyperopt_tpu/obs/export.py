@@ -58,7 +58,7 @@ import gzip
 import json
 
 __all__ = ["to_trace_events", "export_trace", "write_trace",
-           "device_trace_events"]
+           "device_trace_events", "flow_events"]
 
 #: device-capture track groups are remapped to pids >= this, far above any
 #: realistic host-stream count, so the two namespaces can never collide
@@ -265,17 +265,77 @@ def device_trace_events(path, pid_base, name=None, epoch_offset_sec=None):
     return meta + events, len(pid_map)
 
 
+def _flow_id(trace_id):
+    """Stable integer flow id from a trace id's leading hex (60 bits —
+    comfortably inside the signed-64 range viewers assume)."""
+    return int(str(trace_id)[:15] or "0", 16)
+
+
+def flow_events(events):
+    """Request-trace flow events (ISSUE 11): every ``X`` span stamped
+    with a ``trace`` attr (or ``links`` list — the wave span's fan-in)
+    joins that trace's flow.  One flow per trace id, rendered by
+    Perfetto as a connected arc across pid track groups: client attempt
+    → server handler → wave → cohort tick.
+
+    Chrome flow-event grammar: ``s`` (start) on the first slice, ``t``
+    (step) on each middle one, ``f`` (finish, ``bp: "e"``) on the last —
+    each bound to its slice by (pid, tid) and a ``ts`` inside the
+    slice.  Flows with fewer than two slices are dropped (nothing to
+    connect).  ``scripts/validate_trace.py`` lints exactly these
+    invariants (no dangling ids, binding slices exist)."""
+    by_trace = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        hits = set()
+        t = args.get("trace")
+        if isinstance(t, str) and t:
+            hits.add(t)
+        links = args.get("links")
+        if isinstance(links, list):
+            hits.update(x for x in links if isinstance(x, str) and x)
+        for t in hits:
+            by_trace.setdefault(t, []).append(e)
+    flows = []
+    for t, slices in sorted(by_trace.items()):
+        if len(slices) < 2:
+            continue  # a single-hop flow draws no arc
+        slices.sort(key=lambda e: e["ts"])
+        try:
+            fid = _flow_id(t)
+        except ValueError:
+            # a foreign producer's non-hex trace attr must not kill the
+            # whole export (the torn-line/fail-open posture); its spans
+            # still render, only the connecting arc is skipped
+            continue
+        for i, e in enumerate(slices):
+            ph = "s" if i == 0 else ("f" if i == len(slices) - 1 else "t")
+            f = {"name": "reqtrace", "cat": "reqtrace", "ph": ph,
+                 "id": fid, "ts": e["ts"], "pid": e["pid"],
+                 "tid": e["tid"], "args": {"trace": t}}
+            if ph == "f":
+                f["bp"] = "e"  # bind to the ENCLOSING slice, not the next
+            flows.append(f)
+    return flows
+
+
 def export_trace(streams, device_traces=()):
     """``[(name, records-iterable)]`` → a trace-event JSON object.  Each
     stream becomes its own ``pid`` track group (the multi-controller merge
     view); ``device_traces`` — ``[(name, artifact path, epoch t0), ...]``
     from ``kind="profile"`` records — merge in as device track groups in
-    the reserved pid range.  Events are sorted ``(pid, tid, ts)``,
-    metadata first — the layout ``scripts/validate_trace.py`` pins."""
+    the reserved pid range.  Spans carrying request-trace ids
+    additionally emit flow events (:func:`flow_events`) so one trace
+    renders as a connected client→handler→wave→device arc.  Events are
+    sorted ``(pid, tid, ts)``, metadata first — the layout
+    ``scripts/validate_trace.py`` pins."""
     meta, events = [], []
     for pid, (name, records) in enumerate(streams):
         for e in to_trace_events(records, pid=pid, name=name):
             (meta if e["ph"] == "M" else events).append(e)
+    events.extend(flow_events(events))
     pid_base = DEVICE_PID_BASE
     for name, path, t0 in device_traces:
         try:

@@ -7,6 +7,7 @@ Usage::
     python -m hyperopt_tpu.obs.report --postmortem run.flight.jsonl
     python -m hyperopt_tpu.obs.report --export-trace out.json run.jsonl ...
     python -m hyperopt_tpu.obs.report --trend [.obs/trajectory.jsonl]
+    python -m hyperopt_tpu.obs.report --study <id> <store-or-wal> [more...]
 
 Single-stream sections, matching the telemetry pillars:
 
@@ -67,7 +68,8 @@ from .events import (
 from .trace import iter_jsonl, read_jsonl  # noqa: F401  (read_jsonl re-export)
 
 __all__ = ["main", "render", "render_merged", "render_postmortem",
-           "render_trend", "headline_sections", "json_report"]
+           "render_trend", "headline_sections", "json_report",
+           "render_study_timeline", "study_timeline_events"]
 
 _BAR_W = 30
 
@@ -429,6 +431,38 @@ def _service_section(metrics, out):
             detail = "  ".join(f"{ep} {n}" for ep, n
                                in sorted(http[cls].items()))
             out.append(f"  http     {cls} x{total}  ({detail})")
+    _slo_lines(metrics, out)
+
+
+def _slo_lines(metrics, out):
+    """SLO error-budget lines (ISSUE 11): one row per objective from the
+    ``slo.*`` gauges, budget bar + fast/slow burn rates, with the
+    ERROR-BUDGET-EXHAUSTED banner when any objective's budget is gone.
+    Rendered only when the stream recorded the SLO plane."""
+    objectives = sorted({k.split(".")[1] for k in metrics
+                         if k.startswith("slo.") and k.count(".") >= 2})
+    if not objectives:
+        return
+    exhausted = []
+    for name in objectives:
+        rem = metrics.get(f"slo.{name}.budget_remaining_frac")
+        if rem is None:
+            continue
+        burn_f = metrics.get(f"slo.{name}.burn_fast", 0.0)
+        burn_s = metrics.get(f"slo.{name}.burn_slow", 0.0)
+        frac = max(0.0, min(1.0, float(rem)))
+        line = (f"  slo      {name:<14} budget [{_bar(frac, 16)}] "
+                f"{float(rem) * 100:6.1f}%  burn fast {float(burn_f):5.1f}x"
+                f"  slow {float(burn_s):5.1f}x")
+        if metrics.get(f"slo.{name}.fast_alerting"):
+            line += "  FAST-BURN"
+        out.append(line)
+        if metrics.get(f"slo.{name}.exhausted"):
+            exhausted.append(name)
+    if exhausted:
+        out.append("  ERROR-BUDGET-EXHAUSTED: " + ", ".join(exhausted)
+                   + " — the service is out of SLO; see slo.* gauges and "
+                     "the escalation capture (slo.escalations)")
 
 
 def _devmem_section(devmem_recs, out):
@@ -1072,6 +1106,158 @@ def render_postmortem(records, name=None):
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# per-study audit timeline (ISSUE 11: obs.report --study <id>)
+# ---------------------------------------------------------------------------
+
+#: the WAL record kinds that belong to a study's durable timeline
+_WAL_KINDS = ("admit", "snapshot", "ask", "tell", "close")
+
+
+def study_timeline_events(study_id, streams):
+    """Join one study's lifecycle out of mixed JSONL streams.
+
+    ``streams`` is ``[(name, records)]`` — typically the service WAL
+    (``service.wal.jsonl``) plus any obs/flight/access streams the
+    caller has.  Returns ``(events, trace_hops)``:
+
+    * ``events`` — the study's WAL records (admit/ask/tell/void/close/
+      snapshot), each tagged with its source stream, sorted by ``ts``
+      (records without one — pre-ISSUE-11 journals — keep file order at
+      the front);
+    * ``trace_hops`` — ``{trace_id: [span/access records]}`` for every
+      trace id the study's records name, joined across ALL streams (the
+      client→handler→wave→device correlation arc).
+    """
+    # materialize up front: the streams are walked TWICE (events, then
+    # trace joins), and a caller handing iter_jsonl generators would
+    # otherwise silently lose the whole correlation view on pass 2
+    streams = [(name, list(records)) for name, records in streams]
+    events = []
+    traces = set()
+    for name, records in streams:
+        for r in records:
+            if not isinstance(r, dict):
+                continue
+            kind = r.get("kind")
+            if kind in _WAL_KINDS and r.get("sid") == study_id:
+                events.append({**r, "_src": name})
+                if r.get("trace"):
+                    traces.add(r["trace"])
+            elif kind == "access" and r.get("study_id") == study_id \
+                    and r.get("trace"):
+                traces.add(r["trace"])
+    order = {id(e): i for i, e in enumerate(events)}
+    events.sort(key=lambda e: (e.get("ts") is not None, e.get("ts") or 0.0,
+                               order[id(e)]))
+    trace_hops = {t: [] for t in traces}
+    if traces:
+        for name, records in streams:
+            for r in records:
+                if not isinstance(r, dict):
+                    continue
+                attrs = r.get("attrs") or {}
+                hits = set()
+                t = r.get("trace") or attrs.get("trace")
+                if t in trace_hops:
+                    hits.add(t)
+                for t in attrs.get("links") or []:
+                    if t in trace_hops:
+                        hits.add(t)
+                for t in hits:
+                    trace_hops[t].append({**r, "_src": name})
+        for hops in trace_hops.values():
+            hops.sort(key=lambda r: r.get("ts") or 0.0)
+    return events, trace_hops
+
+
+def render_study_timeline(study_id, streams):
+    """``--study``: one study's full lifecycle as a T+ timeline — every
+    admit/ask/tell/void/evict/close/resume boundary from the WAL, each
+    ask's wave/algo/degrade flags and trace id, plus the cross-stream
+    correlation arc for every trace the study's records name."""
+    events, trace_hops = study_timeline_events(study_id, streams)
+    out = []
+    out.append(f"== study timeline: {study_id} " + "=" * max(
+        1, 46 - len(study_id)))
+    if not events:
+        out.append("  (no WAL records for this study in "
+                   + ", ".join(n for n, _ in streams) + ")")
+        return "\n".join(out) + "\n"
+    t0 = next((e["ts"] for e in events if e.get("ts") is not None), 0.0)
+    asks = tells = voids = degraded = 0
+    for e in events:
+        ts = e.get("ts")
+        stamp = f"T+{ts - t0:9.3f}s" if ts is not None else "T+    ?    "
+        kind = e["kind"]
+        if kind == "admit":
+            what = (f"admit     seed={e.get('seed')}"
+                    + (f"  kwargs={e.get('kwargs')}" if e.get("kwargs")
+                       else ""))
+        elif kind == "snapshot":
+            # a snapshot record is a compaction boundary: everything
+            # before it was folded into this one registry entry —
+            # after a crash-resume this is where replay picked up
+            what = (f"snapshot  (compaction/resume boundary)  "
+                    f"state={e.get('state')}  n_asked={e.get('n_asked')}"
+                    f"  n_told={e.get('n_told')}")
+        elif kind == "ask":
+            algo = e.get("algo")
+            if algo == "void":
+                voids += 1
+                what = f"void      tids={e.get('tids')}  (failed/shed ask)"
+            else:
+                asks += 1
+                what = f"ask       tids={e.get('tids')}  algo={algo}"
+                if algo == "rand":
+                    degraded += 1
+                    what += "  [startup or DEGRADED]"
+        elif kind == "tell":
+            tells += 1
+            what = (f"tell      tid={e.get('tid')}  loss={e.get('loss')}"
+                    + (f"  status={e['status']}" if e.get("status")
+                       else ""))
+        elif kind == "close":
+            what = "close"
+        else:  # pragma: no cover - _WAL_KINDS is closed
+            what = kind
+        if e.get("trace"):
+            what += f"  trace={e['trace'][:16]}.."
+        out.append(f"  {stamp}  {what}")
+    out.append(f"  summary: {asks} asks ({degraded} rand-served, "
+               f"{voids} void), {tells} tells")
+    shown = {t: hops for t, hops in trace_hops.items() if hops}
+    if shown:
+        out.append("")
+        out.append("== request correlation " + "=" * 41)
+        for t in sorted(shown):
+            hops = shown[t]
+            arc = " -> ".join(
+                f"{h.get('name') or h.get('kind')}"
+                + (f"[{h['attrs']['wave']}]"
+                   if (h.get("attrs") or {}).get("wave") is not None
+                   else "")
+                for h in hops[:8])
+            out.append(f"  {t[:16]}..  {arc}"
+                       + ("  (+%d more)" % (len(hops) - 8)
+                          if len(hops) > 8 else ""))
+    return "\n".join(out) + "\n"
+
+
+def _study_streams(paths):
+    """Resolve ``--study`` inputs: a directory means a store root (its
+    ``service.wal.jsonl`` is the stream); files are read as JSONL."""
+    from ..service.journal import wal_path_for
+
+    streams = []
+    for path in paths:
+        p = wal_path_for(path) if os.path.isdir(path) else path
+        if not os.path.exists(p):
+            raise OSError(f"no such stream: {p}")
+        streams.append((os.path.basename(p), read_jsonl(p)))
+    return streams
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.obs.report",
@@ -1103,7 +1289,35 @@ def main(argv=None):
                    help="render the bench trajectory store "
                         "(.obs/trajectory.jsonl) as per-key sparkline "
                         "history instead of a run report")
+    p.add_argument("--study", metavar="ID", default=None,
+                   help="render one study's audit timeline from the "
+                        "service WAL (give the WAL file or the --store "
+                        "root; extra obs/flight/access streams join the "
+                        "request-correlation view)")
     args = p.parse_args(argv)
+    if args.study is not None:
+        if args.merge or args.postmortem or args.export_trace or args.trend:
+            print("error: --study is its own view; it does not combine "
+                  "with --merge/--postmortem/--export-trace/--trend",
+                  file=sys.stderr)
+            return 2
+        if args.format == "json":
+            # erroring beats a scripted consumer silently getting text:
+            # the WAL records behind the view are already JSONL
+            print("error: --study renders text only; for machine-"
+                  "readable records read the WAL (service.wal.jsonl) "
+                  "or GET /study/<id>/timeline", file=sys.stderr)
+            return 2
+        if not args.jsonl:
+            p.error("--study needs the service WAL (or store root), plus "
+                    "any extra streams to correlate")
+        try:
+            streams = _study_streams(args.jsonl)
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        sys.stdout.write(render_study_timeline(args.study, streams))
+        return 0
     if args.format == "json" and args.postmortem:
         print("error: --format json applies to the report/merge views, "
               "not --postmortem", file=sys.stderr)

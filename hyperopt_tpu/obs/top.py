@@ -31,7 +31,7 @@ import os
 import sys
 import time
 
-from .report import _fmt_bytes, _fmt_sec, _spark
+from .report import _bar, _fmt_bytes, _fmt_sec, _spark
 
 __all__ = ["main", "render_frame", "fetch_snapshot", "snapshot_from_stream",
            "snapshot_from_records"]
@@ -187,6 +187,74 @@ class History:
         return max(0.0, (n1 - n0) / (t1 - t0))
 
 
+def _metric_scalar(m, default=0):
+    """A service-registry metric snapshot value as a scalar (histograms
+    snapshot as dicts — take the count)."""
+    if isinstance(m, dict):
+        return m.get("count", default)
+    return m if isinstance(m, (int, float)) else default
+
+
+def _render_service_source(name, snap, out, w):
+    """The serving-process view (ISSUE 11): a ``service.server``
+    ``/snapshot`` has no fmin sections — render the study table, traffic
+    + shed rate, degrade-ladder state and the SLO budget bars instead
+    (pre-PR the dashboard showed nothing for a serving process)."""
+    svc = (snap.get("sections") or {}).get("service") or {}
+    asks = int(_metric_scalar(svc.get("service.asks")))
+    tells = int(_metric_scalar(svc.get("service.tells")))
+    shed = int(_metric_scalar(svc.get("service.shed.ask")))
+    studies = snap.get("studies") or []
+    live = sum(1 for s in studies if s.get("state") == "active")
+    line = (f"  {name:<{w}}  SERVICE  studies {live}/{len(studies)}"
+            f"  asks {asks}  tells {tells}")
+    if shed or asks:
+        line += f"  shed {shed / max(1, shed + asks):.1%}"
+    wave = svc.get("service.wave_sec") or {}
+    if isinstance(wave, dict) and wave.get("count"):
+        line += (f"  wave p50 {_fmt_sec(wave.get('p50'))}"
+                 f" p99 {_fmt_sec(wave.get('p99'))}")
+    util = snap.get("slot_utilization")
+    if isinstance(util, (int, float)):
+        line += f"  slots {util:.0%}"
+    if snap.get("draining"):
+        line += "  DRAINING"
+    out.append(line)
+    degrade = snap.get("degrade")
+    if degrade and (degrade.get("level") or degrade.get("faults")):
+        out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"
+                   f"  faults {degrade.get('faults', 0)}"
+                   f"  clean {degrade.get('clean_waves', 0)}/"
+                   f"{degrade.get('recover_after', '?')}")
+    slo = snap.get("slo") or {}
+    for obj in sorted(slo):
+        s = slo[obj]
+        rem = s.get("budget_remaining_frac")
+        if rem is None:
+            continue
+        frac = max(0.0, min(1.0, float(rem)))
+        line = (f"  {'':<{w}}  slo {obj:<14} [{_bar(frac, 12)}] "
+                f"{float(rem) * 100:6.1f}%  burn "
+                f"{float(s.get('burn_fast', 0)):4.1f}x/"
+                f"{float(s.get('burn_slow', 0)):4.1f}x")
+        if s.get("exhausted") and s.get("window_events"):
+            line += "  EXHAUSTED"
+        elif s.get("fast_alerting") and s.get("window_events"):
+            line += "  FAST-BURN"
+        out.append(line)
+    # the hottest studies (most recently active first)
+    top = sorted(studies, key=lambda s: -(s.get("last_active") or 0))[:6]
+    for s in top:
+        best = s.get("best_loss")
+        out.append(
+            f"  {'':<{w}}    {str(s.get('study_id', '?'))[:24]:<24}"
+            f"  {s.get('state', '?'):<7}"
+            f"  trials {s.get('n_trials', 0):>4}"
+            f"  pending {s.get('n_pending', 0):>3}"
+            + (f"  best {best:.6g}" if isinstance(best, (int, float))
+               else "  best -"))
+
+
 def render_frame(sources, histories, now=None):
     """One dashboard frame (pure text) from ``[(name, snapshot), ...]`` —
     the testable core of the refresh loop."""
@@ -203,6 +271,9 @@ def render_frame(sources, histories, now=None):
         hist = histories.setdefault(name, History())
         if "error" in snap:
             out.append(f"  {name:<{w}}  DEAD  {snap['error']}")
+            continue
+        if snap.get("service") or "studies" in snap:
+            _render_service_source(name, snap, out, w)
             continue
         sections = snap.get("sections") or {}
         health = sections.get("health") or {}

@@ -96,6 +96,13 @@ KEY_DIRECTIONS = {
     # committed round measured -0.167), so anything tighter gates noise.
     "profiler_overhead_frac": {"direction": "lower", "threshold": 0.35,
                                "absolute": True},
+    # request-trace + SLO plane armed vs disarmed per-ask delta through
+    # the real handler path (bench.py trace_overhead stage, ISSUE 11).
+    # Absolute, like profiler_overhead_frac: the bar catches the plane
+    # growing a per-ask serialization/I/O cost (tens of percent), not
+    # the scheduler-noise swings of a sub-ms handler loop.
+    "trace_overhead_frac": {"direction": "lower", "threshold": 0.35,
+                            "absolute": True},
     # fleet shard-reclaim latency (bench.py fleet_recovery stage): wall
     # seconds from a controller dying mid-shard to a survivor holding the
     # reclaimed lease.  Dominated by the stage's lease_ttl constant plus
@@ -139,7 +146,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "sharded_cand_per_sec",
                 "ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                 "peak_hbm_bytes", "history_bytes",
-                "profiler_overhead_frac", "recovery_latency_sec",
+                "profiler_overhead_frac", "trace_overhead_frac",
+                "recovery_latency_sec",
                 "studies_per_sec", "study_ask_p99_ms",
                 "slot_utilization_frac",
                 "resume_latency_sec", "shed_rate_frac")

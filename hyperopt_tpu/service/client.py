@@ -27,13 +27,20 @@ harnesses, not an SDK.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.error
 import urllib.request
 
+from ..obs import reqtrace
+from ..obs.trace import Tracer
 from ..retry import RetryPolicy
 
 __all__ = ["ServiceClient", "ServiceUnavailable"]
+
+#: client attempt spans feed the process flight ring (sink-less tracer):
+#: the client half of the request-trace arc, visible in postmortems
+_tracer = Tracer()
 
 
 class ServiceUnavailable(RuntimeError):
@@ -57,7 +64,9 @@ class ServiceClient:
     absorbs a server restart (5 retries, 0.2s base ≈ 6s worst case)."""
 
     def __init__(self, url, retry=None, timeout=60.0, deadline_ms=None,
-                 sleep=time.sleep, key=0):
+                 sleep=time.sleep, key=0, trace=None):
+        from .._env import parse_reqtrace
+
         self.url = str(url).rstrip("/")
         self.retry = (RetryPolicy(max_retries=5, base_delay=0.2,
                                   max_delay=5.0)
@@ -67,14 +76,58 @@ class ServiceClient:
         self._sleep = sleep
         self._key = key
         self.retries = 0  # total backoffs taken (harness assertions)
+        # request tracing (ISSUE 11): ONE trace id per logical request —
+        # every RetryPolicy attempt reuses it with a FRESH span id, so
+        # the server (and the WAL) can tie a client's retries together
+        self.trace_enabled = (parse_reqtrace() if trace is None
+                              else bool(trace))
+        # per-THREAD request-trace state: a shared client may serve
+        # concurrent request() calls, and instance-level attempt headers
+        # would cross-attribute traces between threads (the pre-trace
+        # client built headers from immutable config only)
+        self._tls = threading.local()
+
+    # trace id of the calling thread's last logical request, and its
+    # per-attempt span ids (harness assertions read these from the same
+    # thread that issued the request)
+    @property
+    def last_trace(self):
+        return getattr(self._tls, "last_trace", None)
+
+    @last_trace.setter
+    def last_trace(self, v):
+        self._tls.last_trace = v
+
+    @property
+    def last_spans(self):
+        if not hasattr(self._tls, "last_spans"):
+            self._tls.last_spans = []
+        return self._tls.last_spans
+
+    @last_spans.setter
+    def last_spans(self, v):
+        self._tls.last_spans = v
+
+    @property
+    def _attempt_headers(self):
+        return getattr(self._tls, "attempt_headers", None)
+
+    @_attempt_headers.setter
+    def _attempt_headers(self, v):
+        self._tls.attempt_headers = v
 
     # -- transport ---------------------------------------------------------
 
     def _once(self, method, path, body):
-        """One HTTP exchange → ``(status, payload, retry_after)``."""
+        """One HTTP exchange → ``(status, payload, retry_after)``.
+        Attempt-scoped headers (the ``traceparent`` of THIS attempt)
+        ride in ``self._attempt_headers`` — the signature stays what
+        every harness that monkeypatches ``_once`` expects."""
         headers = {"Content-Type": "application/json"}
         if self.deadline_ms is not None:
             headers["X-Deadline-Ms"] = str(self.deadline_ms)
+        if self._attempt_headers:
+            headers.update(self._attempt_headers)
         data = (json.dumps(body).encode()
                 if method == "POST" else None)
         req = urllib.request.Request(self.url + path, data=data,
@@ -93,13 +146,35 @@ class ServiceClient:
     def request(self, method, path, body=None, retryable=(429, 503)):
         """One logical request with retry/backoff.  Returns
         ``(status, payload)`` for any non-retryable answer; raises
-        :class:`ServiceUnavailable` when retries run out."""
+        :class:`ServiceUnavailable` when retries run out.  With tracing
+        armed, all attempts share one trace id (fresh span id each) and
+        the attempt span + ``traceparent`` header carry it."""
         last_status, last_err = None, None
         attempt = 0
+        root = reqtrace.mint() if self.trace_enabled else None
+        if root is not None:
+            self.last_trace = root.trace_id
+            self.last_spans = []
         while True:
+            ctx = None
+            self._attempt_headers = None
+            if root is not None:
+                # fresh span per ATTEMPT under the one logical trace
+                ctx = (root if not attempt else reqtrace.child(root))
+                self.last_spans.append(ctx.span_id)
+                self._attempt_headers = {
+                    "traceparent": ctx.traceparent()}
             try:
-                status, payload, retry_after = self._once(
-                    method, path, body or {})
+                if ctx is not None:
+                    with _tracer.span("client.request",
+                                      trace=ctx.trace_id,
+                                      span=ctx.span_id, attempt=attempt,
+                                      path=path):
+                        status, payload, retry_after = self._once(
+                            method, path, body or {})
+                else:
+                    status, payload, retry_after = self._once(
+                        method, path, body or {})
             except _CONN_ERRORS as e:
                 status, payload, retry_after = None, None, None
                 last_err = e

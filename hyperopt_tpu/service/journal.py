@@ -195,26 +195,44 @@ class StudyJournal:
 
     # -- record constructors (one place owns the schema) -------------------
 
-    @staticmethod
-    def admit_rec(study_id, spec, seed, kwargs):
-        return {"kind": "admit", "sid": study_id, "spec": spec,
-                "seed": int(seed), "kwargs": dict(kwargs), "ts": time.time()}
+    # ``trace`` (ISSUE 11) is the request-trace id that caused the
+    # record — pure metadata for the per-study audit timeline.  Replay
+    # NEVER reads it (unknown fields were always ignored), so journals
+    # written before the field existed — and journals written with
+    # tracing disarmed — resume bit-identically (pinned by test).
 
     @staticmethod
-    def ask_rec(study_id, tids, seed, algo):
-        return {"kind": "ask", "sid": study_id,
-                "tids": [int(t) for t in tids], "seed": int(seed),
-                "algo": str(algo)}
+    def admit_rec(study_id, spec, seed, kwargs, trace=None):
+        rec = {"kind": "admit", "sid": study_id, "spec": spec,
+               "seed": int(seed), "kwargs": dict(kwargs), "ts": time.time()}
+        if trace is not None:
+            rec["trace"] = str(trace)
+        return rec
 
     @staticmethod
-    def tell_rec(study_id, tid, loss, status):
-        return {"kind": "tell", "sid": study_id, "tid": int(tid),
-                "loss": None if loss is None else float(loss),
-                "status": status}
+    def ask_rec(study_id, tids, seed, algo, trace=None):
+        rec = {"kind": "ask", "sid": study_id,
+               "tids": [int(t) for t in tids], "seed": int(seed),
+               "algo": str(algo), "ts": time.time()}
+        if trace is not None:
+            rec["trace"] = str(trace)
+        return rec
 
     @staticmethod
-    def close_rec(study_id):
-        return {"kind": "close", "sid": study_id}
+    def tell_rec(study_id, tid, loss, status, trace=None):
+        rec = {"kind": "tell", "sid": study_id, "tid": int(tid),
+               "loss": None if loss is None else float(loss),
+               "status": status, "ts": time.time()}
+        if trace is not None:
+            rec["trace"] = str(trace)
+        return rec
+
+    @staticmethod
+    def close_rec(study_id, trace=None):
+        rec = {"kind": "close", "sid": study_id, "ts": time.time()}
+        if trace is not None:
+            rec["trace"] = str(trace)
+        return rec
 
     @staticmethod
     def snapshot_rec(study):

@@ -49,6 +49,7 @@ import logging
 import math
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -66,7 +67,9 @@ from ..base import (
     coarse_utcnow,
     spec_from_misc,
 )
+from ..obs import reqtrace
 from ..obs.metrics import get_metrics
+from ..obs.trace import Tracer
 from .journal import JournalError, StudyJournal, wal_path_for
 from .overload import (LADDER_LEVELS, DeadlineExceeded, DegradeLadder,
                        NonFiniteProposal, is_device_fault)
@@ -100,6 +103,16 @@ def _pow2(n):
     while b < n:
         b *= 2
     return b
+
+
+#: scheduler spans (service.wave / service.tick) and degrade events feed
+#: the process-global flight ring through a sink-less tracer — per-WAVE
+#: cost, not per-ask, so the disarmed hot path stays flat
+_tracer = Tracer()
+
+#: bound on each study's in-memory audit timeline; the WAL is the
+#: durable record, this ring is the live `GET /study/<id>/timeline` view
+_STUDY_EVENT_CAP = 512
 
 
 class Study:
@@ -155,6 +168,37 @@ class Study:
         self.last_active = self.created
         self.n_asked = 0
         self.n_told = 0
+        # the live audit timeline (ISSUE 11): one bounded ring of
+        # lifecycle events — admit, every ask (wave/algo/degrade/trace),
+        # every tell, shed/void, evict/re-admit, resume boundary —
+        # served by `GET /study/<id>/timeline` and joined with the WAL
+        # by `obs.report --study`
+        self.events = deque(maxlen=_STUDY_EVENT_CAP)
+        self.events_dropped = 0
+
+    def note(self, event, **attrs):
+        """Append one audit-timeline event (pure metadata — never feeds
+        the RNG or the proposals)."""
+        if len(self.events) == self.events.maxlen:
+            self.events_dropped += 1
+        rec = {"ts": time.time(), "event": event}
+        rec.update({k: v for k, v in attrs.items() if v is not None})
+        self.events.append(rec)
+
+    def timeline_dict(self):
+        """The ``GET /study/<id>/timeline`` payload."""
+        return {
+            "study_id": self.study_id,
+            "state": self.state,
+            "seed": self.seed,
+            "created": self.created,
+            "n_trials": self.n_trials,
+            "n_asked": self.n_asked,
+            "n_told": self.n_told,
+            "best_loss": self.best_loss(),
+            "events": list(self.events),
+            "events_dropped": self.events_dropped,
+        }
 
     def next_seed(self):
         """One suggest seed per ask — exactly ``FMinIter``'s
@@ -207,9 +251,11 @@ class _AskReq:
     again); ``deadline`` is the request's monotonic budget."""
 
     __slots__ = ("study", "new_ids", "seed", "docs", "error", "algo",
-                 "degraded", "replay", "deadline", "journaled")
+                 "degraded", "replay", "deadline", "journaled", "trace",
+                 "wave")
 
-    def __init__(self, study, new_ids, seed, deadline=None, replay=False):
+    def __init__(self, study, new_ids, seed, deadline=None, replay=False,
+                 trace=None):
         self.study = study
         self.new_ids = new_ids
         self.seed = seed
@@ -219,6 +265,11 @@ class _AskReq:
         self.degraded = False
         self.replay = replay
         self.deadline = deadline
+        # request-trace id (ISSUE 11): captured from the ambient context
+        # at ingress, carried into the wave span's links, the cohort-tick
+        # stamp, the WAL ask record and the study's audit timeline
+        self.trace = trace
+        self.wave = None  # wave sequence number, stamped by the ticker
         # True once the served-ask record is in the WAL: a later failure
         # (doc landing) must NOT also journal a void record — two
         # records would replay the one seed draw twice
@@ -507,6 +558,7 @@ class StudyScheduler:
         self._wave_reqs = []
         self._tick_running = False
         self._draining = False
+        self._wave_seq = 0  # wave sequence: the id request spans fan into
         self.metrics = get_metrics("service")
         self.overload = overload
 
@@ -573,10 +625,14 @@ class StudyScheduler:
                 trials = FileTrials(os.path.join(self.store_root, study_id))
             st = Study(study_id, space, seed=seed, trials=trials,
                        space_spec=space_spec, **kwargs)
+            trace = reqtrace.current_trace_id()
             if self.journal is not None and not _replay:
                 self.journal.append(StudyJournal.admit_rec(
-                    study_id, space_spec, st.seed, st.admit_kwargs))
+                    study_id, space_spec, st.seed, st.admit_kwargs,
+                    trace=trace))
                 self.journal.sync()  # admits are rare; durable immediately
+            st.note("admit", trace=trace,
+                    replay=True if _replay else None)
             self._studies[study_id] = st
             self.metrics.counter("service.studies_created").inc()
             self.metrics.gauge("service.studies_live").set(live + 1)
@@ -590,9 +646,12 @@ class StudyScheduler:
         with self._lock:
             st = self._get(study_id)
             st.state = "closed"
+            trace = reqtrace.current_trace_id()
             if self.journal is not None:
-                self.journal.append(StudyJournal.close_rec(study_id))
+                self.journal.append(StudyJournal.close_rec(study_id,
+                                                           trace=trace))
                 self.journal.sync()
+            st.note("close", trace=trace)
             self._evict_from_cohort(st)
             self._gc_cohorts()
             self.metrics.gauge("service.studies_live").set(
@@ -624,12 +683,14 @@ class StudyScheduler:
             # evict from any smaller-capacity cohort it may still occupy
             self._evict_from_cohort(st)
             cohort.admit(st)
+            st.note("cohort_admit", cap=cohort.cap)
         return cohort
 
     def _evict_from_cohort(self, st):
         for cohort in self._cohorts.values():
             if cohort.evict(st.study_id) is not None:
                 self.metrics.counter("service.evictions").inc()
+                st.note("evict", cap=cohort.cap)
 
     def evict_idle(self, now=None):
         """Free cohort slots of studies idle past ``idle_sec`` (the study
@@ -688,11 +749,12 @@ class StudyScheduler:
         st.touch()
         st.n_asked += n
         self.metrics.counter("service.asks").inc()
+        trace = reqtrace.current_trace_id()
         if len(st.trials.trials) < st.n_startup_jobs:
             journaled = False
             try:
                 docs = rand.suggest(new_ids, st.domain, st.trials, seed)
-                self._journal_ask(st, new_ids, seed, "rand")
+                self._journal_ask(st, new_ids, seed, "rand", trace=trace)
                 journaled = True
                 self._land(st, docs)
                 if self.journal is not None:
@@ -703,30 +765,37 @@ class StudyScheduler:
                     # the draw is burned either way; keep replay's seed
                     # stream aligned (a journaled-but-unlanded record
                     # already accounts for the draw — never void twice)
-                    self._journal_void_ask(st, new_ids, seed)
+                    self._journal_void_ask(st, new_ids, seed, trace=trace)
                 raise
+            st.note("ask", tids=[int(t) for t in new_ids], algo="rand",
+                    startup=True, trace=trace)
             return docs
-        return _AskReq(st, new_ids, seed, deadline=deadline)
+        return _AskReq(st, new_ids, seed, deadline=deadline, trace=trace)
 
-    def _journal_ask(self, st, new_ids, seed, algo):
+    def _journal_ask(self, st, new_ids, seed, algo, trace=None):
         """WAL the served ask (ids + seed + serving algo) BEFORE its docs
         land — crash-ordering argument in ``journal.py``."""
         if self.journal is not None:
             self.journal.append(StudyJournal.ask_rec(
-                st.study_id, new_ids, seed, algo))
+                st.study_id, new_ids, seed, algo, trace=trace))
 
-    def _journal_void_ask(self, st, new_ids, seed):
+    def _journal_void_ask(self, st, new_ids, seed, trace=None,
+                          reason=None):
         """A FAILED/SHED ask still consumed one seed draw from the
         study's RNG stream AND its allocated trial ids (both
         irreversibly); record them as a ``void`` ask so replay advances
-        the stream and retires the same ids identically.  Best effort:
-        if the WAL itself is down, losing one draw record is logged,
-        not fatal (the serving path already failed)."""
+        the stream and retires the same ids identically.  One timeline
+        event per void, ``reason`` naming a shed (deadline) when that is
+        what failed it.  Best effort on the WAL side: if the journal
+        itself is down, losing one draw record is logged, not fatal
+        (the serving path already failed)."""
+        st.note("void", tids=[int(t) for t in new_ids], trace=trace,
+                reason=reason)
         if self.journal is None:
             return
         try:
             self.journal.append(StudyJournal.ask_rec(
-                st.study_id, new_ids, seed, "void"))
+                st.study_id, new_ids, seed, "void", trace=trace))
             self.journal.sync()
         except JournalError as e:
             logging.getLogger(__name__).warning(
@@ -769,16 +838,23 @@ class StudyScheduler:
         """Journal (write-ahead) + land one served ask.  Replay reqs are
         already in the WAL and must not journal twice."""
         if not r.replay:
-            self._journal_ask(r.study, r.new_ids, r.seed, r.algo)
+            self._journal_ask(r.study, r.new_ids, r.seed, r.algo,
+                              trace=r.trace)
             r.journaled = True
         self._land(r.study, docs)
         r.docs = docs
+        r.study.note("ask", tids=[int(t) for t in r.new_ids], algo=r.algo,
+                     wave=r.wave, trace=r.trace,
+                     degraded=True if r.degraded else None,
+                     replay=True if r.replay else None)
 
     def _dispatch_cohort(self, cohort, cohort_reqs, mesh, spec):
         """One cohort tick dispatch at ladder level ``spec``.  Returns the
         in-flight packed array, or None when this level serves the
         cohort host-side (rand floor / capacity bucket over the level's
-        limit)."""
+        limit).  The tick span is stamped with the wave id and the
+        request traces it serves (fan-in: the flow-event arc's device
+        hop)."""
         if spec["rand"] or (spec["cap_limit"] is not None
                             and cohort.cap > spec["cap_limit"]):
             return None
@@ -789,8 +865,14 @@ class StudyScheduler:
             demand[slot] = (np.asarray(
                 [int(i) & 0xFFFFFFFF for i in r.new_ids],
                 np.uint32), r.seed)
-        return cohort.tick(demand, donate=tpe._donation_enabled(),
-                           mesh=mesh, cand_scale=spec["cand_scale"])
+        wave = next((r.wave for r in cohort_reqs if r.wave is not None),
+                    None)
+        links = sorted({r.trace for r in cohort_reqs if r.trace})
+        with _tracer.span("service.tick", wave=wave, cap=cohort.cap,
+                          n_asks=len(cohort_reqs), ladder=spec["name"],
+                          **({"links": links} if links else {})):
+            return cohort.tick(demand, donate=tpe._donation_enabled(),
+                               mesh=mesh, cand_scale=spec["cand_scale"])
 
     def _readback_cohort(self, cohort, cohort_reqs, packed):
         """Block on one cohort's tick and build + land every req's docs
@@ -852,6 +934,14 @@ class StudyScheduler:
             faults += 1
             self.degrade.record_fault()
             spec = self._ladder_spec()
+            # the degrade decision, stamped with the traces it affects —
+            # "whose requests were served below full quality, and why"
+            _tracer.event(
+                "service.degrade", level=spec["name"],
+                fault=f"{type(exc).__name__}: {exc}"[:200],
+                wave=next((r.wave for r in cohort_reqs
+                           if r.wave is not None), None),
+                links=sorted({r.trace for r in cohort_reqs if r.trace}))
             try:
                 packed = self._dispatch_cohort(
                     cohort, cohort_reqs, mesh, spec)
@@ -870,7 +960,24 @@ class StudyScheduler:
         degrade ladder (never failing the wave while the rand floor can
         serve it); the wave's wall time feeds the overload guard's
         ``Retry-After`` EWMA; served asks journal before landing and the
-        WAL fsyncs ONCE per wave, before any asker unblocks."""
+        WAL fsyncs ONCE per wave, before any asker unblocks.
+
+        The wave is one span with ``links`` = the request traces it
+        serves (fan-in: N request spans → one wave span), and every req
+        is stamped with the wave's sequence number — the join key the
+        audit timeline and the flow-event export use."""
+        self._wave_seq += 1
+        wave = self._wave_seq
+        for r in reqs:
+            r.wave = wave
+        attrs = {"wave": wave, "n_reqs": len(reqs)}
+        links = sorted({r.trace for r in reqs if r.trace})
+        if links:
+            attrs["links"] = links
+        with _tracer.span("service.wave", **attrs):
+            self._run_wave_inner(reqs)
+
+    def _run_wave_inner(self, reqs):
         from .._env import parse_shard
         from ..parallel import sharding as _sh
 
@@ -1015,8 +1122,15 @@ class StudyScheduler:
                 # replay draw the failed seed twice
                 req.study.n_asked -= len(req.new_ids)
                 if not req.journaled:
-                    self._journal_void_ask(req.study, req.new_ids,
-                                           req.seed)
+                    # the void note names a deadline shed explicitly —
+                    # ONE timeline event per failed/shed ask, matching
+                    # the single WAL void record
+                    self._journal_void_ask(
+                        req.study, req.new_ids, req.seed,
+                        trace=req.trace,
+                        reason=("deadline_shed"
+                                if isinstance(req.error, DeadlineExceeded)
+                                else None))
         if req.error is not None:
             raise req.error
         self.metrics.histogram("service.ask_sec").observe(
@@ -1055,7 +1169,8 @@ class StudyScheduler:
                     # repeated failures wedge the study at 429
                     r.study.n_asked -= len(r.new_ids)
                     if not r.journaled:
-                        self._journal_void_ask(r.study, r.new_ids, r.seed)
+                        self._journal_void_ask(r.study, r.new_ids, r.seed,
+                                               trace=r.trace)
                     failed.append(r)
                 else:
                     out.setdefault(r.study.study_id, []).extend(
@@ -1090,10 +1205,12 @@ class StudyScheduler:
             if doc["state"] == JOB_STATE_DONE:
                 raise DuplicateTellError(
                     f"{study_id}: trial {tid} was already told")
+            trace = reqtrace.current_trace_id()
             if self.journal is not None:
                 self.journal.append(StudyJournal.tell_rec(
-                    study_id, tid, loss, status))
+                    study_id, tid, loss, status, trace=trace))
                 self.journal.sync()
+            st.note("tell", tid=tid, trace=trace)
             self._apply_tell(st, doc, loss, status)
             if st.state == "done":
                 self._maybe_compact()
@@ -1173,6 +1290,12 @@ class StudyScheduler:
                     stats["errors"] += 1
                     logging.getLogger(__name__).warning(
                         "service: WAL replay failed for %r: %s", rec, e)
+            for st in self._studies.values():
+                # the crash-resume boundary on every resumed timeline:
+                # everything before this marker was replayed from the
+                # WAL, everything after is live traffic
+                st.note("resume", n_trials=st.n_trials,
+                        n_told=st.n_told)
             self.metrics.gauge("service.studies_live").set(
                 sum(1 for s in self._studies.values()
                     if s.state == "active"))
@@ -1270,8 +1393,11 @@ class StudyScheduler:
             if rec.get("algo") == "rand":
                 docs = rand.suggest(tids, st.domain, st.trials, seed)
                 self._land(st, docs)
+                st.note("ask", tids=tids, algo="rand", replay=True,
+                        trace=rec.get("trace"))
             else:
-                req = _AskReq(st, tids, seed, replay=True)
+                req = _AskReq(st, tids, seed, replay=True,
+                              trace=rec.get("trace"))
                 self._run_wave([req])
                 if req.error is not None:
                     raise req.error
@@ -1294,6 +1420,8 @@ class StudyScheduler:
                 # the scheduler-side bookkeeping needs replaying.
                 self._replay_ctx["told"].add(key)
                 st.n_told += 1
+                st.note("tell", tid=tid, replay=True,
+                        trace=rec.get("trace"))
                 stats["tells"] += 1
                 if (st.max_trials is not None
                         and st.n_trials >= st.max_trials
@@ -1303,6 +1431,8 @@ class StudyScheduler:
                 self._replay_ctx["told"].add(key)
                 self._apply_tell(st, doc, rec.get("loss"),
                                  rec.get("status"))
+                st.note("tell", tid=tid, replay=True,
+                        trace=rec.get("trace"))
                 stats["tells"] += 1
         elif kind == "close":
             st.state = "closed"
@@ -1364,6 +1494,14 @@ class StudyScheduler:
     def study_status(self, study_id):
         with self._lock:
             return self._get(study_id).status_dict()
+
+    def study_timeline(self, study_id):
+        """The ``GET /study/<id>/timeline`` payload: the study's live
+        audit timeline (admit, every ask with wave/algo/degrade/trace,
+        every tell, shed/void, evict/re-admit, resume boundary).  The
+        WAL holds the durable copy; ``obs.report --study`` joins both."""
+        with self._lock:
+            return self._get(study_id).timeline_dict()
 
     def studies_status(self):
         """The ``GET /studies`` payload: per-study status plus the

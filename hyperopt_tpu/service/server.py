@@ -16,9 +16,24 @@ same ``ThreadingHTTPServer`` + daemon-thread shape, now serving
 * ``POST /close`` — ``{"study_id": ...}`` frees the study's slot.
 * ``GET /studies`` — the study table: per-study status + cohort/slot
   roll-up + cohort-program cache counters.
+* ``GET /study/<id>/timeline`` — the study's live audit timeline
+  (ISSUE 11): admit, every ask (wave/algo/degrade/trace), every tell,
+  shed/void, evict/re-admit, crash-resume boundary.
 * ``GET /metrics`` / ``GET /snapshot`` — the obs integration:
   Prometheus exposition of every registry namespace (the ``service.*``
-  family rides along) and a JSON snapshot with the study table.
+  family and the ``slo_*`` error-budget gauges ride along) and a JSON
+  snapshot with the study table, degrade-ladder state and SLO section.
+
+Request observability (ISSUE 11, armed by default): every request
+carries a W3C-``traceparent``-style trace context — extracted from the
+inbound header (malformed ones degrade to a fresh trace, never an
+error) or minted — echoed on every response (JSON ``trace`` field +
+``X-Trace-Id`` header, 429/503 included) and threaded through the
+scheduler's wave/tick spans and the WAL.  The SLO plane
+(``obs/slo.py``, ``HYPEROPT_TPU_SERVICE_SLO``) evaluates availability /
+ask-latency / shed-rate burn rates from the handler's own traffic; the
+opt-in access log (``HYPEROPT_TPU_SERVICE_ACCESS_LOG``) writes one
+JSONL record per request and taps the flight ring.
 
 Error mapping is in-band and typed: schema errors answer 400, unknown
 studies 404, quota exhaustion and load sheds 429 (+ ``Retry-After``
@@ -53,7 +68,9 @@ import logging
 import threading
 import time
 
+from ..obs import reqtrace
 from ..obs.serve import prometheus_text, split_hostport
+from ..obs.trace import JsonlSink, Tracer
 from .overload import AdmissionGuard, Deadline, OverloadError
 from .scheduler import (DrainingError, DuplicateTellError, StudyQuotaError,
                         StudyScheduler, UnknownStudyError)
@@ -76,6 +93,17 @@ class _RequestError(Exception):
         self.status = int(status)
 
 
+def _timeline_study_id(path):
+    """``/study/<id>/timeline`` → the study id, else None (one level
+    only — a nested or empty id is not this route)."""
+    if not (path.startswith("/study/") and path.endswith("/timeline")):
+        return None
+    sid = path[len("/study/"):-len("/timeline")].rstrip("/")
+    if not sid or "/" in sid:
+        return None
+    return sid
+
+
 class ServiceHTTPServer:
     """Daemon-thread ask/tell server over one scheduler (see module
     docstring).  Fail-open lifecycle matches ``obs/serve.py``:
@@ -83,8 +111,9 @@ class ServiceHTTPServer:
     raising, ``stop()`` is idempotent."""
 
     def __init__(self, port, scheduler=None, host=None, store_root=None,
-                 guard=None):
-        from .._env import parse_service_deadline_ms
+                 guard=None, trace=None, slo=None, access_log=None):
+        from .._env import (parse_reqtrace, parse_service_access_log,
+                            parse_service_deadline_ms, parse_service_slo)
 
         try:
             if host is None:
@@ -102,6 +131,28 @@ class ServiceHTTPServer:
             # EWMA is what sizes every Retry-After hint
             self.scheduler.overload = self.guard
         self.default_deadline_ms = parse_service_deadline_ms()
+        # request-trace plane (ISSUE 11): parse/mint/echo/stamp trace
+        # context per request.  Pure metadata, zero threads; `trace=False`
+        # (or HYPEROPT_TPU_REQTRACE=off) restores the pre-PR handler path
+        self.trace_enabled = (parse_reqtrace() if trace is None
+                              else bool(trace))
+        # handler spans feed the flight ring through a sink-less tracer
+        self._tracer = Tracer()
+        # SLO error-budget plane: None = disarmed (no gauges, no
+        # escalation); targets resolve from HYPEROPT_TPU_SERVICE_SLO
+        self.slo = None
+        if slo is not False:
+            targets = parse_service_slo() if slo in (None, True) else slo
+            if targets is not None:
+                from ..obs.slo import SLOPlane
+
+                self.slo = SLOPlane(targets,
+                                    metrics=self.scheduler.metrics,
+                                    escalation=self._slo_escalation)
+        # opt-in structured access log (JSONL; one record per request)
+        log_path = (parse_service_access_log() if access_log is None
+                    else (access_log or None))
+        self.access_log = JsonlSink(log_path) if log_path else None
         self._httpd = None
         self._thread = None
         self._stopped = False
@@ -111,12 +162,136 @@ class ServiceHTTPServer:
     def handle(self, method, path, body, headers=None):
         """Route one request; returns ``(status, payload dict)``.  Pure
         (no socket I/O) so tests can drive it directly.  ``headers`` is
-        a lower-cased mapping (the deadline header rides in it); a 429/
-        503 payload carries ``retry_after`` seconds, which the HTTP
-        layer also emits as a ``Retry-After`` header."""
-        status, payload = self._handle(method, path, body, headers or {})
+        a lower-cased mapping (the deadline and ``traceparent`` headers
+        ride in it); a 429/503 payload carries ``retry_after`` seconds,
+        which the HTTP layer also emits as a ``Retry-After`` header.
+
+        Trace plumbing (ISSUE 11, armed by default): a valid inbound
+        ``traceparent`` continues the caller's trace, a malformed one
+        degrades to a fresh trace — NEVER a 4xx/5xx (the fuzz pin) —
+        and every response carries the trace id in its JSON body
+        (``trace``) plus an ``X-Trace-Id`` header from the HTTP layer,
+        so a client can correlate its own retries, including through a
+        429/503."""
+        headers = headers or {}
+        observing = self.slo is not None or self.access_log is not None
+        if not self.trace_enabled and not observing:
+            # fully disarmed: the pre-PR handler path, nothing extra
+            status, payload = self._handle(method, path, body, headers)
+            self._count_response(method, path, status)
+            return status, payload
+        t0 = time.perf_counter()
+        req_id = reqtrace.sanitize_request_id(headers.get("x-request-id"))
+        if self.trace_enabled:
+            ctx = reqtrace.extract_or_mint(headers.get("traceparent"))
+            with reqtrace.use(ctx):
+                with self._tracer.span("service.handle",
+                                       trace=ctx.trace_id,
+                                       span=ctx.span_id, method=method,
+                                       path=path):
+                    status, payload = self._handle(method, path, body,
+                                                   headers)
+            if isinstance(payload, dict):
+                payload.setdefault("trace", ctx.trace_id)
+        else:
+            # tracing off, but the SLO plane / access log still observe
+            ctx = None
+            status, payload = self._handle(method, path, body, headers)
+        latency = time.perf_counter() - t0
+        if req_id and isinstance(payload, dict):
+            # echo a sane client X-Request-Id (hostile ones were dropped
+            # by the sanitizer) — the client's own correlation token
+            payload.setdefault("request_id", req_id)
         self._count_response(method, path, status)
+        self._observe_response(method, path, status, latency, payload,
+                               ctx, req_id)
         return status, payload
+
+    def _observe_response(self, method, path, status, latency_sec,
+                          payload, ctx, req_id):
+        """Post-response observability: feed the SLO plane and write the
+        access-log record (JSONL + flight ring).  Never raises."""
+        ep = self._endpoint_label(method, path)
+        shed = bool(status == 429 and isinstance(payload, dict)
+                    and payload.get("retry_after") is not None)
+        if self.slo is not None:
+            try:
+                self.slo.record_request(ep, status,
+                                        latency_sec=latency_sec,
+                                        shed=shed)
+            except Exception:  # noqa: BLE001 - observability never fails a req
+                # log once, keep the plane alive: disabling it on a
+                # transient fault would freeze the last-published slo_*
+                # gauges at plausible-but-dead values on /metrics
+                if not self._slo_warned:
+                    self._slo_warned = True
+                    logger.warning("slo plane record failed (continuing)",
+                                   exc_info=True)
+        if self.access_log is None:
+            return
+        try:
+            rec = {"kind": "access", "ts": time.time(), "method": method,
+                   "path": path, "status": int(status),
+                   "latency_ms": round(latency_sec * 1e3, 3),
+                   "trace": ctx.trace_id if ctx is not None else None}
+            if req_id:
+                rec["request_id"] = req_id
+            if isinstance(payload, dict):
+                if status >= 400 and payload.get("error"):
+                    rec["reason"] = str(payload["error"])[:200]
+                if shed:
+                    rec["shed"] = True
+                if payload.get("degraded"):
+                    rec["degraded"] = True
+                if payload.get("study_id"):
+                    rec["study_id"] = payload["study_id"]
+            self.access_log.write(rec)
+            # the flight-ring tap: the last requests ride into every
+            # postmortem dump next to the spans that served them
+            from ..obs.flight import get_flight
+
+            get_flight().record(rec)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _slo_escalation(self):
+        """The SLO plane's fast-burn escalation: ONE bounded device
+        capture when the error budget starts burning page-hot, so "SLO
+        violated" comes with the device trace of the slow wave.  Needs
+        the capture plane armed (``HYPEROPT_TPU_PROFILE=<dir>``);
+        without it the escalation only logs.  The capture itself runs on
+        a short-lived background thread — the hook fires from inside a
+        request's ``_observe_response`` (or a scrape), and blocking THAT
+        thread for the bounded capture window would inject seconds of
+        latency into exactly the overloaded path the SLO just flagged
+        (the watchdog's ``capture_on_stall`` makes the same choice)."""
+        import os as _os
+
+        from ..obs.profiler import DeviceProfiler, split_profile_mode
+
+        cap_dir, _full = split_profile_mode(
+            _os.environ.get("HYPEROPT_TPU_PROFILE"))
+        if cap_dir is None:
+            logger.warning(
+                "SLO fast burn-rate alert: error budget burning hot "
+                "(no device capture — arm HYPEROPT_TPU_PROFILE=<dir> to "
+                "get one)")
+            return
+        prof = self._escalation_profiler
+        if prof is None:
+            prof = self._escalation_profiler = DeviceProfiler(cap_dir)
+
+        def _capture():
+            rec = prof.capture(2.0, reason="slo_burn")
+            logger.warning("SLO fast burn-rate alert: captured device "
+                           "trace (ok=%s dir=%s)", rec.get("ok"),
+                           rec.get("dir"))
+
+        threading.Thread(target=_capture, name="hyperopt-slo-escalation",
+                         daemon=True).start()
+
+    _escalation_profiler = None
+    _slo_warned = False
 
     @staticmethod
     def _endpoint_label(method, path):
@@ -127,6 +302,8 @@ class ServiceHTTPServer:
                  "/metrics", "/snapshot", "/")
         if path in known:
             return path.strip("/") or "root"
+        if _timeline_study_id(path) is not None:
+            return "timeline"
         return "other"
 
     def _count_response(self, method, path, status):
@@ -157,13 +334,17 @@ class ServiceHTTPServer:
                     return 200, sched.studies_status()
                 if path == "/snapshot":
                     return 200, self.snapshot_dict()
+                sid = _timeline_study_id(path)
+                if sid is not None:
+                    return 200, sched.study_timeline(sid)
                 if path == "/":
                     return 200, {
                         "ok": True,
                         "endpoints": ["POST /study", "POST /ask",
                                       "POST /tell", "POST /close",
-                                      "GET /studies", "GET /metrics",
-                                      "GET /snapshot"]}
+                                      "GET /studies",
+                                      "GET /study/<id>/timeline",
+                                      "GET /metrics", "GET /snapshot"]}
                 raise _RequestError(404, f"no such endpoint: {path}")
             if method != "POST":
                 raise _RequestError(405, f"{method} not supported")
@@ -288,8 +469,14 @@ class ServiceHTTPServer:
 
     def snapshot_dict(self):
         """``/snapshot``: the service metrics namespace plus the study
-        table — the obs-plane view of the serving layer."""
-        out = {"ts": time.time(), "endpoint": "snapshot"}
+        table — the obs-plane view of the serving layer.  Carries the
+        SLO section (budget/burn per objective, freshly evaluated) and
+        the degrade-ladder state so ``obs.top``'s service view renders
+        from one GET."""
+        out = {"ts": time.time(), "endpoint": "snapshot",
+               "service": True}
+        if self.slo is not None:
+            out["slo"] = self.slo.publish()  # refresh gauges on scrape
         out["sections"] = {
             "service": self.scheduler.metrics.snapshot()["metrics"]}
         status = self.scheduler.studies_status()
@@ -297,6 +484,11 @@ class ServiceHTTPServer:
         out["cohorts"] = status["cohorts"]
         out["slot_utilization"] = status["slot_utilization"]
         out["cohort_cache"] = status["cohort_cache"]
+        out["draining"] = status.get("draining", False)
+        if "degrade" in status:
+            out["degrade"] = status["degrade"]
+        if "wal" in status:
+            out["wal"] = status["wal"]
         return out
 
     # -- lifecycle ---------------------------------------------------------
@@ -371,6 +563,13 @@ def _make_handler(server):
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
+            if isinstance(payload, dict) and payload.get("trace"):
+                # echo the request's trace id on EVERY response — incl.
+                # 429/503 — so a client can correlate its own retries
+                self.send_header("X-Trace-Id", str(payload["trace"]))
+            if isinstance(payload, dict) and payload.get("request_id"):
+                self.send_header("X-Request-Id",
+                                 str(payload["request_id"]))
             if (status in (429, 503) and isinstance(payload, dict)
                     and payload.get("retry_after") is not None):
                 # RFC 7231 delta-seconds is an INTEGER — a fractional
@@ -389,6 +588,11 @@ def _make_handler(server):
             path = self.path.partition("?")[0]
             try:
                 if method == "GET" and path == "/metrics":
+                    if server.slo is not None:
+                        try:  # refresh slo_* gauges at scrape time
+                            server.slo.publish()
+                        except Exception:  # noqa: BLE001 - fail-open scrape
+                            pass
                     server._count_response(method, path, 200)
                     self._answer(
                         200, prometheus_text().encode(),

@@ -41,6 +41,13 @@
 # bit-identical to an undisturbed reference, 2x-capacity overload must
 # shed with 429/Retry-After and lose zero tells, and injected tick
 # faults must walk the degrade ladder without killing the server.
+# Opt-in SLO gate: SLO_GATE=1 additionally re-runs the request-trace /
+# SLO / timeline suites and then scripts/slo_smoke.py — a real
+# subprocess server with tracing + SLO + access log armed serves one
+# traced ServiceClient ask; the trace id must correlate across the
+# response, the on-disk WAL ask record, GET /study/<id>/timeline and
+# obs.report --study, /metrics must lint with the slo_* gauge families,
+# and the server must still drain cleanly on SIGTERM.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -92,5 +99,11 @@ if [ "${SERVICE_CHAOS_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_journal.py tests/test_overload.py \
         tests/test_service.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/service_chaos_smoke.py || exit 1
+fi
+if [ "${SLO_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_reqtrace.py tests/test_slo.py \
+        tests/test_timeline.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/slo_smoke.py || exit 1
 fi
 exit 0

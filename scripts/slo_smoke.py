@@ -1,0 +1,168 @@
+"""SLO_GATE end-to-end smoke (ISSUE 11): request tracing + audit
+timeline + SLO plane against a REAL subprocess server.
+
+What it pins (the cross-process correlation no in-process test can):
+
+* a real ``python -m hyperopt_tpu.service.server`` subprocess with WAL
+  store, access log, SLO plane and tracing armed;
+* ONE traced ``ServiceClient`` ask: the trace id the client minted comes
+  back on the response AND lands in the WAL ask record on disk AND in
+  the ``GET /study/<id>/timeline`` payload — the cross-process slice of
+  the five-layer correlation pin (the in-process layers are tier-1,
+  tests/test_timeline.py);
+* ``GET /metrics`` passes the Prometheus exposition lint and carries the
+  ``hyperopt_tpu_slo_*`` gauge families;
+* ``obs.report --study <id>`` renders the complete timeline from the
+  store (run against the live WAL, before drain-time compaction
+  collapses history into a snapshot);
+* the access log holds one JSONL record per request, trace ids included;
+* the server still drains cleanly on SIGTERM (exit 0).
+
+Opt in via ``SLO_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+def fail(msg):
+    print(f"slo_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def main():
+    from validate_scrape import validate_metrics_text
+
+    from hyperopt_tpu.service.client import ServiceClient
+
+    tmp = tempfile.mkdtemp(prefix="slo_smoke_")
+    store = os.path.join(tmp, "store")
+    access_log = os.path.join(tmp, "access.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["HYPEROPT_TPU_SERVICE_ACCESS_LOG"] = access_log
+    env["HYPEROPT_TPU_SERVICE_SLO"] = "on"
+    env["HYPEROPT_TPU_REQTRACE"] = "on"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--port", "0", "--announce", "--store", store],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("SERVICE_URL "):
+                url = line.split(None, 1)[1].strip()
+                break
+            if proc.poll() is not None:
+                break
+        if url is None:
+            print((proc.stderr.read() or "")[-2000:], file=sys.stderr)
+            return fail("server never announced")
+        print(f"slo_smoke: server up at {url} (pid {proc.pid})")
+
+        client = ServiceClient(url, trace=True)
+        sid = client.create_study(space=SPACE_SPEC, seed=5,
+                                  n_startup_jobs=1)
+        # startup rand ask + tell, then THE traced TPE ask
+        t = client.ask(sid)[0]
+        client.tell(sid, t["tid"], loss=0.25)
+        trials = client.ask(sid)
+        trace = client.last_trace
+        if not (isinstance(trace, str) and len(trace) == 32):
+            return fail(f"client minted no trace id: {trace!r}")
+        print(f"slo_smoke: traced ask served (trace {trace[:16]}..)")
+
+        # layer: the WAL ask record on disk carries the trace id
+        from hyperopt_tpu.service.journal import StudyJournal, wal_path_for
+
+        wal_recs = list(StudyJournal(wal_path_for(store)).records())
+        tpe_asks = [r for r in wal_recs if r.get("kind") == "ask"
+                    and r.get("algo") == "tpe"]
+        if not tpe_asks or tpe_asks[-1].get("trace") != trace:
+            return fail(f"WAL ask record not stamped with {trace[:16]}..: "
+                        f"{tpe_asks[-1] if tpe_asks else None}")
+
+        # layer: the live timeline endpoint shows the same id
+        import urllib.request
+
+        with urllib.request.urlopen(f"{url}/study/{sid}/timeline",
+                                    timeout=30) as r:
+            tl = json.loads(r.read())
+        tl_asks = [e for e in tl.get("events", [])
+                   if e.get("event") == "ask" and e.get("algo") == "tpe"]
+        if not tl_asks or tl_asks[-1].get("trace") != trace:
+            return fail("timeline endpoint missing the traced ask")
+
+        # obs.report --study reconstructs the timeline from the store
+        rep = subprocess.run(
+            [sys.executable, "-m", "hyperopt_tpu.obs.report",
+             "--study", sid, store],
+            cwd=_REPO, env=env, capture_output=True, text=True,
+            timeout=120)
+        if rep.returncode != 0:
+            return fail(f"obs.report --study failed: {rep.stderr[-500:]}")
+        if trace[:16] not in rep.stdout or "algo=tpe" not in rep.stdout:
+            return fail("obs.report --study did not render the traced "
+                        f"ask:\n{rep.stdout[-800:]}")
+        print("slo_smoke: obs.report --study renders the traced timeline")
+
+        # /metrics: exposition lint + the slo_* gauge families
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        errs = validate_metrics_text(text)
+        if errs:
+            return fail("exposition lint: " + "; ".join(errs[:5]))
+        for fam in ("hyperopt_tpu_slo_availability_budget_remaining_frac",
+                    "hyperopt_tpu_slo_ask_latency_burn_fast",
+                    "hyperopt_tpu_slo_shed_rate_burn_slow"):
+            if fam not in text:
+                return fail(f"/metrics missing slo family {fam}")
+        print("slo_smoke: /metrics lints clean with slo_* gauges")
+
+        # the access log: one record per request, trace ids throughout
+        with open(access_log) as f:
+            acc = [json.loads(ln) for ln in f if ln.strip()]
+        posts = [a for a in acc if a.get("method") == "POST"]
+        if len(posts) < 4:  # study + ask + tell + ask
+            return fail(f"access log has {len(posts)} POST records, "
+                        "expected >= 4")
+        if not all(len(a.get("trace") or "") == 32 for a in posts):
+            return fail("access-log records missing trace ids")
+        if trace not in {a.get("trace") for a in posts}:
+            return fail("the traced ask never hit the access log")
+        print(f"slo_smoke: access log carries {len(acc)} records with "
+              "trace ids")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 0:
+            return fail(f"server exited {rc} on SIGTERM")
+        print("slo_smoke: OK — traced ask correlated across client, WAL, "
+              "timeline, report and access log; slo_* gauges lint clean; "
+              "clean drain")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,12 @@ Checked invariants — the contract a trace viewer actually relies on:
 
 * top level is ``{"traceEvents": [...]}`` (object form) or a bare event
   array;
-* every event is an object with a known ``ph`` (``X i B E M C``);
+* every event is an object with a known ``ph`` (``X i B E M C s t f``);
+* request-trace flow events (``s``/``t``/``f`` — obs/export.py
+  ``flow_events``, ISSUE 11): every flow id opens with exactly one
+  ``s``, terminates with exactly one ``f`` (no dangling flows), and
+  every flow event binds to an enclosing ``X`` slice on its
+  ``(pid, tid)`` track;
 * non-metadata events carry numeric ``ts`` >= 0 and integer ``pid``/``tid``;
 * complete (``X``) events have ``dur`` >= 0;
 * duration ``B``/``E`` events are matched per ``(pid, tid)`` track (no
@@ -52,7 +57,7 @@ import argparse
 import json
 import sys
 
-_KNOWN_PH = {"X", "i", "I", "B", "E", "M", "C"}
+_KNOWN_PH = {"X", "i", "I", "B", "E", "M", "C", "s", "t", "f"}
 
 #: the loop-boundary annotation names obs/profiler.py stamps onto the
 #: device timeline — events with these names must carry trial/generation
@@ -73,6 +78,8 @@ def validate_events(events):
     named_pids = set()  # pids with a process_name metadata record
     event_pids = set()  # pids carrying timeline events
     seen_non_meta = False
+    flow_events = {}  # flow id -> [(ph, ts, pid, tid, where)]
+    slices = {}  # (pid, tid) -> [(start, end)] X-slice intervals
     for i, e in enumerate(events):
         where = f"event[{i}]"
         if not isinstance(e, dict):
@@ -124,10 +131,24 @@ def validate_events(events):
                     errors.append(
                         f"{where}: counter {name!r} arg {k!r} is "
                         f"non-numeric ({v!r})")
+        if ph in ("s", "t", "f"):
+            # request-trace flow events (obs/export.py flow_events):
+            # collected here, invariants checked after the pass — an id
+            # must open with s, close with f, and every event must bind
+            # to an enclosing X slice on its (pid, tid) track
+            fid = e.get("id")
+            if fid is None:
+                errors.append(f"{where}: flow event without an id")
+            else:
+                flow_events.setdefault(fid, []).append(
+                    (ph, ts, pid, tid, where))
+            continue
         if ph == "X":
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 errors.append(f"{where}: X event with bad dur {dur!r}")
+            else:
+                slices.setdefault(track, []).append((ts, ts + dur))
             base = (name or "").split("#", 1)[0]
             if base in ANNOTATION_NAMES and "#" not in (name or "") \
                     and not e.get("args"):
@@ -148,6 +169,27 @@ def validate_events(events):
         for name in stack:
             errors.append(
                 f"unclosed B event {name!r} on track pid={pid} tid={tid}")
+    for fid, evs in sorted(flow_events.items(), key=lambda kv: str(kv[0])):
+        evs.sort(key=lambda e: e[1])
+        phs = [e[0] for e in evs]
+        if phs.count("s") != 1:
+            errors.append(f"flow id {fid}: {phs.count('s')} start (s) "
+                          f"events (need exactly 1)")
+        elif phs[0] != "s":
+            errors.append(f"flow id {fid}: does not open with s "
+                          f"(opens {phs[0]!r})")
+        if phs.count("f") != 1:
+            errors.append(f"flow id {fid}: {phs.count('f')} finish (f) "
+                          f"events — a dangling flow never terminates")
+        elif phs[-1] != "f":
+            errors.append(f"flow id {fid}: f is not the final event")
+        for ph, ts, pid, tid, where in evs:
+            track_slices = slices.get((pid, tid), ())
+            if not any(s0 <= ts <= s1 for s0, s1 in track_slices):
+                errors.append(
+                    f"{where}: flow {ph!r} id {fid} has no enclosing X "
+                    f"slice on pid={pid} tid={tid} at ts={ts} (binding "
+                    f"endpoint missing)")
     for pid in sorted(event_pids - named_pids):
         errors.append(f"pid={pid} carries timeline events but no "
                       "process_name metadata (unnamed track group)")

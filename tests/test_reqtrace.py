@@ -1,0 +1,394 @@
+"""ISSUE 11: request-scoped trace context — wire-format parsing, hostile
+header fuzzing (degrade-to-fresh-trace, 400-never-500), client trace
+continuity across retries, response echo, and the disarmed pins (zero
+new threads; armed tracing never changes proposals)."""
+
+import json
+import threading
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu._env import parse_reqtrace, parse_service_access_log
+from hyperopt_tpu.obs import reqtrace
+from hyperopt_tpu.service.client import ServiceClient
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+VALID_TP = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+
+
+def _server(**kw):
+    kw.setdefault("slo", False)  # slo plane has its own suite
+    return ServiceHTTPServer(0, scheduler=StudyScheduler(wal=False), **kw)
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def test_parse_valid_traceparent():
+    ctx = reqtrace.parse(VALID_TP)
+    assert ctx is not None
+    assert ctx.trace_id == "4bf92f3577b34da6a3ce929d0e0e4736"
+    assert ctx.span_id == "00f067aa0ba902b7"
+    assert ctx.traceparent().startswith("00-4bf92f3577b34da6a3ce929d0e0e4736-")
+
+
+def test_mint_and_child_shapes():
+    root = reqtrace.mint()
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    assert reqtrace.parse(root.traceparent()) is not None
+    kid = reqtrace.child(root)
+    assert kid.trace_id == root.trace_id
+    assert kid.span_id != root.span_id
+    assert kid.parent_id == root.span_id
+    # two mints never collide (the ids ARE the correlation key)
+    assert reqtrace.mint().trace_id != root.trace_id
+
+
+def test_contextvar_use_and_restore():
+    assert reqtrace.current() is None
+    ctx = reqtrace.mint()
+    with reqtrace.use(ctx):
+        assert reqtrace.current() is ctx
+        assert reqtrace.current_trace_id() == ctx.trace_id
+        with reqtrace.use(reqtrace.child(ctx)) as inner:
+            assert reqtrace.current() is inner
+        assert reqtrace.current() is ctx
+    assert reqtrace.current() is None
+    with reqtrace.use(None):  # None is a no-op, not an error
+        assert reqtrace.current() is None
+
+
+#: the hostile traceparent corpus: every entry must parse to None
+HOSTILE_TRACEPARENTS = [
+    "",  # empty
+    "00",  # truncated
+    "00-4bf92f3577b34da6a3ce929d0e0e4736",  # missing span/flags
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7",  # no flags
+    "ff-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # ver ff
+    "0-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # 1-char ver
+    "zz-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # non-hex
+    "00-4bf92f3577b34da6a3ce929d0e0e47-00f067aa0ba902b7-01",  # short trace
+    "00-4bf92f3577b34da6a3ce929d0e0e4736ab-00f067aa0ba902b7-01",  # long
+    "00-4BF92F3577B34DA6A3CE929D0E0E4736-00f067aa0ba902b7-01",  # UPPERCASE
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902-01",  # short span
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7zz-01",  # bad span
+    "00-" + "0" * 32 + "-00f067aa0ba902b7-01",  # all-zero trace id
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-" + "0" * 16 + "-01",  # zero span
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-1",  # short flags
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01-extra",  # v00+
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01\n",  # ctl byte
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01\x00",  # NUL
+    "\x1b[2J" + VALID_TP,  # ANSI escape prefix
+    "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01" + "a" * 500,
+    "a" * 10_000,  # oversized
+    "тест-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01",  # non-ascii
+]
+
+
+@pytest.mark.parametrize("header", HOSTILE_TRACEPARENTS,
+                         ids=range(len(HOSTILE_TRACEPARENTS)))
+def test_hostile_traceparent_parses_to_none(header):
+    assert reqtrace.parse(header) is None
+
+
+def test_parse_non_string_inputs():
+    for bad in (None, 7, b"00-aa-bb-01", ["00"], {"tp": 1}):
+        assert reqtrace.parse(bad) is None
+
+
+def test_forward_compat_version():
+    # a future version with a trailing field still parses (the W3C
+    # forward-compat rule); version 00 with extra fields does not
+    ctx = reqtrace.parse(VALID_TP.replace("00-", "01-", 1) + "-future")
+    assert ctx is not None and ctx.trace_id == VALID_TP.split("-")[1]
+
+
+def test_sanitize_request_id():
+    assert reqtrace.sanitize_request_id("req-1_2.3:ok") == "req-1_2.3:ok"
+    assert reqtrace.sanitize_request_id("") is None
+    assert reqtrace.sanitize_request_id("x" * 1000) is None
+    assert reqtrace.sanitize_request_id("evil\nheader") is None
+    assert reqtrace.sanitize_request_id("\x00\x01") is None
+    assert reqtrace.sanitize_request_id(42) is None
+
+
+# ---------------------------------------------------------------------------
+# server-side: degrade to fresh, echo, 400-never-500
+# ---------------------------------------------------------------------------
+
+
+def _mk_study(srv, **kw):
+    body = {"space": SPACE_SPEC, "seed": 7, "n_startup_jobs": 2}
+    body.update(kw)
+    code, r = srv.handle("POST", "/study", body)
+    assert code == 200, r
+    return r["study_id"]
+
+
+def test_valid_traceparent_continues_the_trace():
+    srv = _server()
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"traceparent": VALID_TP})
+    assert code == 200
+    assert r["trace"] == "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+@pytest.mark.parametrize("header", HOSTILE_TRACEPARENTS,
+                         ids=range(len(HOSTILE_TRACEPARENTS)))
+def test_hostile_header_degrades_to_fresh_trace_never_5xx(header):
+    srv = _server()
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"traceparent": header})
+    # the request itself is FINE: it must be served (200), with a FRESH
+    # trace (never the hostile value), and never a 5xx
+    assert code == 200, (header, r)
+    assert isinstance(r.get("trace"), str) and len(r["trace"]) == 32
+    assert r["trace"] != header
+    assert all(c in "0123456789abcdef" for c in r["trace"])
+
+
+def test_hostile_header_on_bad_request_answers_4xx_never_500():
+    srv = _server()
+    for header in HOSTILE_TRACEPARENTS[:8]:
+        # a malformed BODY under a hostile header: still the typed 4xx
+        code, r = srv.handle("POST", "/ask", {},
+                             headers={"traceparent": header,
+                                      "x-request-id": "bad\x00id"})
+        assert code == 400, (header, code, r)
+        assert "trace" in r  # errors carry the correlation id too
+
+
+def test_trace_echoed_on_404_and_quota_429():
+    srv = ServiceHTTPServer(
+        0, scheduler=StudyScheduler(max_studies=1, wal=False), slo=False)
+    _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": "study-nope"},
+                         headers={"traceparent": VALID_TP})
+    assert code == 404
+    assert r["trace"] == "4bf92f3577b34da6a3ce929d0e0e4736"
+    code, r = srv.handle("POST", "/study", {"space": SPACE_SPEC},
+                         headers={"traceparent": VALID_TP})
+    assert code == 429  # quota
+    assert r["trace"] == "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+def test_request_id_echoed_when_sane_dropped_when_hostile():
+    srv = _server()
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-request-id": "req-42"})
+    assert code == 200 and r["request_id"] == "req-42"
+    code, r = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-request-id": "evil\x00" + "x" * 500})
+    assert code == 200 and "request_id" not in r
+
+
+def test_client_trace_state_is_per_thread(monkeypatch):
+    """A shared client serving concurrent requests must not cross-
+    attribute traces between threads (the attempt header and
+    last_trace/last_spans are thread-local)."""
+    import threading as _threading
+
+    seen = {}
+    barrier = _threading.Barrier(2)
+
+    def fake_once(self, method, path, body):
+        barrier.wait(timeout=10)  # both threads mid-attempt together
+        tp = (self._attempt_headers or {}).get("traceparent")
+        seen[_threading.current_thread().name] = reqtrace.parse(tp)
+        barrier.wait(timeout=10)
+        return 200, {"ok": True}, None
+
+    monkeypatch.setattr(ServiceClient, "_once", fake_once)
+    c = ServiceClient("http://127.0.0.1:1", trace=True)
+    results = {}
+
+    def drive():
+        c.request("POST", "/ask", {})
+        results[_threading.current_thread().name] = (c.last_trace,
+                                                     list(c.last_spans))
+
+    threads = [_threading.Thread(target=drive, name=f"t{i}")
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == 2
+    # each thread saw ITS OWN trace on the wire and in last_trace
+    for name in ("t0", "t1"):
+        assert seen[name].trace_id == results[name][0]
+        assert [seen[name].span_id] == results[name][1]
+    assert results["t0"][0] != results["t1"][0]
+
+
+def test_report_study_rejects_format_json(capsys):
+    from hyperopt_tpu.obs import report
+
+    assert report.main(["--format", "json", "--study", "s1",
+                        "/tmp/nope"]) == 2
+    assert "renders text only" in capsys.readouterr().err
+
+
+def test_timeline_endpoint_routes_and_404s():
+    srv = _server()
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid})
+    assert code == 200
+    code, tl = srv.handle("GET", f"/study/{sid}/timeline", {})
+    assert code == 200
+    assert tl["study_id"] == sid
+    events = [e["event"] for e in tl["events"]]
+    assert "admit" in events and "ask" in events
+    ask = next(e for e in tl["events"] if e["event"] == "ask")
+    assert ask["tids"] == [0]
+    code, _ = srv.handle("GET", "/study/nope/timeline", {})
+    assert code == 404
+    assert srv.handle("GET", "/study//timeline", {})[0] == 404
+    assert srv.handle("GET", "/study/a/b/timeline", {})[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# client-side: one trace across retries, fresh span per attempt
+# ---------------------------------------------------------------------------
+
+
+def test_client_one_trace_across_retries(monkeypatch):
+    sent = []
+
+    def fake_once(self, method, path, body):
+        sent.append((self._attempt_headers or {}).get("traceparent"))
+        if len(sent) < 3:
+            return 429, {"ok": False, "retry_after": 0.0}, "1"
+        return 200, {"ok": True, "trace": "ignored"}, None
+
+    monkeypatch.setattr(ServiceClient, "_once", fake_once)
+    c = ServiceClient("http://127.0.0.1:1", retry=5, sleep=lambda s: None,
+                      trace=True)
+    status, payload = c.request("POST", "/ask", {"study_id": "s"})
+    assert status == 200
+    assert len(sent) == 3 and all(tp for tp in sent)
+    parsed = [reqtrace.parse(tp) for tp in sent]
+    # ONE trace id across every attempt, a FRESH span id per attempt
+    assert len({p.trace_id for p in parsed}) == 1
+    assert len({p.span_id for p in parsed}) == 3
+    assert c.last_trace == parsed[0].trace_id
+    assert c.last_spans == [p.span_id for p in parsed]
+
+
+def test_client_disarmed_sends_no_header(monkeypatch):
+    sent = []
+
+    def fake_once(self, method, path, body):
+        sent.append(self._attempt_headers)
+        return 200, {"ok": True}, None
+
+    monkeypatch.setattr(ServiceClient, "_once", fake_once)
+    c = ServiceClient("http://127.0.0.1:1", trace=False)
+    c.request("GET", "/studies")
+    assert sent == [None]
+    assert c.last_trace is None
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+
+def test_parse_reqtrace_grammar():
+    assert parse_reqtrace({}) is True  # default ON
+    assert parse_reqtrace({"HYPEROPT_TPU_REQTRACE": "1"}) is True
+    for off in ("0", "off", "false", "no"):
+        assert parse_reqtrace({"HYPEROPT_TPU_REQTRACE": off}) is False
+
+
+def test_parse_access_log_grammar(tmp_path):
+    assert parse_service_access_log({}) is None
+    assert parse_service_access_log(
+        {"HYPEROPT_TPU_SERVICE_ACCESS_LOG": "off"}) is None
+    p = str(tmp_path / "access.jsonl")
+    assert parse_service_access_log(
+        {"HYPEROPT_TPU_SERVICE_ACCESS_LOG": p}) == p
+
+
+# ---------------------------------------------------------------------------
+# disarmed pins: no new threads, proposals bit-identical armed vs not
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_server_starts_no_new_threads():
+    before = {th.ident for th in threading.enumerate()}
+    srv = ServiceHTTPServer(0, scheduler=StudyScheduler(wal=False),
+                            trace=False, slo=False, access_log=None)
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid})
+    assert code == 200
+    assert "trace" not in r  # the pre-PR payload shape
+    after = {th.ident for th in threading.enumerate()}
+    assert after == before
+
+
+def test_armed_tracing_never_changes_proposals():
+    """The determinism pin: trace ids are metadata — the proposal
+    stream with tracing (and hostile headers!) is bit-identical to the
+    disarmed stream at the same seed."""
+    def drive(trace_armed, headers):
+        srv = ServiceHTTPServer(
+            0, scheduler=StudyScheduler(wal=False), trace=trace_armed,
+            slo=False)
+        sid = _mk_study(srv, seed=123)
+        out = []
+        for i in range(6):
+            code, r = srv.handle("POST", "/ask", {"study_id": sid},
+                                 headers=headers)
+            assert code == 200
+            t = r["trials"][0]
+            out.append((t["tid"], repr(t["params"]["x"])))
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": t["tid"], "loss": float(i % 3)})
+            assert code == 200
+        return out
+
+    disarmed = drive(False, None)
+    armed = drive(True, {"traceparent": VALID_TP})
+    hostile = drive(True, {"traceparent": HOSTILE_TRACEPARENTS[4]})
+    assert disarmed == armed == hostile
+
+
+def test_access_log_works_with_tracing_disarmed(tmp_path):
+    """The knobs are independent: REQTRACE=off must not silence an
+    armed access log — records land with ``trace: null``."""
+    srv = ServiceHTTPServer(
+        0, scheduler=StudyScheduler(wal=False), trace=False, slo=False,
+        access_log=str(tmp_path / "a.jsonl"))
+    sid = _mk_study(srv)
+    code, r = srv.handle("POST", "/ask", {"study_id": sid})
+    assert code == 200 and "trace" not in r
+    recs = [json.loads(ln) for ln in
+            (tmp_path / "a.jsonl").read_text().splitlines()]
+    assert [r["path"] for r in recs] == ["/study", "/ask"]
+    assert all(r.get("trace") is None for r in recs)
+
+
+def test_slo_and_access_log_armed_still_zero_threads(tmp_path):
+    before = {th.ident for th in threading.enumerate()}
+    srv = ServiceHTTPServer(
+        0, scheduler=StudyScheduler(wal=False), trace=True, slo=True,
+        access_log=str(tmp_path / "access.jsonl"))
+    sid = _mk_study(srv)
+    srv.handle("POST", "/ask", {"study_id": sid})
+    assert {th.ident for th in threading.enumerate()} == before
+    # the access log wrote one JSONL record per request, trace included
+    recs = [json.loads(ln) for ln in
+            (tmp_path / "access.jsonl").read_text().splitlines()]
+    assert [r["path"] for r in recs] == ["/study", "/ask"]
+    assert all(r["kind"] == "access" and len(r["trace"]) == 32
+               and "latency_ms" in r and "status" in r for r in recs)
+    assert recs[1]["study_id"] == sid

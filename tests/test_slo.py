@@ -1,0 +1,278 @@
+"""ISSUE 11: the SLO error-budget plane — burn-rate math under a fake
+clock (window rotation, budget exhaustion, recovery), gauge exposition
+through the Prometheus lint, edge-triggered escalation, the env spec
+grammar, and the report/snapshot surfaces."""
+
+import os
+import sys
+
+from hyperopt_tpu._env import parse_service_slo
+from hyperopt_tpu.obs.metrics import MetricsRegistry
+from hyperopt_tpu.obs.slo import (DEFAULT_TARGETS, FAST_BURN, Objective,
+                                  SLOPlane, WINDOWS)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import validate_scrape  # noqa: E402  (scripts/validate_scrape.py)
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, sec):
+        self.t += sec
+
+
+def _plane(clock, targets=None, metrics=None, **kw):
+    return SLOPlane(targets, metrics=metrics, clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math
+# ---------------------------------------------------------------------------
+
+
+def test_all_good_traffic_burns_nothing():
+    clock = Clock()
+    obj = Objective("availability", 0.999)
+    for _ in range(100):
+        obj.record(True, clock())
+    s = obj.status(clock())
+    assert s["burn_fast"] == 0.0 and s["burn_slow"] == 0.0
+    assert s["budget_remaining_frac"] == 1.0
+    assert not s["exhausted"] and not s["fast_alerting"]
+
+
+def test_all_bad_traffic_burns_at_inverse_budget():
+    clock = Clock()
+    obj = Objective("availability", 0.999)  # budget 0.1%
+    for _ in range(50):
+        obj.record(False, clock())
+    s = obj.status(clock())
+    # 100% bad over a 0.1% budget = burn rate 1000x
+    assert abs(s["burn_fast"] - 1000.0) < 1e-9
+    assert s["exhausted"] and s["fast_alerting"] and s["slow_alerting"]
+    assert s["budget_remaining_frac"] < 0
+
+
+def test_burn_rate_one_at_exact_budget_spend():
+    clock = Clock()
+    obj = Objective("o", 0.9)  # 10% budget
+    for i in range(1000):
+        obj.record(i % 10 != 0, clock())  # exactly 10% bad
+    s = obj.status(clock())
+    assert abs(s["burn_fast"] - 1.0) < 1e-9
+    assert abs(s["budget_remaining_frac"]) < 1e-9
+
+
+def test_idle_service_is_not_burning():
+    clock = Clock()
+    obj = Objective("o", 0.99)
+    s = obj.status(clock())
+    assert s["burn_fast"] == 0.0 and s["window_events"] == 0
+    assert not s["exhausted"]
+
+
+def test_window_rotation_ages_bad_events_out():
+    clock = Clock()
+    obj = Objective("o", 0.9)
+    for _ in range(100):
+        obj.record(False, clock())  # a terrible minute
+    assert abs(obj.burn_rate(WINDOWS["fast"][0], clock()) - 10.0) < 1e-9
+    # 6 minutes later the 5m window has rotated past it...
+    clock.tick(6 * 60)
+    for _ in range(100):
+        obj.record(True, clock())
+    assert obj.burn_rate(WINDOWS["fast"][0], clock()) == 0.0
+    # ...but the 1h window still remembers (100 bad / 200 total / 0.1)
+    assert abs(obj.burn_rate(WINDOWS["fast"][1], clock()) - 5.0) < 1e-9
+    # and after the 6h window passes, the budget fully recovers
+    clock.tick(7 * 3600)
+    obj.record(True, clock())
+    s = obj.status(clock())
+    assert s["budget_remaining_frac"] == 1.0 and not s["exhausted"]
+
+
+def test_exhaustion_and_recovery_cycle():
+    clock = Clock()
+    obj = Objective("o", 0.9)
+    for _ in range(9):
+        obj.record(True, clock())
+    obj.record(False, clock())  # 10% bad = budget exactly spent
+    assert obj.status(clock())["exhausted"]  # remaining <= 0
+    # an hour of clean traffic dilutes the bad fraction under budget
+    for _ in range(60):
+        clock.tick(60)
+        for _ in range(10):
+            obj.record(True, clock())
+    s = obj.status(clock())
+    assert not s["exhausted"] and s["budget_remaining_frac"] > 0.8
+
+
+def test_pair_alerting_needs_both_windows():
+    """The fast pair alerts on min(5m, 1h): a single bad burst trips the
+    5m window but not the 1h — no page (the SRE-workbook guard against
+    paging on one bad minute)."""
+    clock = Clock()
+    obj = Objective("o", 0.999)
+    # seed the 1h window with lots of good traffic, then one bad burst
+    for _ in range(50_000):
+        obj.record(True, clock())
+    clock.tick(50 * 60)
+    for _ in range(100):
+        obj.record(False, clock())
+    s = obj.status(clock())
+    assert obj.burn_rate(WINDOWS["fast"][0], clock()) >= FAST_BURN
+    assert s["burn_fast"] < FAST_BURN  # the 1h window vetoes
+    assert not s["fast_alerting"]
+
+
+# ---------------------------------------------------------------------------
+# the plane: routing, gauges, escalation
+# ---------------------------------------------------------------------------
+
+
+def test_record_request_routing():
+    clock = Clock()
+    plane = _plane(clock)
+    plane.record_request("ask", 200, latency_sec=0.010)
+    plane.record_request("ask", 200, latency_sec=5.0)  # slow: bad latency
+    plane.record_request("ask", 429, shed=True)        # shed: bad shed
+    plane.record_request("tell", 500)                  # bad availability
+    st = plane.status()
+    assert st["availability"]["window_events"] == 4
+    av_good, av_bad = plane.objectives["availability"].window_counts(
+        3600, clock())
+    assert (av_good, av_bad) == (3, 1)
+    lat_good, lat_bad = plane.objectives["ask_latency"].window_counts(
+        3600, clock())
+    assert (lat_good, lat_bad) == (1, 1)  # the 429 never counts latency
+    sh_good, sh_bad = plane.objectives["shed_rate"].window_counts(
+        3600, clock())
+    assert (sh_good, sh_bad) == (2, 1)
+
+
+def test_gauges_pass_the_exposition_lint():
+    from hyperopt_tpu.obs.serve import prometheus_text
+
+    clock = Clock()
+    reg = MetricsRegistry("slo-test-ns")
+    plane = _plane(clock, metrics=reg)
+    for i in range(20):
+        plane.record_request("ask", 200 if i % 2 else 503,
+                             latency_sec=0.01)
+    plane.publish()
+    names = dict(reg.iter_metrics())
+    for obj in DEFAULT_TARGETS:
+        for leaf in ("burn_fast", "burn_slow", "budget_remaining_frac",
+                     "fast_alerting", "slow_alerting", "exhausted"):
+            assert f"slo.{obj}.{leaf}" in names, (obj, leaf)
+    # the full exposition (slo_* families included) lints clean
+    import hyperopt_tpu.obs.metrics as metrics_mod
+
+    metrics_mod.adopt_metrics("slo-test-ns", reg)
+    try:
+        text = prometheus_text(["slo-test-ns"])
+        assert "hyperopt_tpu_slo_availability_burn_fast" in text
+        assert validate_scrape.validate_metrics_text(text) == []
+    finally:
+        metrics_mod.reset_metrics("slo-test-ns")
+
+
+def test_escalation_fires_once_per_episode_with_cooldown():
+    clock = Clock()
+    fired = []
+    plane = _plane(clock, escalation=lambda: fired.append(clock()),
+                   eval_interval=0.0, escalation_cooldown=600.0)
+    # page-hot traffic: everything 5xx
+    for _ in range(10):
+        plane.record_request("ask", 500, latency_sec=0.01)
+    assert len(fired) == 1  # edge-triggered: once, not per request
+    for _ in range(10):
+        plane.record_request("ask", 500, latency_sec=0.01)
+    assert len(fired) == 1
+    # recovery clears the edge... but the cooldown still holds
+    clock.tick(7 * 3600)
+    plane.record_request("ask", 200, latency_sec=0.01)
+    assert not plane.status()["availability"]["fast_alerting"]
+    for _ in range(10):
+        plane.record_request("ask", 500, latency_sec=0.01)
+    assert len(fired) == 2  # cooldown (600s) long passed: a new episode
+    assert plane.escalations == 2
+
+
+def test_escalation_hook_failure_never_cascades():
+    clock = Clock()
+
+    def boom():
+        raise RuntimeError("capture exploded")
+
+    plane = _plane(clock, escalation=boom, eval_interval=0.0)
+    for _ in range(5):
+        plane.record_request("ask", 500, latency_sec=0.01)  # must not raise
+
+
+def test_bad_target_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        Objective("o", 1.0)
+    with pytest.raises(ValueError):
+        Objective("o", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# env grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_service_slo_grammar():
+    assert parse_service_slo({}) is not None  # default ON
+    assert parse_service_slo({"HYPEROPT_TPU_SERVICE_SLO": "off"}) is None
+    assert parse_service_slo({"HYPEROPT_TPU_SERVICE_SLO": "0"}) is None
+    t = parse_service_slo({"HYPEROPT_TPU_SERVICE_SLO":
+                           "avail=99.5,ask_p99_ms=250,ask_pct=95,shed=2"})
+    assert abs(t["availability"]["target"] - 0.995) < 1e-9
+    assert t["ask_latency"]["threshold_ms"] == 250.0
+    assert abs(t["ask_latency"]["target"] - 0.95) < 1e-9
+    assert abs(t["shed_rate"]["target"] - 0.98) < 1e-9
+    # malformed tokens keep the defaults, never raise
+    t = parse_service_slo({"HYPEROPT_TPU_SERVICE_SLO": "avail=banana,,x=1"})
+    assert t["availability"]["target"] == DEFAULT_TARGETS[
+        "availability"]["target"]
+    # shed=0 stays a valid (0,1) target
+    t = parse_service_slo({"HYPEROPT_TPU_SERVICE_SLO": "shed=0"})
+    assert 0 < t["shed_rate"]["target"] < 1
+    SLOPlane(t)  # constructible
+
+
+# ---------------------------------------------------------------------------
+# surfaces: snapshot section + report banner
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_and_report_surfaces():
+    from hyperopt_tpu.obs.report import _slo_lines
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    srv = ServiceHTTPServer(0, scheduler=StudyScheduler(wal=False),
+                            slo=True)
+    assert srv.slo is not None
+    for i in range(10):
+        srv.slo.record_request("ask", 500, latency_sec=0.01)
+    snap = srv.snapshot_dict()
+    assert "slo" in snap
+    assert snap["slo"]["availability"]["exhausted"]
+    # the report section renders the budget bars + the banner from the
+    # published gauges
+    metrics = srv.scheduler.metrics.snapshot()["metrics"]
+    out = []
+    _slo_lines(metrics, out)
+    text = "\n".join(out)
+    assert "availability" in text and "budget" in text
+    assert "ERROR-BUDGET-EXHAUSTED" in text

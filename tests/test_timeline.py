@@ -1,0 +1,337 @@
+"""ISSUE 11: end-to-end request correlation + per-study audit timelines +
+WAL back-compat.
+
+The headline acceptance pin: with tracing armed, ONE `ServiceClient` ask
+against a real HTTP server yields ONE trace id observable at all five
+layers — the client attempt span, the server handler span, the wave
+span's fan-in links, the cohort-tick annotation, and the WAL ask record
+— and `obs.report --study` renders the full timeline from the store.
+Plus: pre-ISSUE-11 journals (no `trace`/`ts` fields) resume
+bit-identically, and the flow-event export of a traced run passes the
+`scripts/validate_trace.py` lint.
+"""
+
+import json
+import os
+import sys
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.obs import report
+from hyperopt_tpu.obs.flight import get_flight
+from hyperopt_tpu.service.client import ServiceClient
+from hyperopt_tpu.service.journal import StudyJournal, wal_path_for
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+def _ring_records():
+    return get_flight().records()
+
+
+# ---------------------------------------------------------------------------
+# the five-layer correlation pin
+# ---------------------------------------------------------------------------
+
+
+def test_one_trace_observable_at_all_five_layers(tmp_path):
+    store = str(tmp_path / "store")
+    sched = StudyScheduler(store_root=store)
+    srv = ServiceHTTPServer(0, scheduler=sched, slo=False, trace=True)
+    assert srv.start()
+    try:
+        client = ServiceClient(srv.url, trace=True)
+        sid = client.create_study(space=SPACE_SPEC, seed=5,
+                                  n_startup_jobs=1)
+        client.ask(sid)  # startup rand (burns the rand phase)
+        client.tell(sid, 0, loss=0.25)
+        trials = client.ask(sid)  # THE traced TPE ask
+        assert len(trials) == 1
+        trace = client.last_trace
+        assert isinstance(trace, str) and len(trace) == 32
+
+        # filter the WHOLE ring by the trace id: the ring is process-
+        # global and bounded, so under a full suite run its length stays
+        # pinned at the cap while content shifts — positional windows
+        # lie, the (unique) trace id does not
+        by_name = {}
+        for r in _ring_records():
+            attrs = r.get("attrs") or {}
+            if attrs.get("trace") == trace or trace in (
+                    attrs.get("links") or []):
+                by_name.setdefault(r.get("name"), []).append(r)
+        # layer 1: the client attempt span
+        assert "client.request" in by_name
+        assert by_name["client.request"][-1]["attrs"]["span"] in \
+            client.last_spans
+        # layer 2: the server handler span (a CHILD span of the client's
+        # attempt — same trace, different span id)
+        assert "service.handle" in by_name
+        assert by_name["service.handle"][-1]["attrs"]["span"] not in \
+            client.last_spans
+        # layer 3: the wave span links the request trace (fan-in)
+        assert trace in by_name["service.wave"][-1]["attrs"]["links"]
+        # layer 4: the cohort-tick annotation carries it too
+        assert trace in by_name["service.tick"][-1]["attrs"]["links"]
+        # layer 5: the WAL ask record is stamped with it
+        wal = list(StudyJournal(wal_path_for(store)).records())
+        ask_recs = [r for r in wal if r["kind"] == "ask"
+                    and r.get("algo") == "tpe"]
+        assert ask_recs and ask_recs[-1]["trace"] == trace
+        # and the live timeline endpoint shows the same id on the ask
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"{srv.url}/study/{sid}/timeline", timeout=30) as r:
+            tl = json.loads(r.read())
+        tpe_asks = [e for e in tl["events"]
+                    if e["event"] == "ask" and e.get("algo") == "tpe"]
+        assert tpe_asks and tpe_asks[-1]["trace"] == trace
+    finally:
+        srv.stop()
+
+    # obs.report --study renders the complete timeline from the store
+    # (admit + both asks + the tell), trace ids included
+    rendered = report.render_study_timeline(
+        sid, [("wal", list(StudyJournal(wal_path_for(store)).records()))])
+    assert "admit" in rendered and "tell" in rendered
+    assert "algo=tpe" in rendered and "algo=rand" in rendered
+    assert trace[:16] in rendered
+
+
+def test_report_study_cli_accepts_store_root(tmp_path, capsys):
+    store = str(tmp_path / "store")
+    sched = StudyScheduler(store_root=store)
+    srv = ServiceHTTPServer(0, scheduler=sched, slo=False, trace=True)
+    code, r = srv.handle("POST", "/study", {"space": SPACE_SPEC,
+                                            "seed": 3,
+                                            "n_startup_jobs": 1})
+    sid = r["study_id"]
+    srv.handle("POST", "/ask", {"study_id": sid})
+    rc = report.main(["--study", sid, store])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"study timeline: {sid}" in out and "ask" in out
+    # unknown study: renders the empty-timeline notice, not a crash
+    rc = report.main(["--study", "study-nope", store])
+    assert rc == 0
+    assert "no WAL records" in capsys.readouterr().out
+    # missing stream: clean error
+    assert report.main(["--study", sid, str(tmp_path / "nope")]) == 2
+
+
+def test_shed_and_resume_boundary_appear_in_timeline(tmp_path):
+    store = str(tmp_path / "store")
+    sched = StudyScheduler(store_root=store)
+    sid = sched.create_study(SPACE, seed=11, n_startup_jobs=1,
+                             space_spec={"space": SPACE_SPEC})
+    a = sched.ask(sid)[0]
+    sched.tell(sid, a["tid"], 0.5)
+    sched.ask(sid)
+    # a restart on the same WAL: the resumed scheduler's timeline marks
+    # the crash-resume boundary after the replayed history
+    sched2 = StudyScheduler(store_root=store)
+    tl = sched2.study_timeline(sid)
+    events = [e["event"] for e in tl["events"]]
+    assert "resume" in events
+    assert events.index("admit") < events.index("resume")
+    replayed = [e for e in tl["events"] if e.get("replay")]
+    assert replayed  # the pre-crash history is flagged as replayed
+
+
+# ---------------------------------------------------------------------------
+# WAL back-compat: pre-ISSUE-11 journals resume bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _drive(sched, sid, n):
+    out = []
+    for i in range(n):
+        a = sched.ask(sid)[0]
+        out.append((a["tid"], repr(a["params"]["x"])))
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+    return out
+
+
+def _strip_issue11_fields(rec):
+    """A faithful pre-ISSUE-11 record: no ``trace`` ever, no ``ts`` on
+    ask/tell/close (admit/snapshot always had one)."""
+    rec = {k: v for k, v in rec.items() if k != "trace"}
+    if rec.get("kind") in ("ask", "tell", "close"):
+        rec.pop("ts", None)
+    return rec
+
+
+def test_pre_issue11_wal_resumes_bit_identical(tmp_path):
+    # the reference: an uninterrupted run
+    ref = StudyScheduler(wal=False)
+    ref_sid = ref.create_study(SPACE, seed=42, n_startup_jobs=2)
+    ref_seq = _drive(ref, ref_sid, 6)
+
+    # a run that crashed after 3 rounds, journaled in the OLD format
+    store = str(tmp_path / "store")
+    s1 = StudyScheduler(store_root=store)
+    sid = s1.create_study(SPACE, seed=42, n_startup_jobs=2,
+                          space_spec={"space": SPACE_SPEC})
+    seq1 = _drive(s1, sid, 3)
+    wal_path = wal_path_for(store)
+    old_recs = [_strip_issue11_fields(r)
+                for r in StudyJournal(wal_path).records()]
+    with open(wal_path, "w", encoding="utf-8") as f:
+        for rec in old_recs:
+            f.write(json.dumps(rec, sort_keys=True,
+                               separators=(",", ":")) + "\n")
+    assert not any("trace" in r or ("ts" in r and r["kind"] == "ask")
+                   for r in StudyJournal(wal_path).records())
+
+    # resume from the stripped journal: proposals must continue the
+    # reference stream bit-for-bit
+    s2 = StudyScheduler(store_root=store)
+    assert s2.last_resume["studies"] == 1
+    assert s2.last_resume["errors"] == 0
+    seq2 = _drive(s2, sid, 3)
+    assert seq1 + seq2 == ref_seq
+
+
+def test_armed_tracing_wal_resumes_bit_identical(tmp_path):
+    """The forward pin: a WAL written WITH trace fields replays to the
+    same proposals as the uninterrupted run — replay ignores the
+    metadata entirely."""
+    ref = StudyScheduler(wal=False)
+    ref_sid = ref.create_study(SPACE, seed=9, n_startup_jobs=2)
+    ref_seq = _drive(ref, ref_sid, 6)
+
+    store = str(tmp_path / "store")
+    s1 = StudyScheduler(store_root=store)
+    srv = ServiceHTTPServer(0, scheduler=s1, slo=False, trace=True)
+    code, r = srv.handle("POST", "/study", {"space": SPACE_SPEC,
+                                            "seed": 9,
+                                            "n_startup_jobs": 2})
+    sid = r["study_id"]
+    seq1 = []
+    for i in range(3):
+        code, a = srv.handle("POST", "/ask", {"study_id": sid})
+        t = a["trials"][0]
+        seq1.append((t["tid"], repr(t["params"]["x"])))
+        srv.handle("POST", "/tell", {
+            "study_id": sid, "tid": t["tid"],
+            "loss": float((t["params"]["x"] - 1.0) ** 2)})
+    # the armed WAL really carries trace ids on its TPE ask records
+    wal = list(StudyJournal(wal_path_for(store)).records())
+    assert any(r.get("trace") for r in wal if r["kind"] == "ask")
+    s2 = StudyScheduler(store_root=store)
+    seq2 = _drive(s2, sid, 3)
+    assert seq1 + seq2 == ref_seq
+
+
+# ---------------------------------------------------------------------------
+# flow-event export of a traced run passes the trace lint
+# ---------------------------------------------------------------------------
+
+
+def test_flow_events_lint_clean(tmp_path):
+    import validate_trace  # scripts/ (path injected above)
+
+    sched = StudyScheduler(wal=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, slo=False, trace=True)
+    sid = srv.handle("POST", "/study", {"space": SPACE_SPEC, "seed": 1,
+                                        "n_startup_jobs": 1})[1]["study_id"]
+    srv.handle("POST", "/ask", {"study_id": sid})
+    srv.handle("POST", "/tell", {"study_id": sid, "tid": 0, "loss": 0.1})
+    code, a = srv.handle("POST", "/ask", {"study_id": sid})
+    trace = a["trace"]
+
+    stream = tmp_path / "svc.jsonl"
+    with open(stream, "w") as f:
+        for rec in _ring_records():
+            f.write(json.dumps(rec, default=str) + "\n")
+    out = str(tmp_path / "trace.json")
+    assert report.main(["--export-trace", out, str(stream)]) == 0
+    assert validate_trace.validate_file(out) == []
+    events = json.load(open(out))["traceEvents"]
+    flows = [e for e in events if e.get("cat") == "reqtrace"]
+    # the traced ask's flow: at least handler -> wave -> tick = s, t, f
+    mine = [e for e in flows if (e.get("args") or {}).get("trace") == trace]
+    phs = [e["ph"] for e in mine]
+    assert phs.count("s") == 1 and phs.count("f") == 1
+    assert len(mine) >= 3
+
+
+def test_top_renders_service_snapshot():
+    """obs.top's service view (ISSUE 11 satellite): a serving-process
+    /snapshot renders the study table, shed rate, ladder state and SLO
+    budget bars — pre-PR the dashboard showed nothing for a server."""
+    from hyperopt_tpu.obs import top
+
+    sched = StudyScheduler(wal=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, slo=True, trace=True)
+    sid = srv.handle("POST", "/study", {"space": SPACE_SPEC, "seed": 2,
+                                        "n_startup_jobs": 1})[1]["study_id"]
+    code, a = srv.handle("POST", "/ask", {"study_id": sid})
+    srv.handle("POST", "/tell", {"study_id": sid,
+                                 "tid": a["trials"][0]["tid"],
+                                 "loss": 0.5})
+    frame = top.render_frame([("svc", srv.snapshot_dict())], {})
+    assert "SERVICE" in frame
+    assert "studies 1/1" in frame
+    assert "slo availability" in frame
+    assert sid[:24] in frame
+    assert "trials" in frame
+    # a dead source still renders as a dead row next to it
+    frame = top.render_frame(
+        [("svc", srv.snapshot_dict()), ("gone", {"error": "refused"})], {})
+    assert "DEAD" in frame and "SERVICE" in frame
+
+
+def test_flow_export_without_traces_unchanged(tmp_path):
+    """A stream with no trace-stamped spans exports zero flow events —
+    the merged-artifact gate (TRACE_GATE) stays green on pre-PR
+    streams."""
+    from hyperopt_tpu.obs.export import flow_events
+
+    assert flow_events([
+        {"ph": "X", "ts": 1.0, "pid": 0, "tid": 0, "name": "a",
+         "args": {}},
+        {"ph": "i", "ts": 2.0, "pid": 0, "tid": 0, "name": "b"},
+    ]) == []
+
+
+def test_flow_export_skips_foreign_non_hex_trace_ids():
+    """A foreign producer stamping a non-hex trace attr must not kill
+    the export — its arc is skipped, valid flows still emit."""
+    from hyperopt_tpu.obs.export import flow_events
+
+    mk = lambda ts, trace: {"ph": "X", "ts": ts, "pid": 0, "tid": 0,  # noqa: E731
+                            "name": "s", "args": {"trace": trace}}
+    flows = flow_events([mk(1.0, "req-1"), mk(2.0, "req-1"),
+                         mk(3.0, "abc123"), mk(4.0, "abc123")])
+    assert {f["args"]["trace"] for f in flows} == {"abc123"}
+
+
+def test_slo_record_fault_does_not_disable_the_plane():
+    """A transient SLO-record fault must not freeze the slo_* gauges at
+    stale values — the plane logs once and keeps recording."""
+    sched = StudyScheduler(wal=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, slo=True, trace=True)
+    sid = srv.handle("POST", "/study", {"space": SPACE_SPEC, "seed": 4,
+                                        "n_startup_jobs": 1})[1]["study_id"]
+    boom = {"n": 0}
+    orig = srv.slo.record_request
+
+    def flaky(*a, **kw):
+        boom["n"] += 1
+        if boom["n"] == 1:
+            raise RuntimeError("transient registry fault")
+        return orig(*a, **kw)
+
+    srv.slo.record_request = flaky
+    assert srv.handle("POST", "/ask", {"study_id": sid})[0] == 200
+    assert srv.slo is not None  # still armed
+    assert srv.handle("POST", "/tell", {"study_id": sid, "tid": 0,
+                                        "loss": 0.1})[0] == 200
+    assert boom["n"] == 2  # the plane kept recording after the fault
