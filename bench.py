@@ -1402,6 +1402,158 @@ def bench_service_resume(n_studies=48, waves=5, queue=8, seed=0):
     return out
 
 
+def bench_fleet_scale(n_studies=24, waves=4, n_shards=8, seed=0):
+    """Replicated serving fleet (ISSUE 12): ask/tell throughput through
+    in-process fleet replicas at 1→4 replicas on one box
+    (``fleet_studies_per_sec`` — the headline key gates the LARGEST
+    replica count), plus the shard failover latency
+    (``reclaim_latency_sec``): a replica "dies" (stops heartbeating —
+    the SIGKILL analog; its leases age past the TTL) and the stage
+    measures wall seconds until a survivor holds the reclaimed lease
+    AND serves an ask for one of the dead replica's studies, WAL replay
+    included.  One replica == one FleetReplica + handler (threads, not
+    subprocesses: the stage measures shard routing + per-shard WAL
+    costs, not the box's core count — FLEET_GATE's smoke covers real
+    processes)."""
+    import tempfile
+    import threading as _th
+
+    import numpy as _np
+
+    from hyperopt_tpu.service import FleetReplica
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    def cheap_loss(params):
+        return float(_np.sin(sum(float(v) for v in params.values())))
+
+    spec = {"x": {"dist": "uniform", "args": [-5, 5]}}
+    out = {"n_studies": n_studies, "waves": waves, "n_shards": n_shards,
+           "by_replicas": {}}
+
+    for n_replicas in (1, 2, 4):
+        with tempfile.TemporaryDirectory() as root:
+            replicas = [
+                FleetReplica(root, n_shards=n_shards,
+                             replica_id=f"bench-r{i}",
+                             addr=f"inproc://r{i}", lease_ttl=60.0,
+                             scheduler_kwargs={"wave_window": 0.0})
+                for i in range(n_replicas)]
+            servers = [ServiceHTTPServer(0, fleet=r) for r in replicas]
+            for r in replicas:
+                r.join()
+            for _ in range(3):  # converge the shard balance
+                for r in replicas:
+                    r.steward_once()
+            # place studies round-robin across replicas (place_study
+            # redraws until the id lands in the PLACING replica's own
+            # shards, so always starting at servers[0] would put every
+            # study there and leave the other replicas idle — the
+            # scaling metric must drive all of them)
+            per = {i: [] for i in range(n_replicas)}
+            for j in range(n_studies):
+                for k in range(n_replicas):
+                    i = (j + k) % n_replicas
+                    code, payload = servers[i].handle("POST", "/study", {
+                        "space": spec, "seed": seed + j,
+                        "n_startup_jobs": 2})
+                    if code == 200:
+                        per[i].append(payload["study_id"])
+                        break
+                else:
+                    raise RuntimeError("no replica could place a study")
+            # warm-up round (pays the per-cohort XLA compiles)
+            for i, srv in enumerate(servers):
+                for sid in per[i]:
+                    code, p = srv.handle("POST", "/ask", {"study_id": sid})
+                    assert code == 200, p
+                    t = p["trials"][0]
+                    srv.handle("POST", "/tell", {
+                        "study_id": sid, "tid": t["tid"],
+                        "loss": cheap_loss(t["params"])})
+            errors = []
+
+            def drive(i):
+                try:
+                    srv = servers[i]
+                    for _ in range(waves):
+                        for sid in per[i]:
+                            code, p = srv.handle("POST", "/ask",
+                                                 {"study_id": sid})
+                            assert code == 200, p
+                            t = p["trials"][0]
+                            code, p2 = srv.handle("POST", "/tell", {
+                                "study_id": sid, "tid": t["tid"],
+                                "loss": cheap_loss(t["params"])})
+                            assert code == 200, p2
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"replica {i}: {type(e).__name__}: {e}")
+
+            t0 = time.perf_counter()
+            threads = [_th.Thread(target=drive, args=(i,))
+                       for i in range(n_replicas)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError("fleet_scale drivers failed: "
+                                   + "; ".join(errors[:5]))
+            out["by_replicas"][str(n_replicas)] = {
+                "fleet_studies_per_sec": n_studies * waves / dt,
+                "rounds": n_studies * waves,
+                "elapsed_sec": dt,
+                "shards_held": [len(r.schedulers) for r in replicas],
+            }
+    # the gated scalar: throughput at the widest fleet
+    out["fleet_studies_per_sec"] = (
+        out["by_replicas"]["4"]["fleet_studies_per_sec"])
+
+    # -- shard failover: dead replica -> survivor serves its studies -------
+    with tempfile.TemporaryDirectory() as root:
+        ttl = 0.5
+        dead = FleetReplica(root, n_shards=4, replica_id="bench-dead",
+                            addr="inproc://dead", lease_ttl=ttl,
+                            scheduler_kwargs={"wave_window": 0.0})
+        dead.join()
+        dead.steward_once()  # claims everything
+        sdead = ServiceHTTPServer(0, fleet=dead)
+        code, payload = sdead.handle("POST", "/study", {
+            "space": spec, "seed": seed, "n_startup_jobs": 2})
+        sid = payload["study_id"]
+        for _ in range(3):
+            code, p = sdead.handle("POST", "/ask", {"study_id": sid})
+            t = p["trials"][0]
+            sdead.handle("POST", "/tell", {"study_id": sid,
+                                           "tid": t["tid"],
+                                           "loss": cheap_loss(t["params"])})
+        survivor = FleetReplica(root, n_shards=4,
+                                replica_id="bench-survivor",
+                                addr="inproc://survivor", lease_ttl=ttl,
+                                scheduler_kwargs={"wave_window": 0.0})
+        survivor.join()
+        ssurv = ServiceHTTPServer(0, fleet=survivor)
+        # the death: the replica stops heartbeating (nothing else) — the
+        # latency measured is TTL expiry + reclaim + WAL replay + serve
+        t0 = time.perf_counter()
+        deadline = t0 + 30.0
+        served = False
+        while time.perf_counter() < deadline:
+            survivor.steward_once()
+            code, p = ssurv.handle("POST", "/ask", {"study_id": sid})
+            if code == 200:
+                served = True
+                break
+            time.sleep(0.02)
+        if not served:
+            raise RuntimeError("survivor never served the dead "
+                               "replica's study")
+        out["reclaim_latency_sec"] = time.perf_counter() - t0
+        out["reclaim_lease_ttl_sec"] = ttl
+        out["reclaim_adoptions"] = survivor.adoptions
+    return out
+
+
 def bench_pallas_ei(n=8192, reps=5, seed=0):
     """jnp-vs-pallas crossover for the fused two-model EI score
     (``pallas_ei.ei_diff``) by COMPONENT COUNT — the axis the MEASURED
@@ -1508,6 +1660,10 @@ _JAX_STAGES = (
     # (WAL replay + in-flight regeneration) and the shed rate at 2x ask
     # capacity through the real handler path
     ("service_resume", bench_service_resume),
+    # ISSUE 12: replicated serving fleet — ask/tell throughput across
+    # 1→4 in-process replicas (lease-partitioned shards, per-shard
+    # epoch WALs) and the shard failover latency after a replica death
+    ("fleet_scale", bench_fleet_scale),
 )
 
 _PROBE_SNIPPET = (
@@ -1748,6 +1904,18 @@ def main():
             for k in ("resume_latency_sec", "resume_studies",
                       "resume_regenerated", "shed_rate_frac",
                       "lost_tells")}
+    # the replicated-fleet stage (ISSUE 12) rides along: throughput by
+    # replica count and the shard failover (reclaim + WAL replay) latency
+    rec = stages.get("fleet_scale")
+    if rec and rec.get("ok"):
+        r = rec["result"]
+        obs_summary["fleet_scale"] = {
+            "by_replicas": {
+                k: round(v["fleet_studies_per_sec"], 1)
+                for k, v in (r.get("by_replicas") or {}).items()},
+            "fleet_studies_per_sec": r.get("fleet_studies_per_sec"),
+            "reclaim_latency_sec": r.get("reclaim_latency_sec"),
+        }
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact
@@ -1807,6 +1975,10 @@ def main():
                                              "resume_latency_sec"),
             "shed_rate_frac": _stage_val("service_resume",
                                          "shed_rate_frac"),
+            "fleet_studies_per_sec": _stage_val("fleet_scale",
+                                                "fleet_studies_per_sec"),
+            "reclaim_latency_sec": _stage_val("fleet_scale",
+                                              "reclaim_latency_sec"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

@@ -465,6 +465,61 @@ def parse_service_slo(env=None):
     return targets
 
 
+# -- replicated serving fleet knobs (ISSUE 12) ------------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# fleet it would have partitioned.
+
+
+DEFAULT_FLEET_SHARDS = 8
+DEFAULT_FLEET_LEASE_TTL = 15.0
+
+
+def parse_fleet_shards(env=None):
+    """``HYPEROPT_TPU_FLEET_SHARDS`` → how many study-shards the fleet
+    partitions the study keyspace into (default 8).  The shard count is
+    a WRITE-ONCE property of a fleet store root (``fleet/params.json``
+    pins it; joiners with a different value are refused) — changing it
+    would re-bucket every existing study id."""
+    return _parse_pos_int("HYPEROPT_TPU_FLEET_SHARDS",
+                          DEFAULT_FLEET_SHARDS, env)
+
+
+def parse_fleet_lease_ttl(env=None):
+    """``HYPEROPT_TPU_FLEET_LEASE_TTL`` → seconds without a heartbeat
+    after which a replica's study-shard lease is reclaimable by a
+    survivor (default 15).  Lower = faster failover, higher = more
+    tolerance for long GC/compile pauses; the steward heartbeats every
+    ttl/4, so the TTL must comfortably exceed a wave's wall time."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_FLEET_LEASE_TTL", "").strip()
+    if not raw:
+        return DEFAULT_FLEET_LEASE_TTL
+    try:
+        sec = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_FLEET_LEASE_TTL", raw,
+                   "a duration in seconds")
+        return DEFAULT_FLEET_LEASE_TTL
+    if not sec > 0:
+        _warn_once("HYPEROPT_TPU_FLEET_LEASE_TTL", raw,
+                   "a positive duration")
+        return DEFAULT_FLEET_LEASE_TTL
+    return sec
+
+
+def parse_fleet_addr(env=None):
+    """``HYPEROPT_TPU_FLEET_ADDR`` → the URL this replica ADVERTISES in
+    the ownership table (what 307 redirects point other clients at), or
+    None to advertise the server's own bind URL.  Needed whenever the
+    bind address is not the reachable one (0.0.0.0 binds, NAT,
+    port-forwarded containers)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_FLEET_ADDR", "").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    return raw.rstrip("/")
+
+
 _CACHE_CONFIGURED = False
 _EXPLICIT_DIR = None  # the explicit dir currently configured, if any
 

@@ -146,16 +146,36 @@ def _claim_suffix():
     return f"{os.getpid()}.{threading.get_ident()}"
 
 
-def new_run_id(prefix="run"):
+def new_run_id(prefix="run", unique_dir=None):
     """Auth-agnostic opaque run/study id: ``<prefix>-<12 hex>`` from
     ``os.urandom``.  Collision-safe across processes with no coordination
     (the ask/tell service mints study ids with this — the id doubles as
     the store subdirectory name when studies persist through a
     :class:`FileStore`), and unguessable enough that knowing one study's
-    id never reveals a neighbor's."""
+    id never reveals a neighbor's.
+
+    ``unique_dir`` makes the allocation collision-PROOF instead of
+    merely collision-unlikely: the id is claimed by ``os.mkdir`` of
+    ``<unique_dir>/<id>`` — atomic-exclusive on every filesystem the
+    store runs on — and a lost race simply redraws.  N fleet replicas
+    minting study ids against one shared store root use this; the
+    claimed directory IS the study's store subdirectory, so the claim
+    costs nothing extra."""
     import binascii
 
-    return f"{prefix}-{binascii.hexlify(os.urandom(6)).decode()}"
+    for _ in range(64):
+        run_id = f"{prefix}-{binascii.hexlify(os.urandom(6)).decode()}"
+        if unique_dir is None:
+            return run_id
+        try:
+            os.makedirs(unique_dir, exist_ok=True)
+            os.mkdir(os.path.join(unique_dir, run_id))
+            return run_id
+        except FileExistsError:
+            continue  # another replica drew the same 48 bits: redraw
+    raise RuntimeError(
+        f"could not mint a unique id under {unique_dir} in 64 draws "
+        "(exhausted 48-bit space, or the directory is not writable)")
 
 
 # the durable trial-lifecycle event log rides the attachments namespace so

@@ -220,6 +220,33 @@ def _render_service_source(name, snap, out, w):
     if snap.get("draining"):
         line += "  DRAINING"
     out.append(line)
+    # the FLEET row (ISSUE 12): which replica this is, the shard leases
+    # (+ epochs) it holds out of the fleet's keyspace, live peer count,
+    # adoption/handoff traffic and WAL sync health — the /healthz body
+    # rendered one line per replica
+    fleet = snap.get("fleet")
+    if fleet:
+        held = fleet.get("shards_held") or []
+        shards = fleet.get("shards") or {}
+        epochs = sorted({int(s.get("epoch") or 0)
+                         for s in shards.values()})
+        fline = (f"  {'':<{w}}  FLEET  {fleet.get('replica', '?')}"
+                 f"  shards {len(held)}/{fleet.get('n_shards', '?')}"
+                 f" {held}")
+        if epochs:
+            fline += f"  epochs {epochs[0]}" + (
+                f"-{epochs[-1]}" if len(epochs) > 1 else "")
+        fline += f"  replicas {len(fleet.get('replicas') or [])}"
+        if fleet.get("adoptions") or fleet.get("handoffs"):
+            fline += (f"  adopt {fleet.get('adoptions', 0)}"
+                      f"  handoff {fleet.get('handoffs', 0)}")
+        if fleet.get("leases_lost"):
+            fline += f"  LOST {fleet['leases_lost']}"
+        if fleet.get("wal_sync_errors"):
+            fline += f"  WAL-SYNC-ERRORS {fleet['wal_sync_errors']}"
+        if fleet.get("draining"):
+            fline += "  DRAINING"
+        out.append(fline)
     degrade = snap.get("degrade")
     if degrade and (degrade.get("level") or degrade.get("faults")):
         out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"

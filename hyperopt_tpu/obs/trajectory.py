@@ -137,6 +137,20 @@ KEY_DIRECTIONS = {
     # mode — latency explodes instead of clients being told to back
     # off).  Direction "higher" so the gate fires on that collapse.
     "shed_rate_frac": {"direction": "higher", "threshold": 0.60},
+    # replicated-fleet serving throughput (bench.py fleet_scale stage,
+    # ISSUE 12): ask+tell rounds/sec through in-process fleet replicas
+    # at the largest measured replica count.  Loose-ish bar — the stage
+    # runs real per-shard schedulers and WAL fsyncs on shared hardware;
+    # a real regression means shard routing or the per-shard WAL grew a
+    # per-request cost.
+    "fleet_studies_per_sec": {"direction": "higher", "threshold": 0.35},
+    # shard-failover latency (same stage): wall seconds from a replica
+    # abandoning its shards (SIGKILL analog: leases simply stop
+    # heartbeating) to a survivor holding + serving the reclaimed
+    # shard.  Dominated by the stage's lease TTL constant + steward
+    # poll; the loose bar catches a broken reclaim/adopt path (latency
+    # jumping toward the client retry ceiling), not scheduler noise.
+    "reclaim_latency_sec": {"direction": "lower", "threshold": 1.00},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -150,7 +164,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "recovery_latency_sec",
                 "studies_per_sec", "study_ask_p99_ms",
                 "slot_utilization_frac",
-                "resume_latency_sec", "shed_rate_frac")
+                "resume_latency_sec", "shed_rate_frac",
+                "fleet_studies_per_sec", "reclaim_latency_sec")
 
 
 def trajectory_path(root=None):

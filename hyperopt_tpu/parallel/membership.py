@@ -60,7 +60,8 @@ import time
 from ..filestore import FileStore, _atomic_write, _claim_suffix
 from ..obs import get_metrics
 
-__all__ = ["FleetMembership", "shard_trials", "n_occupied_shards"]
+__all__ = ["FleetMembership", "EpochLeases", "shard_trials",
+           "n_occupied_shards", "publish_params_once", "rotate_for_owner"]
 
 logger = logging.getLogger(__name__)
 
@@ -89,6 +90,52 @@ def _safe(owner):
     return str(owner).replace(":", "-").replace(os.sep, "-")
 
 
+def publish_params_once(path, params, what="store"):
+    """Write-once params file: the first caller publishes ``params`` at
+    ``path`` atomically-exclusively, every later caller verifies
+    equality.  Atomic-exclusive publish: write a private tmp
+    COMPLETELY, then ``os.link`` it into place — exactly one linker
+    wins, and a loser (or any concurrent joiner) can only ever read a
+    fully-written file.  A bare O_EXCL-create-then-write would let a
+    simultaneous joiner read the empty/partial file and die on a false
+    params mismatch.  Returns True for the first writer, False for a
+    verified joiner; raises ValueError on a mismatch (params are
+    write-once — every joiner must present identical params)."""
+    blob = json.dumps(params, sort_keys=True, default=str)
+    tmp = f"{path}.tmp.{_claim_suffix()}"
+    with open(tmp, "w") as f:
+        f.write(blob)
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        with open(path) as f:
+            existing = f.read()
+        if existing != blob:
+            raise ValueError(
+                f"{what} was created with params {existing}; this "
+                f"process has {blob} — every joiner must present "
+                "identical params")
+        return False
+    finally:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+
+
+def rotate_for_owner(items, owner):
+    """Deterministic per-owner rotation of ``items`` so concurrent
+    claimers start at different offsets (less contention) while any
+    single survivor still visits every item.  Stable across processes
+    for one owner; NOT Python ``hash()`` (salted)."""
+    items = list(items)
+    if not items:
+        return items
+    h = sum(ord(c) for c in str(owner)) % len(items)
+    return items[h:] + items[:h]
+
+
 class FleetMembership:
     """One controller's handle on the lease plane of a fleet store."""
 
@@ -111,33 +158,9 @@ class FleetMembership:
         possibly differently-sized) fleet must present IDENTICAL params —
         the lease plane's analog of the checkpoint run-params check, and
         the guard behind bitwise replay at any fleet size."""
-        path = os.path.join(self._fleet, "params.json")
-        blob = json.dumps(params, sort_keys=True, default=str)
-        # atomic-exclusive publish: write a private tmp COMPLETELY, then
-        # os.link it into place — exactly one linker wins, and a loser (or
-        # any concurrent joiner) can only ever read a fully-written file.
-        # A bare O_EXCL-create-then-write would let a simultaneous joiner
-        # read the empty/partial file and die on a false params mismatch.
-        tmp = f"{path}.tmp.{_claim_suffix()}"
-        with open(tmp, "w") as f:
-            f.write(blob)
-        try:
-            os.link(tmp, path)
-            return True
-        except FileExistsError:
-            with open(path) as f:
-                existing = f.read()
-            if existing != blob:
-                raise ValueError(
-                    f"fleet store {self.store.root} was created with params "
-                    f"{existing}; this controller has {blob} — a fleet (or "
-                    "a resumed fleet of any size) must run identical params")
-            return False
-        finally:
-            try:
-                os.remove(tmp)
-            except FileNotFoundError:
-                pass
+        return publish_params_once(
+            os.path.join(self._fleet, "params.json"), params,
+            what=f"fleet store {self.store.root}")
 
     # -- membership records (observability; liveness by mtime) ------------
 
@@ -322,12 +345,7 @@ class FleetMembership:
         """Deterministic per-owner rotation of ``shards`` so a fleet's
         members start claiming at different offsets (less contention)
         while any single survivor still visits every shard."""
-        shards = list(shards)
-        if not shards:
-            return shards
-        # stable across processes for one owner; NOT Python hash() (salted)
-        h = sum(ord(c) for c in self.owner) % len(shards)
-        return shards[h:] + shards[:h]
+        return rotate_for_owner(shards, self.owner)
 
     # -- divergence audit --------------------------------------------------
 
@@ -349,3 +367,182 @@ class FleetMembership:
             except OSError:
                 continue
         return out
+
+
+# ---------------------------------------------------------------------------
+# long-lived epoch leases (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+class EpochLeases:
+    """Long-lived, epoch-fenced leases over one directory — the
+    generalization of the per-generation shard lease above for ownership
+    that OUTLIVES any single unit of work (the serving fleet's
+    study-shard keyspace).  Three differences from the ``gen/shard``
+    lease:
+
+    * **no terminal state** — there is no ``result.pkl`` that retires a
+      lease; ownership ends only by explicit :meth:`release` or by
+      stale :meth:`reclaim`;
+    * **a durable per-name epoch counter** — every successful claim
+      bumps ``<name>.epoch`` (atomically, under the just-won ``O_EXCL``
+      exclusivity, so bumps never race) and the claim returns the new
+      epoch.  The epoch is the fencing token downstream state is named
+      by: the serving fleet writes one WAL file per (shard, epoch), so
+      a reclaimed-from-under-us holder's late appends land in a file no
+      replay will ever read — journals never interleave;
+    * **owner-verified mutation** — :meth:`heartbeat` and
+      :meth:`release` verify the lease body still names THIS owner and
+      epoch before touching the file, so a holder that lost its lease
+      to reclaim can never refresh (or free) the new holder's claim.
+
+    The claim/reclaim discipline itself is unchanged: ``O_CREAT|O_EXCL``
+    claim (exactly one creator wins), mtime heartbeat, rename-first
+    stale reclaim (claim-the-claim — two reclaimers cannot double-free).
+    Clocks follow the module convention: aging uses file mtime (the
+    only clock a shared filesystem gives every process), fake-clock
+    tests age leases with ``os.utime``.
+    """
+
+    def __init__(self, root, owner, lease_ttl=15.0, metrics=None):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.owner = str(owner)
+        self.lease_ttl = float(lease_ttl)
+        self.metrics = metrics if metrics is not None else get_metrics("fleet")
+        self.held = {}  # name -> epoch this owner currently holds
+
+    def _lease_path(self, name):
+        return os.path.join(self.root, f"{name}{_LEASE_SUFFIX}")
+
+    def _epoch_path(self, name):
+        return os.path.join(self.root, f"{name}.epoch")
+
+    def read_epoch(self, name):
+        """The last epoch ever claimed for ``name`` (0 = never)."""
+        try:
+            with open(self._epoch_path(name)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def holder(self, name):
+        """The lease body ``{owner, epoch, ts}`` of ``name``'s current
+        claim, or None (unleased / torn mid-claim)."""
+        try:
+            with open(self._lease_path(name)) as f:
+                rec = json.loads(f.read())
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def try_claim(self, name):
+        """Atomically claim ``name``: exactly one ``O_EXCL`` creator
+        wins and gets the bumped epoch back (None = lost the race).
+        The epoch bump is serialized BY the claim itself — nobody else
+        can win the O_EXCL while this lease file exists, and reclaim
+        renames it away before the next claim — so epochs are strictly
+        monotonic per name across any claim/crash/reclaim history."""
+        path = self._lease_path(name)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            self.metrics.counter("lease.contention").inc()
+            return None
+        epoch = self.read_epoch(name) + 1
+        _atomic_write(self._epoch_path(name), str(epoch).encode())
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps({"owner": self.owner, "epoch": epoch,
+                                "ts": time.time()}))
+        self.held[name] = epoch
+        self.metrics.counter("lease.claims").inc()
+        return epoch
+
+    def verify_held(self, name):
+        """True while the on-disk lease still names this owner at the
+        epoch it claimed.  False means the lease was reclaimed (or
+        released) from under us — the caller must stop serving the
+        name; its epoch-named state is already fenced off."""
+        want = self.held.get(name)
+        if want is None:
+            return False
+        rec = self.holder(name)
+        if (rec is None or rec.get("owner") != self.owner
+                or rec.get("epoch") != want):
+            self.held.pop(name, None)
+            return False
+        return True
+
+    def heartbeat(self, name):
+        """Refresh a held lease's mtime; returns False (and forgets the
+        hold) when the lease was reclaimed from under us — unlike the
+        gen/shard lease, a long-lived holder MUST notice, because it is
+        still serving."""
+        if not self.verify_held(name):
+            return False
+        try:
+            os.utime(self._lease_path(name), None)
+            self.metrics.counter("lease.heartbeats").inc()
+        except FileNotFoundError:
+            self.held.pop(name, None)
+            return False
+        return True
+
+    def release(self, name):
+        """Drop a held lease (the graceful-drain path).  Owner-verified:
+        releasing a lease someone else re-claimed would free THEIR
+        ownership.  The verify-then-remove pair is not atomic — a holder
+        stalled PAST the TTL could, in the instant between the two,
+        lose a reclaim race and delete the next claimant's file; the
+        epoch fence self-heals it (the claimant's next verification
+        fails and the shard re-adopts one epoch later), and making it
+        atomic would need a cross-process lock on every lease op."""
+        if not self.verify_held(name):
+            return False
+        self.held.pop(name, None)
+        try:
+            os.remove(self._lease_path(name))
+        except FileNotFoundError:
+            pass
+        return True
+
+    def reclaim(self, names):
+        """Free leases older than ``lease_ttl`` (holder stopped
+        heartbeating: dead, or stalled past the TTL — its epoch fences
+        its late writes either way).  Rename-first, exactly as
+        :meth:`FleetMembership.reclaim_stale`: two concurrent reclaimers
+        free each lease at most once.  Returns the freed names — the
+        caller claims them (bumping the epoch) before adopting any
+        state."""
+        freed = []
+        now = time.time()
+        for name in names:
+            path = self._lease_path(name)
+            try:
+                age = now - os.path.getmtime(path)
+            except FileNotFoundError:
+                continue
+            if age < self.lease_ttl:
+                continue
+            mine = f"{path}.reclaim.{_claim_suffix()}"
+            try:
+                os.rename(path, mine)
+            except FileNotFoundError:
+                continue  # another reclaimer (or the holder) won
+            try:
+                with open(mine) as f:
+                    dead = (json.loads(f.read() or "{}") or {}).get(
+                        "owner", "?")
+            except (OSError, ValueError):
+                dead = "?"
+            os.remove(mine)
+            freed.append(name)
+            self.metrics.counter("lease.reclaims").inc()
+            logger.warning("reclaimed stale epoch lease %s (holder %s, "
+                           "%.1fs old)", name, dead, age)
+        return freed
+
+    def unleased(self, names):
+        """The subset of ``names`` with no live lease file (claimable)."""
+        return [n for n in names
+                if not os.path.exists(self._lease_path(n))]

@@ -7,10 +7,15 @@ fused tell+ask device program per ask wave, and
 ``hyperopt_tpu.service.server`` puts a stdlib HTTP front end
 (``POST /study``, ``POST /ask``, ``POST /tell``, ``GET /studies``) on top
 — the surface every later workload (ATPE, multi-objective, ASHA) plugs
-into.
+into.  ``service/fleet.py`` (ISSUE 12) replicates that server: N
+processes over one store root partition the study keyspace into leased
+study-shards with per-(shard, epoch) WALs, 307 routing and
+bit-identical WAL-replay migration — one logical service that survives
+SIGKILLs and rolling restarts with zero lost tells.
 """
 
 from .client import ServiceClient
+from .fleet import FleetReplica, ShardNotOwned, ShardUnavailable, shard_of
 from .journal import StudyJournal
 from .overload import AdmissionGuard, Deadline, DegradeLadder, OverloadError
 from .scheduler import (DrainingError, StudyQuotaError, StudyScheduler,
@@ -20,4 +25,5 @@ from .spacespec import space_from_spec
 __all__ = ["StudyScheduler", "StudyQuotaError", "UnknownStudyError",
            "DrainingError", "StudyJournal", "AdmissionGuard", "Deadline",
            "DegradeLadder", "OverloadError", "ServiceClient",
+           "FleetReplica", "ShardNotOwned", "ShardUnavailable", "shard_of",
            "space_from_spec"]

@@ -26,6 +26,7 @@ harnesses, not an SDK.
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
@@ -53,21 +54,41 @@ class ServiceUnavailable(RuntimeError):
 
 
 #: connection-level failures worth retrying: the server restarting
-#: (refused), dying mid-response (reset/aborted), or wedged (timeout)
+#: (refused), dying mid-response (reset/aborted — a SIGKILL between
+#: the status line and the body surfaces as IncompleteRead/
+#: BadStatusLine, i.e. http.client.HTTPException), or wedged
+#: (timeout).  Retrying a possibly-served ask is safe: the per-ask
+#: idempotency token answers the original trials (ISSUE 12).
 _CONN_ERRORS = (ConnectionError, TimeoutError, urllib.error.URLError,
-                OSError)
+                OSError, http.client.HTTPException)
 
 
 class ServiceClient:
     """One service endpoint + one retry policy.  ``retry`` coerces like
     every other retry knob in the repo (None/int/policy); the default
-    absorbs a server restart (5 retries, 0.2s base ≈ 6s worst case)."""
+    absorbs a server restart (5 retries, 0.2s base ≈ 6s worst case).
+
+    Fleet-aware (ISSUE 12): ``url`` may be a LIST of replica addresses —
+    the first is the primary, the rest are failover seeds rotated to on
+    connection-level errors.  A 307 answer (the study's shard is owned
+    by another replica) is followed to its ``location`` with a bounded
+    hop count (``max_hops``); the resolved owner is cached per study so
+    steady-state traffic goes straight to the right replica.  A hop
+    budget exhausted (redirect loop / stale ownership table) — or a
+    retryable status from a cached route — drops the cache entry and
+    degrades to plain retry-with-backoff from the seed list, so routing
+    staleness is never worse than a 429."""
+
+    #: bound on 307 redirects followed within one attempt: a loop or a
+    #: stale-table ping-pong degrades to backoff instead of spinning
+    max_hops = 4
 
     def __init__(self, url, retry=None, timeout=60.0, deadline_ms=None,
                  sleep=time.sleep, key=0, trace=None):
         from .._env import parse_reqtrace
 
-        self.url = str(url).rstrip("/")
+        urls = [url] if isinstance(url, str) else list(url)
+        self.urls = [str(u).rstrip("/") for u in urls]
         self.retry = (RetryPolicy(max_retries=5, base_delay=0.2,
                                   max_delay=5.0)
                       if retry is None else RetryPolicy.coerce(retry))
@@ -76,6 +97,8 @@ class ServiceClient:
         self._sleep = sleep
         self._key = key
         self.retries = 0  # total backoffs taken (harness assertions)
+        self.redirects = 0  # total 307 hops followed (harness assertions)
+        self._routes = {}  # study_id -> owning replica base URL (fleet)
         # request tracing (ISSUE 11): ONE trace id per logical request —
         # every RetryPolicy attempt reuses it with a FRESH span id, so
         # the server (and the WAL) can tie a client's retries together
@@ -116,6 +139,21 @@ class ServiceClient:
     def _attempt_headers(self, v):
         self._tls.attempt_headers = v
 
+    @property
+    def url(self):
+        """The attempt-scoped base URL (thread-local, set by
+        :meth:`request` for redirect-following and seed rotation);
+        outside a request, the primary seed."""
+        return getattr(self._tls, "base", None) or self.urls[0]
+
+    @url.setter
+    def url(self, v):
+        # back-compat: harnesses that retarget a client mid-test
+        # (`client.url = new_url`) replace the whole seed list
+        self.urls = [str(v).rstrip("/")]
+        self._routes.clear()
+        self._tls.base = None
+
     # -- transport ---------------------------------------------------------
 
     def _once(self, method, path, body):
@@ -148,9 +186,23 @@ class ServiceClient:
         ``(status, payload)`` for any non-retryable answer; raises
         :class:`ServiceUnavailable` when retries run out.  With tracing
         armed, all attempts share one trace id (fresh span id each) and
-        the attempt span + ``traceparent`` header carry it."""
+        the attempt span + ``traceparent`` header carry it.
+
+        Fleet routing: the attempt base starts from the study's cached
+        owner (else the seed list); a 307 answer re-issues at its
+        ``location`` immediately (no backoff, no retry consumed, at most
+        ``max_hops`` per attempt — past that the redirect is treated as
+        retryable).  Connection-level failures rotate to the next seed
+        URL and drop the study's cached route (the owner may have
+        died — the survivor's table answers the next 307)."""
+        body = body or {}
+        sid = body.get("study_id") if isinstance(body, dict) else None
         last_status, last_err = None, None
         attempt = 0
+        hops = 0
+        seed_i = 0
+        base = self._routes.get(sid) if sid is not None else None
+        first = True
         root = reqtrace.mint() if self.trace_enabled else None
         if root is not None:
             self.last_trace = root.trace_id
@@ -158,12 +210,15 @@ class ServiceClient:
         while True:
             ctx = None
             self._attempt_headers = None
+            self._tls.base = base or self.urls[seed_i % len(self.urls)]
             if root is not None:
-                # fresh span per ATTEMPT under the one logical trace
-                ctx = (root if not attempt else reqtrace.child(root))
+                # fresh span per ATTEMPT (and per redirect hop) under
+                # the one logical trace
+                ctx = (root if first else reqtrace.child(root))
                 self.last_spans.append(ctx.span_id)
                 self._attempt_headers = {
                     "traceparent": ctx.traceparent()}
+            first = False
             try:
                 if ctx is not None:
                     with _tracer.span("client.request",
@@ -171,16 +226,48 @@ class ServiceClient:
                                       span=ctx.span_id, attempt=attempt,
                                       path=path):
                         status, payload, retry_after = self._once(
-                            method, path, body or {})
+                            method, path, body)
                 else:
                     status, payload, retry_after = self._once(
-                        method, path, body or {})
+                        method, path, body)
             except _CONN_ERRORS as e:
                 status, payload, retry_after = None, None, None
                 last_err = e
-            if status is not None and status not in retryable:
+                # this base is unreachable: forget any cached route
+                # through it and rotate to the next seed
+                if sid is not None:
+                    self._routes.pop(sid, None)
+                base = None
+                seed_i += 1
+            if (status == 307 and isinstance(payload, dict)
+                    and payload.get("location")):
+                hops += 1
+                self.redirects += 1
+                if hops <= self.max_hops:
+                    base = str(payload["location"]).rstrip("/")
+                    if sid is not None:
+                        self._routes[sid] = base
+                    continue  # immediate re-issue: no backoff consumed
+                # hop budget exhausted: a redirect loop or a stale
+                # ownership table — degrade to plain backoff from seeds
+                if sid is not None:
+                    self._routes.pop(sid, None)
+                base = None
+                hops = 0
+            elif status is not None and status not in retryable:
                 return status, payload
-            last_status = status
+            elif status is not None:
+                # retryable answer: drop any cached route (the shard may
+                # be mid-migration; a seed will 307 to the new owner)
+                # and rotate to the next seed — a draining/overloaded
+                # replica must not eat the whole retry budget while a
+                # healthy peer could serve (sid-less /study included)
+                if sid is not None:
+                    self._routes.pop(sid, None)
+                if base is None:
+                    seed_i += 1
+                base = None
+            last_status = status if status is not None else last_status
             if not self.retry.retries_left(attempt + 1):
                 raise ServiceUnavailable(
                     f"{method} {path}: retries exhausted "
@@ -201,6 +288,7 @@ class ServiceClient:
                 attempt, key=f"{self._key}:{path}", floor=floor))
             self.retries += 1
             attempt += 1
+            hops = 0
 
     # -- protocol helpers --------------------------------------------------
 
@@ -218,9 +306,19 @@ class ServiceClient:
 
     def ask(self, study_id, n=1):
         """Returns the response payload's ``trials`` list (each entry
-        carries ``degraded``/``algo`` flags when the ladder served it)."""
+        carries ``degraded``/``algo`` flags when the ladder served it).
+
+        Every logical ask carries a fresh idempotency token (``req``):
+        if the response is lost (server crash after the ask became
+        durable, dropped connection, a 307 mid-migration) the retry
+        answers the ORIGINAL trials instead of burning a new seed draw
+        — without it, a retried ask would silently fork the study's
+        proposal stream from its deterministic reference."""
+        import os as _os
+
         status, payload = self.request(
-            "POST", "/ask", {"study_id": study_id, "n": n})
+            "POST", "/ask", {"study_id": study_id, "n": n,
+                             "req": _os.urandom(8).hex()})
         if status != 200:
             raise ServiceUnavailable(
                 f"/ask failed: {payload.get('error')}", status=status)

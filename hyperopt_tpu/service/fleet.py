@@ -1,0 +1,703 @@
+"""Replicated serving fleet: N server replicas, one logical ask/tell
+service (ISSUE 12).
+
+PRs 9-11 built a durable, traced, overload-safe serving plane — but
+exactly ONE process owned the :class:`StudyScheduler`, the mesh and the
+WAL, so a single box was the throughput ceiling and any restart a
+brown-out.  This module combines the two planes the repo already has —
+``parallel/membership.py``'s lease machinery (PR 8) and the WAL's
+bit-identical crash-resume (PR 10) — into a fleet:
+
+* the **study keyspace partitions into M study-shards** —
+  :func:`shard_of` buckets a study id by CRC32, pinned forever (the
+  shard count is a write-once property of the store root, verified by
+  every joiner via ``fleet/params.json``);
+* each shard is owned through a **long-lived epoch lease**
+  (:class:`~hyperopt_tpu.parallel.membership.EpochLeases`: ``O_EXCL``
+  claim, mtime heartbeat, rename-first stale reclaim) and served by its
+  own :class:`StudyScheduler` whose WAL is the **(shard, epoch) journal**
+  ``fleet/wal/shard<k>/e<epoch>.<replica>.jsonl`` — epochs bump on every
+  claim, so two owners' journals can NEVER interleave: a
+  reclaimed-from-under-us holder's late appends land in a file fenced
+  off by its dead epoch;
+* an **ownership table** (``fleet/owners/shard<k>.json``, journaled
+  next to the leases) maps each shard to its owner's advertised
+  address; a request for a study this replica doesn't own raises
+  :class:`ShardNotOwned` → HTTP **307** with the owner's address, which
+  :class:`~hyperopt_tpu.service.client.ServiceClient` follows with a
+  bounded hop count (loops/stale tables degrade to retry-with-backoff);
+* **migration is WAL replay**: adopting a shard (stale reclaim after a
+  SIGKILL, or the volunteer handoff of a drain/rebalance) replays the
+  shard's epoch-WAL chain oldest-first through
+  :meth:`StudyScheduler.resume` — and because resume is pinned
+  bit-identical (ISSUE 10), a migrated study's subsequent proposals
+  equal the undisturbed single-server reference (tier-1 pinned, and
+  end-to-end by ``scripts/fleet_smoke.py``'s SIGKILL + rolling-restart
+  phases).  Adoption compacts the chain into one snapshot-led file for
+  the new epoch and deletes the ancestors (only after the compaction —
+  and its parent-directory entry — are durable);
+* a **steward** thread per replica heartbeats its leases, reclaims
+  stale ones, and rebalances toward ``ceil(M / live replicas)`` held
+  shards — a joining replica is volunteered shards by drain-handoff, a
+  dead one's shards are adopted within ~``lease_ttl``.
+
+Consistency note (DESIGN.md §19): ownership mutations are fenced by the
+lease epoch — re-verified at every durability point (ask ingress, wave
+start, tell ingress), not just at routing — and every acknowledged
+mutation is fsynced into the shard's epoch WAL before the client
+unblocks, so a SIGKILL loses nothing and a reclaim replays everything.
+The residual window is a LIVE holder stalled past ``lease_ttl`` whose
+fence check passes immediately before the reclaim lands: its record
+reaches a WAL the adopter may already have replayed (and whose file the
+adoption compaction may delete), so that acknowledgment can be fenced
+out of the fleet's view entirely.  The window is a single
+fence-to-fsync interval — microseconds, vs the adopter's
+milliseconds-scale claim+scan — and requires the holder to have missed
+every heartbeat for a full TTL first; closing it completely would need
+per-record fencing on the shared filesystem.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+import zlib
+
+from ..filestore import _atomic_write, new_run_id
+from ..obs.metrics import get_metrics
+from ..parallel.membership import (EpochLeases, publish_params_once,
+                                   rotate_for_owner)
+from .journal import StudyJournal, _fsync_dir
+
+__all__ = ["FleetReplica", "ShardNotOwned", "ShardUnavailable",
+           "shard_of", "FLEET_DIR"]
+
+logger = logging.getLogger(__name__)
+
+#: fleet metadata directory under a store root
+FLEET_DIR = "fleet"
+
+
+class ShardNotOwned(RuntimeError):
+    """This replica does not own the study's shard; ``location`` is the
+    advertised address of the replica that does (HTTP 307)."""
+
+    def __init__(self, message, location):
+        super().__init__(message)
+        self.location = str(location)
+
+
+class ShardUnavailable(RuntimeError):
+    """No replica currently serves the shard (the owner died and no
+    survivor adopted it yet, the fleet is mid-rebalance, or this replica
+    is still starting) — retryable, HTTP 503 + ``Retry-After``."""
+
+    def __init__(self, message, retry_after=0.5):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+def shard_of(study_id, n_shards):
+    """Study id → shard bucket.  CRC32 — stable across processes,
+    Python versions and restarts, unlike the salted builtin ``hash``.
+    PINNED (test literal): re-bucketing would strand every persisted
+    study behind 307 redirects to the wrong owner."""
+    return zlib.crc32(str(study_id).encode()) % int(n_shards)
+
+
+def _shard_name(shard):
+    return f"shard{int(shard):04d}"
+
+
+class FleetReplica:
+    """One replica's membership in the serving fleet: its held shard
+    leases, the per-shard schedulers + epoch WALs behind them, and the
+    steward that keeps ownership balanced and failure-reclaimed.  The
+    HTTP layer (``service/server.py``) routes every study-scoped request
+    through :meth:`scheduler_for` and creates studies via
+    :meth:`place_study`; everything else here is the control plane."""
+
+    def __init__(self, store_root, n_shards=None, replica_id=None,
+                 addr=None, lease_ttl=None, poll=None,
+                 scheduler_kwargs=None):
+        from .._env import parse_fleet_lease_ttl, parse_fleet_shards
+
+        self.store_root = str(store_root)
+        self.n_shards = (parse_fleet_shards() if n_shards is None
+                         else int(n_shards))
+        if self.n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.replica_id = _safe_id(
+            replica_id or f"{os.uname().nodename}-{os.getpid()}")
+        self.addr = str(addr).rstrip("/") if addr else None
+        self.lease_ttl = (parse_fleet_lease_ttl() if lease_ttl is None
+                          else float(lease_ttl))
+        #: steward sweep period; also the lease heartbeat cadence — four
+        #: beats per TTL keeps one lost sweep from looking like a death
+        self.poll = (max(0.05, self.lease_ttl / 4.0) if poll is None
+                     else float(poll))
+        self.member_ttl = 3.0 * self.lease_ttl
+        self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        self.metrics = get_metrics("service")
+        self.overload = None  # AdmissionGuard, wired by the HTTP server
+
+        self._fleet = os.path.join(self.store_root, FLEET_DIR)
+        for d in ("owners", "replicas", "wal"):
+            os.makedirs(os.path.join(self._fleet, d), exist_ok=True)
+        self.leases = EpochLeases(
+            os.path.join(self._fleet, "shardleases"), owner=self.replica_id,
+            lease_ttl=self.lease_ttl, metrics=self.metrics)
+        self._ensure_params()
+
+        self._lock = threading.RLock()
+        self.schedulers = {}   # shard -> StudyScheduler (held shards only)
+        self.epochs = {}       # shard -> lease epoch backing the WAL name
+        self._verified = {}    # shard -> monotonic ts of last lease verify
+        #: how stale a lease verification may get before a study-scoped
+        #: request re-reads the lease body (bounds the stalled-holder
+        #: acknowledgment window to a fraction of the reclaim TTL)
+        self._verify_every = max(0.05, self.lease_ttl / 4.0)
+        self._draining = False
+        self._stop = threading.Event()
+        self._hb_stop = threading.Event()
+        self._thread = None
+        self._hb_thread = None
+        self.adoptions = 0
+        self.handoffs = 0
+        self.leases_lost = 0
+
+    # -- write-once fleet params (joiners verify) --------------------------
+
+    def _ensure_params(self):
+        """First replica pins ``{n_shards}``; every joiner must match —
+        a different shard count would re-bucket the whole keyspace
+        (``HYPEROPT_TPU_FLEET_SHARDS`` is write-once per store root)."""
+        publish_params_once(
+            os.path.join(self._fleet, "params.json"),
+            {"n_shards": self.n_shards},
+            what=f"serving-fleet store {self.store_root}")
+
+    # -- shard-epoch WAL naming --------------------------------------------
+
+    def _wal_dir(self, shard):
+        return os.path.join(self._fleet, "wal", _shard_name(shard))
+
+    def _wal_path(self, shard, epoch):
+        return os.path.join(self._wal_dir(shard),
+                            f"e{int(epoch):05d}.{self.replica_id}.jsonl")
+
+    def wal_chain(self, shard):
+        """The shard's existing epoch WAL files, oldest epoch first —
+        what an adoption replays.  Normally length ≤ 1 (each adoption
+        compacts its ancestors away); longer only after a crash between
+        compaction and ancestor deletion, which replays idempotently."""
+        d = self._wal_dir(shard)
+        try:
+            names = os.listdir(d)
+        except FileNotFoundError:
+            return []
+        out = []
+        for fname in names:
+            m = re.match(r"e(\d+)\..+\.jsonl$", fname)
+            if m:
+                out.append((int(m.group(1)), os.path.join(d, fname)))
+        return [p for _, p in sorted(out)]
+
+    # -- ownership table (routing; journaled next to the leases) -----------
+
+    def _owner_path(self, shard):
+        return os.path.join(self._fleet, "owners",
+                            f"{_shard_name(shard)}.json")
+
+    def read_owner(self, shard):
+        """The shard's published owner entry ``{replica, addr, epoch}``,
+        or None.  Advisory — the LEASE is ownership; this table only
+        tells routers where to redirect."""
+        try:
+            with open(self._owner_path(shard)) as f:
+                rec = json.loads(f.read())
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _publish_ownership(self, shard, epoch):
+        _atomic_write(self._owner_path(shard), json.dumps(
+            {"shard": int(shard), "replica": self.replica_id,
+             "addr": self.addr, "epoch": int(epoch), "ts": time.time()},
+            sort_keys=True).encode())
+
+    def _clear_ownership(self, shard):
+        """Remove our routing entry (drain path) so routers answer a
+        retryable 503 instead of bouncing clients to a corpse; never
+        touch an entry a NEW owner already published."""
+        rec = self.read_owner(shard)
+        if rec is not None and rec.get("replica") != self.replica_id:
+            return
+        try:
+            os.remove(self._owner_path(shard))
+        except FileNotFoundError:
+            pass
+
+    # -- replica records (liveness by mtime; sizes the balance target) -----
+
+    def _replica_path(self, rid=None):
+        return os.path.join(self._fleet, "replicas",
+                            _safe_id(rid or self.replica_id))
+
+    def join(self):
+        _atomic_write(self._replica_path(), json.dumps(
+            {"replica": self.replica_id, "addr": self.addr,
+             "pid": os.getpid(), "joined": time.time()},
+            sort_keys=True).encode())
+        self.metrics.counter("service.fleet.joins").inc()
+
+    def heartbeat_replica(self):
+        try:
+            os.utime(self._replica_path(), None)
+        except FileNotFoundError:
+            self.join()
+
+    def leave(self):
+        try:
+            os.remove(self._replica_path())
+        except FileNotFoundError:
+            pass
+
+    def live_replicas(self):
+        """Replica ids whose record heartbeated within ``member_ttl``
+        (a dead replica ages out; leaving is optional)."""
+        d = os.path.join(self._fleet, "replicas")
+        now = time.time()
+        out = []
+        for fname in sorted(os.listdir(d)):
+            try:
+                age = now - os.path.getmtime(os.path.join(d, fname))
+            except FileNotFoundError:
+                continue
+            if age <= self.member_ttl:
+                out.append(fname)
+        return out
+
+    def target_shards(self):
+        """How many shards this replica should hold: ``ceil(M / live)``
+        — every member computes the same target from the same records,
+        so excess holders volunteer handoffs and underfull ones claim,
+        converging without any coordinator."""
+        live = max(1, len(self.live_replicas()))
+        return min(self.n_shards, math.ceil(self.n_shards / live))
+
+    # -- adoption (the migration path) -------------------------------------
+
+    def adopt(self, shard):
+        """Claim ``shard`` and rebuild its studies by replaying the
+        epoch-WAL chain into a fresh per-shard scheduler (bit-identical
+        by the resume pins).  Returns True on success; False when the
+        claim was lost to a racing replica (normal contention)."""
+        name = _shard_name(shard)
+        epoch = self.leases.try_claim(name)
+        if epoch is None:
+            return False
+        t0 = time.perf_counter()
+        from .scheduler import StudyScheduler
+
+        os.makedirs(self._wal_dir(shard), exist_ok=True)
+        new_path = self._wal_path(shard, epoch)
+        chain = [p for p in self.wal_chain(shard) if p != new_path]
+        sched = StudyScheduler(store_root=self.store_root, wal=new_path,
+                               auto_resume=False, **self.scheduler_kwargs)
+        if self.overload is not None:
+            sched.overload = self.overload
+        # the durability fence: every ask/wave/tell re-verifies the
+        # lease so a stalled-then-reclaimed holder refuses the mutation
+        # (StaleOwnershipError -> retryable 503) instead of landing
+        # state the new owner's replay never saw
+        sched.fence = lambda: self._fence(shard)
+        try:
+            for path in chain:
+                sched.resume(StudyJournal(path))
+        except Exception:
+            # never serve a half-replayed shard: release the claim so a
+            # healthier replica (or a retry) adopts it instead
+            logger.warning("fleet: replay of %s epoch chain failed; "
+                           "releasing the claim", name, exc_info=True)
+            self.leases.release(name)
+            raise
+        if chain and sched._maybe_compact():
+            # the chain is now one snapshot-led epoch file; drop the
+            # ancestors ONLY after the compacted file (and its directory
+            # entry) are durable — a crash in between replays the chain
+            # again, idempotently
+            _fsync_dir(new_path)
+            for path in chain:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass
+            _fsync_dir(new_path)
+        with self._lock:
+            self.schedulers[shard] = sched
+            self.epochs[shard] = epoch
+            self._verified[shard] = time.monotonic()
+        self._publish_ownership(shard, epoch)
+        self.adoptions += 1
+        self.metrics.counter("service.fleet.adoptions").inc()
+        self.metrics.histogram("service.fleet.adopt_sec").observe(
+            time.perf_counter() - t0)
+        self.metrics.gauge("service.fleet.shards_held").set(
+            len(self.schedulers))
+        return True
+
+    def handoff(self, shard, timeout=30.0):
+        """Volunteer-release one shard (drain / rebalance): quiesce its
+        scheduler (in-flight waves finish, WAL compacts to one snapshot
+        per live study and closes), clear our routing entry, release the
+        lease.  The next owner's adoption replays ONE compacted file."""
+        with self._lock:
+            sched = self.schedulers.pop(shard, None)
+            self.epochs.pop(shard, None)
+            self._verified.pop(shard, None)
+        if sched is None:
+            return False
+        try:
+            sched.drain(timeout=timeout)
+        except Exception:  # noqa: BLE001 - the lease must still be freed
+            logger.warning("fleet: drain of %s failed mid-handoff",
+                           _shard_name(shard), exc_info=True)
+        self._clear_ownership(shard)
+        self.leases.release(_shard_name(shard))
+        self.handoffs += 1
+        self.metrics.counter("service.fleet.handoffs").inc()
+        self.metrics.gauge("service.fleet.shards_held").set(
+            len(self.schedulers))
+        return True
+
+    def _drop_shard(self, shard):
+        """Our lease was reclaimed from under us (we stalled past the
+        TTL): stop serving the shard IMMEDIATELY — no drain, no
+        compaction (rewriting the fenced epoch file could resurrect a
+        journal the adopter already replayed and deleted).  Every
+        acknowledged mutation is already fsynced in the epoch WAL the
+        reclaimer replays, so nothing acked is lost."""
+        sched = self.schedulers.pop(shard, None)
+        self.epochs.pop(shard, None)
+        self._verified.pop(shard, None)
+        if sched is None:
+            return
+        self.leases_lost += 1
+        self.metrics.counter("service.fleet.leases_lost").inc()
+        self.metrics.gauge("service.fleet.shards_held").set(
+            len(self.schedulers))
+        logger.warning("fleet: lost lease on %s (reclaimed by a "
+                       "survivor); dropping the shard un-drained",
+                       _shard_name(shard))
+        # the journal handle is left OPEN on purpose: closing it here
+        # (heartbeat/request thread) would race an in-flight append/sync
+        # under the scheduler's own lock (StudyJournal is only safe
+        # there).  New mutations are refused by the fence; a mutation
+        # already past its fence check completes normally into the
+        # fenced file (the documented residual window), and the handle
+        # dies with the dropped scheduler's GC.
+
+    # -- request routing ---------------------------------------------------
+
+    def _fence(self, shard):
+        """The per-shard schedulers' durability-point ownership check:
+        a fresh lease-body read (no cache — this is the fence), with a
+        lost lease dropping the shard immediately."""
+        if self.leases.verify_held(_shard_name(shard)):
+            return True
+        with self._lock:
+            self._drop_shard(shard)
+        return False
+
+    def scheduler_for(self, study_id):
+        """The scheduler serving ``study_id``'s shard.  Raises
+        :class:`ShardNotOwned` (→ 307 + owner address) when another
+        replica owns it, :class:`ShardUnavailable` (→ 503 retryable)
+        when nobody does yet.  Held leases are re-verified at most every
+        ``lease_ttl/4`` so a stalled-then-reclaimed holder stops
+        acknowledging within a bounded window."""
+        shard = shard_of(study_id, self.n_shards)
+        with self._lock:
+            sched = self.schedulers.get(shard)
+            if sched is not None:
+                now = time.monotonic()
+                if now - self._verified.get(shard, 0.0) > self._verify_every:
+                    if self.leases.verify_held(_shard_name(shard)):
+                        self._verified[shard] = now
+                    else:
+                        self._drop_shard(shard)
+                        sched = None
+            if sched is not None:
+                return sched
+        owner = self.read_owner(shard)
+        if (owner is not None and owner.get("addr")
+                and owner.get("replica") != self.replica_id):
+            raise ShardNotOwned(
+                f"study {study_id} (shard {shard}) is served by "
+                f"{owner['replica']}", owner["addr"])
+        raise ShardUnavailable(
+            f"shard {shard} has no live owner yet (owner died or fleet "
+            "is rebalancing); retry",
+            retry_after=max(0.05, self.lease_ttl / 4.0))
+
+    def place_study(self):
+        """Mint a study id that lands in a shard THIS replica owns
+        (study ids are minted server-side, so creation cannot redirect;
+        redraw until the CRC32 bucket is held — expected ``M/held``
+        draws).  The id claims its store subdirectory atomically
+        (``new_run_id(unique_dir=...)``), so two replicas can never mint
+        the same id.  Returns ``(study_id, scheduler)``."""
+        with self._lock:
+            held = dict(self.schedulers)
+        if not held or self._draining:
+            raise ShardUnavailable(
+                "replica holds no study shards (starting up, draining, "
+                "or every shard is owned elsewhere); retry",
+                retry_after=max(0.05, self.poll))
+        bound = max(64, 32 * self.n_shards // max(1, len(held)))
+        for _ in range(bound):
+            sid = new_run_id("study", unique_dir=self.store_root)
+            shard = shard_of(sid, self.n_shards)
+            sched = held.get(shard)
+            if sched is not None:
+                return sid, sched
+            try:  # release the claimed (empty) directory and redraw
+                os.rmdir(os.path.join(self.store_root, sid))
+            except OSError:
+                pass
+        raise ShardUnavailable(
+            f"could not mint a study id landing in a held shard in "
+            f"{bound} draws", retry_after=max(0.05, self.poll))
+
+    # -- the steward (heartbeat / reclaim / rebalance) ---------------------
+
+    def start(self):
+        """Join the fleet, run one synchronous steward sweep (so a
+        fresh single replica serves immediately), then keep two daemon
+        threads: a fast HEARTBEAT loop (lease + member mtimes — never
+        blocks on anything slower than ``utime``) and the STEWARD loop
+        (reclaim/claim/rebalance).  They are separate on purpose: an
+        adoption replay pays XLA compiles for seconds, and a steward
+        blocked inside one must not starve this replica's OWN lease
+        heartbeats — that self-inflicted staleness is exactly how a
+        LIVE replica gets its other shards reclaimed from under it."""
+        self.join()
+        self.steward_once()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            name=f"hyperopt-fleet-heartbeat-{self.replica_id}",
+            daemon=True)
+        self._hb_thread.start()
+        self._thread = threading.Thread(
+            target=self._steward_loop,
+            name=f"hyperopt-fleet-steward-{self.replica_id}", daemon=True)
+        self._thread.start()
+        return self
+
+    def _heartbeat_loop(self):
+        # its own stop event: the heartbeat must OUTLIVE the steward
+        # during drain — a lease expiring while its shard waits in the
+        # sequential handoff queue would be reclaimed out of a live
+        # (draining) replica, re-opening the zombie window
+        while not self._hb_stop.wait(self.poll):
+            try:
+                self.heartbeat_once()
+            except Exception:  # noqa: BLE001 - heartbeats must survive
+                logger.warning("fleet: heartbeat sweep failed (continuing)",
+                               exc_info=True)
+
+    def _steward_loop(self):
+        while not self._stop.wait(self.poll):
+            try:
+                self.manage_once()
+            except Exception:  # noqa: BLE001 - the steward must survive
+                logger.warning("fleet: steward sweep failed (continuing)",
+                               exc_info=True)
+
+    def steward_once(self):
+        """One full sweep (heartbeat + manage) — the unit tests' and
+        the synchronous-start entry point; the background threads run
+        the two halves independently."""
+        self.heartbeat_once()
+        self.manage_once()
+
+    def heartbeat_once(self):
+        """Refresh the member record and every held lease's mtime;
+        notice (and drop) leases reclaimed from under us.  Runs while
+        draining too, and iterates the LEASE plane's held set (not the
+        scheduler table): a shard mid-handoff is already out of the
+        routing table but its lease must stay fresh until the handoff's
+        compaction releases it — otherwise a long final wave lets a
+        survivor reclaim a lease whose state is still being written."""
+        self.heartbeat_replica()
+        for name in list(self.leases.held):
+            if not self.leases.heartbeat(name):
+                with self._lock:
+                    self._drop_shard(int(name[len("shard"):]))
+
+    def manage_once(self):
+        """Reclaim stale leases fleet-wide (adopting what we freed
+        IMMEDIATELY), claim toward the balance target, hand off excess
+        shards."""
+        if self._draining:
+            return
+        freed = self.leases.reclaim(
+            [_shard_name(s) for s in range(self.n_shards)])
+        if freed:
+            self.metrics.counter("service.fleet.reclaims").inc(len(freed))
+            # a reclaimed shard's owner is DEAD (stale leases only —
+            # graceful handoffs remove their lease file and are never
+            # reclaimed), so adopt it now regardless of the balance
+            # target: its member record lingers for member_ttl and
+            # would otherwise keep every survivor's target too low to
+            # claim, leaving the shard 503 for ~3x lease_ttl.
+            # Availability beats balance; the later rebalance
+            # redistributes.  No thrash risk: only dead owners' shards
+            # take this path.
+            for name in freed:
+                self.adopt(int(name[len("shard"):]))
+        target = self.target_shards()
+        with self._lock:
+            n_held = len(self.schedulers)
+        if n_held < target:
+            for shard in self._claim_rotation():
+                if n_held >= target:
+                    break
+                with self._lock:
+                    if shard in self.schedulers:
+                        continue
+                if not os.path.exists(
+                        self.leases._lease_path(_shard_name(shard))):
+                    if self.adopt(shard):
+                        n_held += 1
+        elif n_held > target and len(self.live_replicas()) > 1:
+            # volunteer handoff toward an underfull joiner; one shard
+            # per sweep keeps rebalance gradual (no thundering drain)
+            with self._lock:
+                excess = max(self.schedulers, default=None)
+            if excess is not None:
+                self.handoff(excess)
+
+    def _claim_rotation(self):
+        """Shards in a deterministic per-replica rotation so
+        simultaneous claimers start at different offsets."""
+        return rotate_for_owner(range(self.n_shards), self.replica_id)
+
+    # -- lifecycle / views -------------------------------------------------
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def set_addr(self, addr):
+        """Advertise ``addr`` (known only after the HTTP bind for
+        ephemeral ports) and refresh every published ownership entry."""
+        self.addr = str(addr).rstrip("/") if addr else None
+        with self._lock:
+            held = dict(self.epochs)
+        for shard, epoch in held.items():
+            self._publish_ownership(shard, epoch)
+
+    def drain(self, timeout=30.0):
+        """The SIGTERM/rolling-restart path: stop stewarding, hand off
+        every held shard (quiesce → compact → release, so survivors
+        adopt one snapshot-led WAL each), leave the fleet.  Returns True
+        when every handoff quiesced in time."""
+        self._draining = True
+        self._stop.set()  # stop the steward; heartbeats keep running
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, self.poll * 2))
+        ok = True
+        deadline = time.monotonic() + float(timeout)
+        with self._lock:
+            held = sorted(self.schedulers)
+        for shard in held:
+            left = max(0.5, deadline - time.monotonic())
+            ok = self.handoff(shard, timeout=left) and ok
+        # only now may the heartbeat die: every lease is released
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=max(1.0, self.poll * 2))
+        self.leave()
+        return ok
+
+    def healthz(self):
+        """The machine-readable ``GET /healthz`` body: who this replica
+        is, which shard leases (and epochs) it holds, drain state, and
+        WAL sync health — what ``scripts/fleet_restart.py`` polls
+        between restarts and ``obs/top.py``'s FLEET row renders."""
+        with self._lock:
+            shards = {}
+            for shard, sched in self.schedulers.items():
+                j = sched.journal
+                shards[str(shard)] = {
+                    "epoch": self.epochs.get(shard),
+                    "studies": len(sched._studies),
+                    "wal": None if j is None else {
+                        "path": j.path, "appends": j.appends,
+                        "syncs": j.syncs, "compactions": j.compactions,
+                    },
+                }
+        return {
+            "ok": not self._draining,
+            "replica": self.replica_id,
+            "addr": self.addr,
+            "n_shards": self.n_shards,
+            "shards_held": sorted(int(k) for k in shards),
+            "shards": shards,
+            "draining": self._draining,
+            "wal_sync_errors": self.metrics.counter(
+                "service.wal.sync_errors").value,
+            "replicas": self.live_replicas(),
+            "adoptions": self.adoptions,
+            "handoffs": self.handoffs,
+            "leases_lost": self.leases_lost,
+            "lease_ttl": self.lease_ttl,
+            "ts": time.time(),
+        }
+
+    def studies_status(self):
+        """The fleet replica's ``GET /studies`` body: every held
+        shard's study table merged, plus the fleet block the dashboard's
+        FLEET row reads."""
+        with self._lock:
+            scheds = dict(self.schedulers)
+        studies, cohorts = [], []
+        n_slots = n_live = 0
+        wal = None
+        for shard in sorted(scheds):
+            st = scheds[shard].studies_status()
+            studies.extend(st["studies"])
+            cohorts.extend(st["cohorts"])
+            for c in st["cohorts"]:
+                n_slots += c["n_slots"]
+                n_live += c["n_live"]
+            if st.get("wal"):
+                wal = st["wal"]  # representative; healthz has all
+        from ..algos import tpe
+
+        out = {
+            "ts": time.time(),
+            "n_studies": len(studies),
+            "slot_utilization": (n_live / n_slots) if n_slots else 0.0,
+            "cohort_cache": tpe.cohort_cache_stats(),
+            "cohorts": cohorts,
+            "studies": studies,
+            "draining": self._draining,
+            "fleet": self.healthz(),
+        }
+        if wal is not None:
+            out["wal"] = wal
+        return out
+
+
+def _safe_id(rid):
+    """Replica ids become path components (WAL file names, replica
+    records) — keep them one component."""
+    return re.sub(r"[^A-Za-z0-9._-]", "-", str(rid))

@@ -86,6 +86,29 @@ def wal_path_for(store_root):
     return os.path.join(str(store_root), WAL_BASENAME)
 
 
+def _fsync_dir(path):
+    """fsync the DIRECTORY holding ``path``.  ``os.replace`` makes the
+    compacted journal visible atomically, but on ext4-ordered (and most
+    journaled) mounts the rename itself is only durable once the parent
+    directory entry is flushed — a crash right after the replace could
+    otherwise resurrect the pre-compaction journal, whose stale records
+    would replay draws the snapshot already accounts for.  Best-effort:
+    some filesystems refuse O_RDONLY fsync on directories; losing the
+    directory flush there degrades to the pre-ISSUE-12 ordering, never
+    to an error on the serving path."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class StudyJournal:
     """Append-side + replay-side of the WAL.  Not thread-safe by itself —
     the scheduler already serializes every mutation under its lock, and
@@ -191,6 +214,9 @@ class StudyJournal:
             except OSError:
                 pass
             raise JournalError(f"journal compaction failed: {e}") from e
+        # the rename is durable only once the parent directory entry is
+        # too (ISSUE 12 satellite — see _fsync_dir)
+        _fsync_dir(self.path)
         self.compactions += 1
 
     # -- record constructors (one place owns the schema) -------------------
@@ -210,12 +236,17 @@ class StudyJournal:
         return rec
 
     @staticmethod
-    def ask_rec(study_id, tids, seed, algo, trace=None):
+    def ask_rec(study_id, tids, seed, algo, trace=None, req=None):
         rec = {"kind": "ask", "sid": study_id,
                "tids": [int(t) for t in tids], "seed": int(seed),
                "algo": str(algo), "ts": time.time()}
         if trace is not None:
             rec["trace"] = str(trace)
+        if req is not None:
+            # the client's ask-idempotency token (ISSUE 12): replay
+            # rebuilds the served-request map from it so a retried ask
+            # answers the same tids across crashes and shard migrations
+            rec["req"] = str(req)
         return rec
 
     @staticmethod
@@ -239,7 +270,7 @@ class StudyJournal:
         """Compaction record for one study: registry entry + exact RNG
         position (``numpy`` Generator state is a JSON-clean dict of
         bigints) so replay resumes the seed stream mid-flight."""
-        return {
+        rec = {
             "kind": "snapshot", "sid": study.study_id,
             "spec": study.space_spec, "seed": study.seed,
             "kwargs": study.admit_kwargs,
@@ -247,3 +278,9 @@ class StudyJournal:
             "n_asked": study.n_asked, "n_told": study.n_told,
             "state": study.state, "ts": time.time(),
         }
+        if study.served_reqs:
+            # compaction must not break ask idempotency: the retry
+            # window spans a drain/migration (pre-field snapshots
+            # replay fine — the map just starts empty)
+            rec["served"] = dict(study.served_reqs)
+        return rec

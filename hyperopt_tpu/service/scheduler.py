@@ -75,7 +75,8 @@ from .overload import (LADDER_LEVELS, DeadlineExceeded, DegradeLadder,
                        NonFiniteProposal, is_device_fault)
 
 __all__ = ["StudyScheduler", "Study", "StudyQuotaError",
-           "UnknownStudyError", "DuplicateTellError", "DrainingError"]
+           "UnknownStudyError", "DuplicateTellError", "DrainingError",
+           "StaleOwnershipError"]
 
 
 class UnknownStudyError(KeyError):
@@ -98,6 +99,14 @@ class DuplicateTellError(RuntimeError):
     back off forever on a 429)."""
 
 
+class StaleOwnershipError(RuntimeError):
+    """The shard lease backing this scheduler was reclaimed (fleet
+    mode, ISSUE 12): the mutation was refused BEFORE anything became
+    durable, so the fenced-off epoch WAL gains no record the new
+    owner's replay never saw.  Retryable (HTTP 503) — the client's
+    retry routes to the new owner via the ownership table."""
+
+
 def _pow2(n):
     b = 1
     while b < n:
@@ -113,6 +122,11 @@ _tracer = Tracer()
 #: bound on each study's in-memory audit timeline; the WAL is the
 #: durable record, this ring is the live `GET /study/<id>/timeline` view
 _STUDY_EVENT_CAP = 512
+
+#: bound on each study's served-ask idempotency map: the retry window
+#: only ever needs the most recent handful of request ids, and an
+#: unbounded map would grow one entry per ask forever
+_SERVED_REQ_CAP = 128
 
 
 class Study:
@@ -175,6 +189,22 @@ class Study:
         # by `obs.report --study`
         self.events = deque(maxlen=_STUDY_EVENT_CAP)
         self.events_dropped = 0
+        # ask idempotency (ISSUE 12): client request id -> the tids that
+        # ask served.  A RETRIED ask (its response was lost to a crash
+        # or a dropped connection AFTER the ask record became durable)
+        # answers the SAME trials instead of drawing a fresh seed — the
+        # ask-side analog of 409-on-retried-tell.  Bounded (insertion
+        # order), journaled on the ask record, snapshot-carried, and
+        # rebuilt by WAL replay so the dedupe survives crashes AND
+        # shard migrations.
+        self.served_reqs = {}
+
+    def remember_req(self, req_id, tids):
+        if not req_id:
+            return
+        self.served_reqs[str(req_id)] = [int(t) for t in tids]
+        while len(self.served_reqs) > _SERVED_REQ_CAP:
+            del self.served_reqs[next(iter(self.served_reqs))]
 
     def note(self, event, **attrs):
         """Append one audit-timeline event (pure metadata — never feeds
@@ -252,10 +282,10 @@ class _AskReq:
 
     __slots__ = ("study", "new_ids", "seed", "docs", "error", "algo",
                  "degraded", "replay", "deadline", "journaled", "trace",
-                 "wave")
+                 "wave", "req")
 
     def __init__(self, study, new_ids, seed, deadline=None, replay=False,
-                 trace=None):
+                 trace=None, req=None):
         self.study = study
         self.new_ids = new_ids
         self.seed = seed
@@ -269,6 +299,7 @@ class _AskReq:
         # at ingress, carried into the wave span's links, the cohort-tick
         # stamp, the WAL ask record and the study's audit timeline
         self.trace = trace
+        self.req = req  # client idempotency token (ISSUE 12)
         self.wave = None  # wave sequence number, stamped by the ticker
         # True once the served-ask record is in the WAL: a later failure
         # (doc landing) must NOT also journal a void record — two
@@ -561,6 +592,13 @@ class StudyScheduler:
         self._wave_seq = 0  # wave sequence: the id request spans fan into
         self.metrics = get_metrics("service")
         self.overload = overload
+        # ownership fence (ISSUE 12): fleet mode installs a callable
+        # answering "does this scheduler's shard lease still stand?".
+        # Checked at every DURABILITY point (ask ingress, wave start,
+        # tell ingress) so a stalled-then-reclaimed holder refuses the
+        # mutation instead of acknowledging into a fenced epoch WAL.
+        # None (single-server mode) = never fenced.
+        self.fence = None
 
         if wal is None:
             mode = parse_service_wal()
@@ -610,6 +648,12 @@ class StudyScheduler:
             if self._draining and not _replay:
                 raise DrainingError("service is draining; not admitting "
                                     "new studies")
+            if (not _replay and self.fence is not None
+                    and not self.fence()):
+                # an admit journaled into a fenced epoch WAL would mint
+                # a study id no future owner ever learns about
+                raise StaleOwnershipError(
+                    "shard lease lost; study admission refused")
             live = sum(1 for s in self._studies.values()
                        if s.state == "active")
             if live >= self.max_studies and not _replay:
@@ -645,6 +689,9 @@ class StudyScheduler:
         weight for every future replay."""
         with self._lock:
             st = self._get(study_id)
+            if self.fence is not None and not self.fence():
+                raise StaleOwnershipError(
+                    f"{study_id}: shard lease lost; close refused")
             st.state = "closed"
             trace = reqtrace.current_trace_id()
             if self.journal is not None:
@@ -722,15 +769,36 @@ class StudyScheduler:
 
     # -- ask / tell --------------------------------------------------------
 
-    def _prepare_ask(self, st, n, deadline=None):
+    def _prepare_ask(self, st, n, deadline=None, req_id=None):
         """Draw ids + seed for one ask, exactly as ``FMinIter`` would.
         Returns finished docs (startup random search, served inline) or an
-        :class:`_AskReq` awaiting a cohort tick."""
+        :class:`_AskReq` awaiting a cohort tick.
+
+        ``req_id`` is the client's idempotency token: a retried ask
+        whose first attempt was served (response lost to a crash or
+        dropped connection) answers the SAME trials — checked before
+        anything else, state and quotas included, because the original
+        ask already passed them and may even have finished the study."""
+        if req_id is not None:
+            tids = st.served_reqs.get(str(req_id))
+            if tids is not None:
+                by_tid = {d["tid"]: d
+                          for d in st.trials._dynamic_trials}
+                docs = [by_tid[t] for t in tids if t in by_tid]
+                if len(docs) == len(tids):
+                    self.metrics.counter(
+                        "service.asks_deduped").inc(len(tids))
+                    st.note("ask_dedupe", tids=tids,
+                            trace=reqtrace.current_trace_id())
+                    return docs
         if st.state != "active":
             raise UnknownStudyError(f"{st.study_id} is {st.state}")
         if self._draining:
             raise DrainingError("service is draining; not admitting "
                                 "new asks")
+        if self.fence is not None and not self.fence():
+            raise StaleOwnershipError(
+                f"{st.study_id}: shard lease lost; ask refused")
         n = int(n)
         if n < 1:
             raise ValueError("ask n must be >= 1")
@@ -754,7 +822,8 @@ class StudyScheduler:
             journaled = False
             try:
                 docs = rand.suggest(new_ids, st.domain, st.trials, seed)
-                self._journal_ask(st, new_ids, seed, "rand", trace=trace)
+                self._journal_ask(st, new_ids, seed, "rand", trace=trace,
+                                  req=req_id)
                 journaled = True
                 self._land(st, docs)
                 if self.journal is not None:
@@ -767,17 +836,20 @@ class StudyScheduler:
                     # already accounts for the draw — never void twice)
                     self._journal_void_ask(st, new_ids, seed, trace=trace)
                 raise
+            st.remember_req(req_id, new_ids)
             st.note("ask", tids=[int(t) for t in new_ids], algo="rand",
                     startup=True, trace=trace)
             return docs
-        return _AskReq(st, new_ids, seed, deadline=deadline, trace=trace)
+        return _AskReq(st, new_ids, seed, deadline=deadline, trace=trace,
+                       req=req_id)
 
-    def _journal_ask(self, st, new_ids, seed, algo, trace=None):
-        """WAL the served ask (ids + seed + serving algo) BEFORE its docs
-        land — crash-ordering argument in ``journal.py``."""
+    def _journal_ask(self, st, new_ids, seed, algo, trace=None, req=None):
+        """WAL the served ask (ids + seed + serving algo + idempotency
+        token) BEFORE its docs land — crash-ordering argument in
+        ``journal.py``."""
         if self.journal is not None:
             self.journal.append(StudyJournal.ask_rec(
-                st.study_id, new_ids, seed, algo, trace=trace))
+                st.study_id, new_ids, seed, algo, trace=trace, req=req))
 
     def _journal_void_ask(self, st, new_ids, seed, trace=None,
                           reason=None):
@@ -839,9 +911,10 @@ class StudyScheduler:
         already in the WAL and must not journal twice."""
         if not r.replay:
             self._journal_ask(r.study, r.new_ids, r.seed, r.algo,
-                              trace=r.trace)
+                              trace=r.trace, req=r.req)
             r.journaled = True
         self._land(r.study, docs)
+        r.study.remember_req(r.req, r.new_ids)
         r.docs = docs
         r.study.note("ask", tids=[int(t) for t in r.new_ids], algo=r.algo,
                      wave=r.wave, trace=r.trace,
@@ -982,6 +1055,16 @@ class StudyScheduler:
         from ..parallel import sharding as _sh
 
         t_wave = time.perf_counter()
+        if self.fence is not None and not self.fence():
+            # the lease died while this wave queued: refuse it BEFORE
+            # any journal append or doc landing — the seeds drawn stay
+            # in-memory only, so the new owner's replayed stream never
+            # diverges (clients retry against it with their req tokens)
+            err = StaleOwnershipError("shard lease lost; wave refused")
+            for r in reqs:
+                if r.docs is None and r.error is None:
+                    r.error = err
+            return
         wave_faults = 0
         served_any = False
         self.evict_idle()
@@ -1063,21 +1146,24 @@ class StudyScheduler:
         self.metrics.gauge("service.slot_utilization").set(
             self.slot_utilization())
 
-    def ask(self, study_id, n=1, deadline=None):
+    def ask(self, study_id, n=1, deadline=None, req_id=None):
         """Propose ``n`` new trials for one study.  Concurrent callers
         coalesce: the first thread to reach a quiescent scheduler becomes
         the wave ticker and serves every enqueued ask in one batched
         device tick per cohort.  ``deadline`` (an
         :class:`~hyperopt_tpu.service.overload.Deadline`) sheds the ask
         while it is still QUEUED once expired — a req already inside a
-        wave completes and answers (the work is done and journaled)."""
+        wave completes and answers (the work is done and journaled).
+        ``req_id`` makes the ask idempotent across client retries (see
+        :meth:`_prepare_ask`)."""
         chaos.point("ask", self.metrics)
         t0 = time.perf_counter()
         if deadline is not None:
             deadline.check("ask")
         with self._cond:
             st = self._get(study_id)
-            res = self._prepare_ask(st, n, deadline=deadline)
+            res = self._prepare_ask(st, n, deadline=deadline,
+                                    req_id=req_id)
             if not isinstance(res, _AskReq):  # startup random search
                 self.metrics.histogram("service.ask_sec").observe(
                     time.perf_counter() - t0)
@@ -1121,10 +1207,13 @@ class StudyScheduler:
                 # the window before the void record lands, making
                 # replay draw the failed seed twice
                 req.study.n_asked -= len(req.new_ids)
-                if not req.journaled:
+                if not req.journaled and not isinstance(
+                        req.error, StaleOwnershipError):
                     # the void note names a deadline shed explicitly —
                     # ONE timeline event per failed/shed ask, matching
-                    # the single WAL void record
+                    # the single WAL void record.  A FENCED req never
+                    # voids: its journal is dead to every future
+                    # replay, and the burned draw was in-memory only
                     self._journal_void_ask(
                         req.study, req.new_ids, req.seed,
                         trace=req.trace,
@@ -1168,7 +1257,8 @@ class StudyScheduler:
                     # release the failed req's pending quota, else
                     # repeated failures wedge the study at 429
                     r.study.n_asked -= len(r.new_ids)
-                    if not r.journaled:
+                    if not r.journaled and not isinstance(
+                            r.error, StaleOwnershipError):
                         self._journal_void_ask(r.study, r.new_ids, r.seed,
                                                trace=r.trace)
                     failed.append(r)
@@ -1196,9 +1286,25 @@ class StudyScheduler:
         chaos.point("tell", self.metrics)
         with self._lock:
             st = self._get(study_id)
+            if self.fence is not None and not self.fence():
+                raise StaleOwnershipError(
+                    f"{study_id}: shard lease lost; tell refused")
             tid = int(tid)
             doc = next((d for d in st.trials._dynamic_trials
                         if d["tid"] == tid), None)
+            if (doc is None and self.fence is not None
+                    and getattr(st.trials, "store", None) is not None):
+                # miss-path fallback, FLEET MODE ONLY (the fence is the
+                # fleet marker): the doc may have landed in the shared
+                # store a heartbeat before this owner's adoption scan —
+                # one full rescan before 404ing a tell the client was
+                # legitimately answered for.  Single-server mode keeps
+                # the cheap 404 (no migration can race there, and a
+                # hostile unknown-tid tell must not buy an O(files)
+                # unpickling rescan under the scheduler lock).
+                st.trials.refresh()
+                doc = next((d for d in st.trials._dynamic_trials
+                            if d["tid"] == tid), None)
             if doc is None:
                 raise UnknownStudyError(
                     f"{study_id}: no trial with tid {tid}")
@@ -1263,15 +1369,26 @@ class StudyScheduler:
             return space_from_spec(spec["space"])
         return None
 
-    def resume(self):
-        """Replay the WAL into this (fresh) scheduler: re-admit every
+    def resume(self, source=None):
+        """Replay a WAL into this (fresh) scheduler: re-admit every
         journaled study, advance each seed stream draw-for-draw, re-land
         any doc the store does not already hold (regenerated through the
         same serving path — bit-identical by the PR-9 determinism pins)
         and re-apply un-settled tells idempotently.  Returns a stats
         dict (also kept as ``last_resume``); None when no WAL is armed.
-        Safe on an empty/missing journal (no-op stats)."""
-        if self.journal is None:
+        Safe on an empty/missing journal (no-op stats).
+
+        ``source`` replays SOMEONE ELSE'S journal (a
+        :class:`~hyperopt_tpu.service.journal.StudyJournal`) while this
+        scheduler's own WAL stays the append/compaction target — the
+        fleet's shard-migration path (ISSUE 12): an adopting replica
+        replays the dead owner's shard-epoch WAL chain here, oldest
+        epoch first.  Sequential calls compose: records are idempotent
+        and an epoch-head ``snapshot`` for a study an earlier epoch
+        already rebuilt is a no-op skip (by the determinism pins, the
+        replayed state IS the snapshotted state)."""
+        journal = self.journal if source is None else source
+        if journal is None:
             return None
         t0 = time.perf_counter()
         stats = {"studies": 0, "asks": 0, "regenerated": 0, "tells": 0,
@@ -1283,7 +1400,7 @@ class StudyScheduler:
         # allocator must stay past them, exactly as the live run's did)
         self._replay_ctx = {"told": set(), "void_max": {}}
         with self._lock:
-            for rec in self.journal.records():
+            for rec in journal.records():
                 try:
                     self._replay_record(rec, stats)
                 except Exception as e:  # noqa: BLE001 - per-record isolation
@@ -1356,6 +1473,8 @@ class StudyScheduler:
                 st.n_asked = int(rec.get("n_asked", 0))
                 st.n_told = int(rec.get("n_told", 0))
                 st.state = rec.get("state", "active")
+                for rid, tids in (rec.get("served") or {}).items():
+                    st.remember_req(rid, tids)
             stats["studies"] += 1
             return
         st = self._studies.get(sid)
@@ -1383,6 +1502,11 @@ class StudyScheduler:
                         self._replay_ctx["void_max"].get(sid, -1))
                 return
             st.n_asked += len(tids)
+            # the idempotency map replays with the record: a client
+            # whose ask response died with the old process retries
+            # against the resumed/migrated study and must get the SAME
+            # tids, not a fresh draw
+            st.remember_req(rec.get("req"), tids)
             existing = {d["tid"] for d in st.trials._dynamic_trials}
             if all(t in existing for t in tids):
                 stats["asks"] += 1

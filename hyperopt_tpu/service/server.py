@@ -19,6 +19,10 @@ same ``ThreadingHTTPServer`` + daemon-thread shape, now serving
 * ``GET /study/<id>/timeline`` — the study's live audit timeline
   (ISSUE 11): admit, every ask (wave/algo/degrade/trace), every tell,
   shed/void, evict/re-admit, crash-resume boundary.
+* ``GET /healthz`` — machine-readable replica health (ISSUE 12):
+  replica id, held shard leases + epochs, drain state, WAL sync
+  health; the rolling-restart script and ``obs/top.py``'s FLEET row
+  consume it.
 * ``GET /metrics`` / ``GET /snapshot`` — the obs integration:
   Prometheus exposition of every registry namespace (the ``service.*``
   family and the ``slo_*`` error-budget gauges ride along) and a JSON
@@ -53,12 +57,21 @@ deadline cannot cover the predicted wait — the server sheds with 429
 instead of queuing unboundedly.  Tells shed only at 4x the ask bound
 (they are cheap and preserve client work).
 
+Fleet mode (ISSUE 12): ``--fleet`` (with ``--store``) joins the
+replicated serving fleet — N replicas over one store root partition
+the study keyspace into leased study-shards (``service/fleet.py``),
+each served by its own scheduler + shard-epoch WAL; a study owned by
+another replica answers **307** with the owner's advertised address
+(``Location`` header + JSON ``location``), which ``ServiceClient``
+follows transparently.  Single-scheduler mode is byte-for-byte the
+pre-fleet path.
+
 Arming: ``python -m hyperopt_tpu.service.server [--port P]`` (or
 ``HYPEROPT_TPU_SERVICE=<port>`` with no ``--port``); ``--port 0`` binds
 an ephemeral port and ``--announce`` prints ``SERVICE_URL <url>`` for
 harnesses (``scripts/service_smoke.py``).  SIGTERM drains gracefully:
-stop admitting, finish in-flight waves, compact + close the WAL, exit
-0.
+stop admitting, finish in-flight waves, compact + close the WAL (fleet
+mode: hand off every held shard so survivors adopt it), exit 0.
 """
 
 from __future__ import annotations
@@ -71,8 +84,10 @@ import time
 from ..obs import reqtrace
 from ..obs.serve import prometheus_text, split_hostport
 from ..obs.trace import JsonlSink, Tracer
+from .fleet import ShardNotOwned, ShardUnavailable
 from .overload import AdmissionGuard, Deadline, OverloadError
-from .scheduler import (DrainingError, DuplicateTellError, StudyQuotaError,
+from .scheduler import (DrainingError, DuplicateTellError,
+                        StaleOwnershipError, StudyQuotaError,
                         StudyScheduler, UnknownStudyError)
 from .spacespec import SpaceSpecError, space_from_spec
 
@@ -111,9 +126,11 @@ class ServiceHTTPServer:
     raising, ``stop()`` is idempotent."""
 
     def __init__(self, port, scheduler=None, host=None, store_root=None,
-                 guard=None, trace=None, slo=None, access_log=None):
+                 guard=None, trace=None, slo=None, access_log=None,
+                 fleet=None):
         from .._env import (parse_reqtrace, parse_service_access_log,
                             parse_service_deadline_ms, parse_service_slo)
+        from ..obs.metrics import get_metrics
 
         try:
             if host is None:
@@ -122,13 +139,28 @@ class ServiceHTTPServer:
         except (TypeError, ValueError):
             self.port = None  # start() warns and fails open
         self.host = host or "127.0.0.1"
-        self.scheduler = scheduler if scheduler is not None else (
-            StudyScheduler(store_root=store_root, wave_window=0.005))
+        # fleet mode (ISSUE 12): a FleetReplica owns one scheduler per
+        # held study-shard; study-scoped requests route through it (a
+        # shard owned elsewhere answers 307 + the owner's address).
+        # Single-scheduler mode is byte-for-byte the pre-fleet path.
+        self.fleet = fleet
+        if fleet is not None:
+            self.scheduler = None
+            self.metrics = fleet.metrics
+        else:
+            self.scheduler = scheduler if scheduler is not None else (
+                StudyScheduler(store_root=store_root, wave_window=0.005))
+            self.metrics = self.scheduler.metrics
         self.guard = (guard if guard is not None
-                      else AdmissionGuard(metrics=self.scheduler.metrics))
-        if self.scheduler.overload is None:
-            # the scheduler feeds the guard its wave latencies — that
-            # EWMA is what sizes every Retry-After hint
+                      else AdmissionGuard(metrics=self.metrics))
+        if fleet is not None:
+            # every adopted shard's scheduler feeds the one guard its
+            # wave latencies — that EWMA sizes every Retry-After hint
+            fleet.overload = self.guard
+            for sched in fleet.schedulers.values():
+                if sched.overload is None:
+                    sched.overload = self.guard
+        elif self.scheduler.overload is None:
             self.scheduler.overload = self.guard
         self.default_deadline_ms = parse_service_deadline_ms()
         # request-trace plane (ISSUE 11): parse/mint/echo/stamp trace
@@ -147,7 +179,7 @@ class ServiceHTTPServer:
                 from ..obs.slo import SLOPlane
 
                 self.slo = SLOPlane(targets,
-                                    metrics=self.scheduler.metrics,
+                                    metrics=self.metrics,
                                     escalation=self._slo_escalation)
         # opt-in structured access log (JSONL; one record per request)
         log_path = (parse_service_access_log() if access_log is None
@@ -299,7 +331,7 @@ class ServiceHTTPServer:
         rest pooled (an attacker probing random paths must not mint
         unbounded metric families)."""
         known = ("/study", "/ask", "/tell", "/close", "/studies",
-                 "/metrics", "/snapshot", "/")
+                 "/metrics", "/snapshot", "/healthz", "/")
         if path in known:
             return path.strip("/") or "root"
         if _timeline_study_id(path) is not None:
@@ -309,8 +341,7 @@ class ServiceHTTPServer:
     def _count_response(self, method, path, status):
         ep = self._endpoint_label(method, path)
         cls = int(status) // 100
-        self.scheduler.metrics.counter(
-            f"service.http.{ep}.{cls}xx").inc()
+        self.metrics.counter(f"service.http.{ep}.{cls}xx").inc()
 
     def _record_failure(self, method, path, exc):
         """A handler exception became a 500: record it in the flight
@@ -326,17 +357,55 @@ class ServiceHTTPServer:
         except Exception:  # noqa: BLE001 - forensics must never cascade
             pass
 
-    def _handle(self, method, path, body, headers):
+    def _route(self, study_id):
+        """The scheduler serving ``study_id`` — always ``self.scheduler``
+        in single-server mode; in fleet mode the replica's routing table
+        (which raises :class:`ShardNotOwned` → 307 with the owner's
+        address, or :class:`ShardUnavailable` → retryable 503)."""
+        if self.fleet is None:
+            return self.scheduler
+        return self.fleet.scheduler_for(study_id)
+
+    def healthz_dict(self):
+        """``GET /healthz``: replica identity, held shard leases +
+        epochs, drain state and WAL sync health — machine-readable (the
+        rolling-restart script and ``obs/top.py``'s FLEET row consume
+        it).  Single-server mode reports the same shape with no shard
+        table."""
+        if self.fleet is not None:
+            return self.fleet.healthz()
         sched = self.scheduler
+        out = {"ok": True, "replica": None, "addr": self.url,
+               "n_shards": None, "shards_held": [], "shards": {},
+               "draining": sched._draining,
+               "wal_sync_errors": self.metrics.counter(
+                   "service.wal.sync_errors").value,
+               "ts": time.time()}
+        if sched.journal is not None:
+            out["wal"] = {"path": sched.journal.path,
+                          "appends": sched.journal.appends,
+                          "syncs": sched.journal.syncs,
+                          "compactions": sched.journal.compactions}
+        out["ok"] = not sched._draining
+        return out
+
+    def _studies_status(self):
+        if self.fleet is not None:
+            return self.fleet.studies_status()
+        return self.scheduler.studies_status()
+
+    def _handle(self, method, path, body, headers):
         try:
             if method == "GET":
                 if path == "/studies":
-                    return 200, sched.studies_status()
+                    return 200, self._studies_status()
+                if path == "/healthz":
+                    return 200, self.healthz_dict()
                 if path == "/snapshot":
                     return 200, self.snapshot_dict()
                 sid = _timeline_study_id(path)
                 if sid is not None:
-                    return 200, sched.study_timeline(sid)
+                    return 200, self._route(sid).study_timeline(sid)
                 if path == "/":
                     return 200, {
                         "ok": True,
@@ -344,6 +413,7 @@ class ServiceHTTPServer:
                                       "POST /tell", "POST /close",
                                       "GET /studies",
                                       "GET /study/<id>/timeline",
+                                      "GET /healthz",
                                       "GET /metrics", "GET /snapshot"]}
                 raise _RequestError(404, f"no such endpoint: {path}")
             if method != "POST":
@@ -352,12 +422,22 @@ class ServiceHTTPServer:
                 return 200, self._create_study(body)
             if path == "/ask":
                 study_id = self._required(body, "study_id")
+                sched = self._route(study_id)
                 n = int(body.get("n", 1))
+                # the client's ask-idempotency token (ISSUE 12): a
+                # retried ask answers the originally served trials.
+                # Sanitized like X-Request-Id — a hostile value must
+                # not become an unbounded-key or log-injection vector
+                req_id = body.get("req")
+                if not isinstance(req_id, str) or not req_id \
+                        or len(req_id) > 200:
+                    req_id = None
                 deadline = Deadline.from_request(
                     headers.get("x-deadline-ms"), self.default_deadline_ms)
                 token = self.guard.admit_ask(deadline)
                 try:
-                    trials = sched.ask(study_id, n, deadline=deadline)
+                    trials = sched.ask(study_id, n, deadline=deadline,
+                                       req_id=req_id)
                 finally:
                     self.guard.release(token)
                 out = {"ok": True, "study_id": study_id,
@@ -370,6 +450,7 @@ class ServiceHTTPServer:
                 return 200, out
             if path == "/tell":
                 study_id = self._required(body, "study_id")
+                sched = self._route(study_id)
                 token = self.guard.admit_tell()
                 try:
                     results = body.get("results")
@@ -403,11 +484,28 @@ class ServiceHTTPServer:
                              "told": told, "duplicates": dups}
             if path == "/close":
                 study_id = self._required(body, "study_id")
-                sched.close_study(study_id)
+                self._route(study_id).close_study(study_id)
                 return 200, {"ok": True, "study_id": study_id}
             raise _RequestError(404, f"no such endpoint: {path}")
         except _RequestError as e:
             return e.status, {"ok": False, "error": str(e)}
+        except ShardNotOwned as e:
+            # 307: the study's shard is served by another replica; the
+            # HTTP layer emits Location and the client re-issues the
+            # SAME method+body there (bounded hop count client-side)
+            return 307, {"ok": False, "error": str(e),
+                         "location": e.location}
+        except ShardUnavailable as e:
+            # the owner died and no survivor adopted the shard yet (or
+            # the fleet is rebalancing): retryable, like draining
+            return 503, {"ok": False, "error": str(e),
+                         "retry_after": e.retry_after}
+        except StaleOwnershipError as e:
+            # this replica lost the shard's lease at the durability
+            # fence: nothing landed; the retry meets the ownership
+            # table (and its 307) once the new owner publishes
+            return 503, {"ok": False, "error": str(e),
+                         "retry_after": 0.25}
         except UnknownStudyError as e:
             return 404, {"ok": False, "error": str(e)}
         except DuplicateTellError as e:
@@ -462,6 +560,15 @@ class ServiceHTTPServer:
         kwargs = {k: body[k] for k in _STUDY_KWARGS if k in body}
         # the wire schema IS the WAL registry entry: every HTTP-created
         # study is crash-resumable
+        if self.fleet is not None:
+            # fleet placement: mint an id landing in a held shard (ids
+            # are server-minted, so creation cannot redirect) — the id
+            # already claimed its store subdirectory atomically
+            study_id, sched = self.fleet.place_study()
+            sched.create_study(space, seed=int(body.get("seed", 0)),
+                               study_id=study_id, space_spec=space_spec,
+                               **kwargs)
+            return {"ok": True, "study_id": study_id}
         study_id = self.scheduler.create_study(
             space, seed=int(body.get("seed", 0)), space_spec=space_spec,
             **kwargs)
@@ -478,8 +585,10 @@ class ServiceHTTPServer:
         if self.slo is not None:
             out["slo"] = self.slo.publish()  # refresh gauges on scrape
         out["sections"] = {
-            "service": self.scheduler.metrics.snapshot()["metrics"]}
-        status = self.scheduler.studies_status()
+            "service": self.metrics.snapshot()["metrics"]}
+        status = self._studies_status()
+        if "fleet" in status:
+            out["fleet"] = status["fleet"]
         out["studies"] = status["studies"]
         out["cohorts"] = status["cohorts"]
         out["slot_utilization"] = status["slot_utilization"]
@@ -530,9 +639,15 @@ class ServiceHTTPServer:
         """Graceful shutdown (the SIGTERM path): stop admitting (new
         studies and asks answer 503/``DrainingError`` immediately, tells
         keep landing), wait for in-flight waves to finish, compact +
-        close the WAL, then stop serving.  Returns True when the
-        scheduler quiesced within ``timeout``."""
-        quiesced = self.scheduler.drain(timeout=timeout)
+        close the WAL, then stop serving.  In fleet mode every held
+        shard is handed off first (lease released, ownership entry
+        cleared) so a survivor adopts it — the rolling-restart
+        zero-lost-tells path.  Returns True when everything quiesced
+        within ``timeout``."""
+        if self.fleet is not None:
+            quiesced = self.fleet.drain(timeout=timeout)
+        else:
+            quiesced = self.scheduler.drain(timeout=timeout)
         self.stop()
         return quiesced
 
@@ -570,6 +685,12 @@ def _make_handler(server):
             if isinstance(payload, dict) and payload.get("request_id"):
                 self.send_header("X-Request-Id",
                                  str(payload["request_id"]))
+            if (status == 307 and isinstance(payload, dict)
+                    and payload.get("location")):
+                # fleet redirect: the owner's advertised address.  The
+                # JSON body carries it too (service/client.py reads the
+                # payload; standard HTTP clients follow the header)
+                self.send_header("Location", str(payload["location"]))
             if (status in (429, 503) and isinstance(payload, dict)
                     and payload.get("retry_after") is not None):
                 # RFC 7231 delta-seconds is an INTEGER — a fractional
@@ -666,6 +787,23 @@ def main(argv=None):
                    help="write-ahead journal: 'auto' (default — under "
                         "--store when given), 'off', or an explicit path "
                         "(default: $HYPEROPT_TPU_SERVICE_WAL)")
+    p.add_argument("--fleet", action="store_true",
+                   help="join the replicated serving fleet on --store: "
+                        "lease-partitioned study shards, per-shard epoch "
+                        "WALs, 307 routing (requires --store)")
+    p.add_argument("--fleet-shards", type=int, default=None,
+                   help="study-shard count (write-once per store root; "
+                        "default: $HYPEROPT_TPU_FLEET_SHARDS or 8)")
+    p.add_argument("--replica-id", default=None,
+                   help="this replica's fleet identity (default: "
+                        "<hostname>-<pid>)")
+    p.add_argument("--addr", default=None,
+                   help="the URL this replica advertises in the fleet "
+                        "ownership table (default: $HYPEROPT_TPU_FLEET_ADDR "
+                        "or the bound URL)")
+    p.add_argument("--lease-ttl", type=float, default=None,
+                   help="shard-lease reclaim TTL in seconds (default: "
+                        "$HYPEROPT_TPU_FLEET_LEASE_TTL or 15)")
     p.add_argument("--announce", action="store_true",
                    help="print 'SERVICE_URL <url>' once bound (harness "
                         "handshake)")
@@ -685,15 +823,46 @@ def main(argv=None):
             wal = False
         else:
             wal = args.wal
-    sched = StudyScheduler(max_studies=args.max_studies,
-                           max_pending=args.max_pending,
-                           idle_sec=args.idle_sec,
-                           store_root=args.store,
-                           wal=wal,
-                           wave_window=0.005)
-    server = ServiceHTTPServer(port, scheduler=sched)
-    if not server.start():
-        return 1
+    if args.fleet:
+        if not args.store:
+            p.error("--fleet needs --store (the shared FileStore root is "
+                    "the fleet's coordination plane)")
+        if args.wal is not None:
+            # fleet mode journals per (shard, epoch) by construction —
+            # a --wal value would be silently discarded otherwise
+            p.error("--wal does not compose with --fleet: each shard "
+                    "journals to its own epoch WAL under "
+                    "<store>/fleet/wal/")
+        from .._env import parse_fleet_addr
+        from .fleet import FleetReplica
+
+        replica = FleetReplica(
+            args.store, n_shards=args.fleet_shards,
+            replica_id=args.replica_id, lease_ttl=args.lease_ttl,
+            scheduler_kwargs={
+                "max_studies": args.max_studies,
+                "max_pending": args.max_pending,
+                "idle_sec": args.idle_sec,
+                "wave_window": 0.005,
+            })
+        server = ServiceHTTPServer(port, fleet=replica)
+        if not server.start():
+            return 1
+        # advertise AFTER the bind: an ephemeral --port 0 has no address
+        # until now.  Claims happen after set_addr so every published
+        # ownership entry routes 307s somewhere reachable.
+        replica.set_addr(args.addr or parse_fleet_addr() or server.url)
+        replica.start()
+    else:
+        sched = StudyScheduler(max_studies=args.max_studies,
+                               max_pending=args.max_pending,
+                               idle_sec=args.idle_sec,
+                               store_root=args.store,
+                               wal=wal,
+                               wave_window=0.005)
+        server = ServiceHTTPServer(port, scheduler=sched)
+        if not server.start():
+            return 1
     if args.announce:
         print(f"SERVICE_URL {server.url}", flush=True)
 

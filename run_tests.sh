@@ -41,6 +41,16 @@
 # bit-identical to an undisturbed reference, 2x-capacity overload must
 # shed with 429/Retry-After and lose zero tells, and injected tick
 # faults must walk the degrade ladder without killing the server.
+# Opt-in fleet gate: FLEET_GATE=1 additionally re-runs the replicated-
+# serving-fleet suites (epoch leases incl. fake-clock reclaim races,
+# in-process migration determinism, 307 routing) and then
+# scripts/fleet_smoke.py — a real 3-subprocess-replica fleet over one
+# store root: SIGKILL one replica under concurrent ServiceClient
+# drivers (survivors reclaim its shard leases and adopt its studies by
+# epoch-WAL replay), then a scripted rolling restart of all replicas;
+# every study must finish bit-identical to the undisturbed
+# single-server reference with zero lost and zero duplicated tells and
+# bounded ask p99.
 # Opt-in SLO gate: SLO_GATE=1 additionally re-runs the request-trace /
 # SLO / timeline suites and then scripts/slo_smoke.py — a real
 # subprocess server with tracing + SLO + access log armed serves one
@@ -99,6 +109,12 @@ if [ "${SERVICE_CHAOS_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_journal.py tests/test_overload.py \
         tests/test_service.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/service_chaos_smoke.py || exit 1
+fi
+if [ "${FLEET_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_epoch_leases.py \
+        tests/test_service_fleet.py tests/test_membership.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
 fi
 if [ "${SLO_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

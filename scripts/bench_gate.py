@@ -84,6 +84,10 @@ DEFAULT_THRESHOLDS = {
     # shed fraction (a collapse toward zero = backpressure broke)
     "resume_latency_sec": 1.00,
     "shed_rate_frac": 0.60,
+    # replicated serving fleet (bench.py fleet_scale stage): throughput
+    # at the largest replica count, and the shard reclaim/adopt latency
+    "fleet_studies_per_sec": 0.35,
+    "reclaim_latency_sec": 1.00,
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
@@ -92,13 +96,14 @@ _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                  "peak_hbm_bytes", "history_bytes",
                  "studies_per_sec", "study_ask_p99_ms",
                  "slot_utilization_frac",
-                 "resume_latency_sec", "shed_rate_frac")
+                 "resume_latency_sec", "shed_rate_frac",
+                 "fleet_studies_per_sec", "reclaim_latency_sec")
 
 # latency and peak-memory metrics regress UPWARD
 LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                    "study_ask_p99_ms",
                    "peak_hbm_bytes", "history_bytes",
-                   "resume_latency_sec")
+                   "resume_latency_sec", "reclaim_latency_sec")
 
 
 def bench_files(root):

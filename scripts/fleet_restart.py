@@ -1,0 +1,179 @@
+"""Rolling restart of a replicated serving fleet with zero lost tells.
+
+For each replica IN TURN:
+
+1. **SIGTERM it** — the server drains: every held study-shard hands off
+   (in-flight waves finish, the shard's epoch WAL compacts to one
+   snapshot per live study, the ownership entry clears, the lease
+   releases) and the process exits 0.
+2. **Wait for coverage** — poll the REMAINING replicas' ``GET /healthz``
+   until their held-shard tables jointly cover the whole keyspace again
+   (survivors' stewards adopt the released shards by WAL replay;
+   clients meanwhile ride 307/503 + Retry-After, never a hard failure).
+3. **Relaunch** — run the replica's launch command again and wait for
+   the new process's ``/healthz`` to answer ``ok`` (its steward will be
+   volunteered shards back by the rebalance).
+
+Usage (one box; pids + healthz URLs + the relaunch command)::
+
+    python scripts/fleet_restart.py \
+        --replica 12345=http://127.0.0.1:9101 \
+        --replica 12346=http://127.0.0.1:9102 \
+        --relaunch 'python -m hyperopt_tpu.service.server --fleet \
+                    --store /srv/hpo --port {port}'
+
+``scripts/fleet_smoke.py`` drives :func:`restart_one` /
+:func:`wait_coverage` in-process with live client traffic running — the
+zero-lost-tells + bitwise-convergence assertions live there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+__all__ = ["fetch_healthz", "fleet_coverage", "wait_coverage",
+           "wait_exit", "restart_one", "main"]
+
+
+def fetch_healthz(url, timeout=3.0):
+    """``GET <url>/healthz`` → dict, or None (a dead replica is a
+    normal sight mid-restart, never an exception)."""
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def fleet_coverage(urls):
+    """``(held shards union, n_shards)`` across the live replicas at
+    ``urls`` (n_shards is None until any replica answers)."""
+    held = set()
+    n_shards = None
+    for url in urls:
+        h = fetch_healthz(url)
+        if not h:
+            continue
+        held.update(int(s) for s in h.get("shards_held") or [])
+        if h.get("n_shards"):
+            n_shards = int(h["n_shards"])
+    return held, n_shards
+
+
+def wait_coverage(urls, timeout=60.0, poll=0.2):
+    """Block until the replicas at ``urls`` jointly hold EVERY shard
+    (the handed-off/reclaimed keyspace is fully re-adopted).  Returns
+    True on success, False on timeout."""
+    deadline = time.monotonic() + float(timeout)
+    while time.monotonic() < deadline:
+        held, n_shards = fleet_coverage(urls)
+        if n_shards is not None and len(held) >= n_shards:
+            return True
+        time.sleep(poll)
+    return False
+
+
+def wait_exit(pid, timeout=60.0, poll=0.1):
+    """Wait for ``pid`` to exit.  Uses ``waitpid`` for our own children
+    (returns the exit code) and signal-0 polling for foreign pids
+    (returns None once gone).  False on timeout."""
+    deadline = time.monotonic() + float(timeout)
+    while time.monotonic() < deadline:
+        try:
+            got, status = os.waitpid(pid, os.WNOHANG)
+            if got == pid:
+                return os.waitstatus_to_exitcode(status)
+        except ChildProcessError:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return None  # foreign pid, gone
+        time.sleep(poll)
+    return False
+
+
+def restart_one(pid, url, other_urls, relaunch=None, timeout=120.0):
+    """One rolling-restart step: SIGTERM ``pid``, wait for its drain
+    exit, wait for the survivors at ``other_urls`` to cover the
+    keyspace, then run ``relaunch`` (a list/str command) and wait for
+    the reborn replica's healthz.  Returns the new Popen (or None
+    without ``relaunch``); raises on a step that never converged."""
+    os.kill(pid, signal.SIGTERM)
+    rc = wait_exit(pid, timeout=timeout)
+    if rc is False:
+        raise RuntimeError(f"replica pid {pid} ignored SIGTERM (drain "
+                           "hung)")
+    if rc not in (None, 0):
+        raise RuntimeError(f"replica pid {pid} drained with exit {rc}, "
+                           "want 0")
+    if other_urls and not wait_coverage(other_urls, timeout=timeout):
+        raise RuntimeError("survivors never re-adopted the drained "
+                           f"shards (urls: {other_urls})")
+    if relaunch is None:
+        return None
+    cmd = relaunch if isinstance(relaunch, (list, tuple)) else [
+        "sh", "-c", relaunch]
+    proc = subprocess.Popen(list(cmd))
+    deadline = time.monotonic() + float(timeout)
+    while time.monotonic() < deadline:
+        h = fetch_healthz(url)
+        if h and h.get("ok"):
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"relaunched replica exited {proc.returncode} before "
+                "its healthz answered")
+        time.sleep(0.2)
+    raise RuntimeError(f"relaunched replica at {url} never answered "
+                       "healthz")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python scripts/fleet_restart.py",
+        description="Rolling restart of serving-fleet replicas with "
+                    "handoff-verified zero-lost-tells ordering.")
+    p.add_argument("--replica", action="append", required=True,
+                   metavar="PID=URL",
+                   help="a replica's pid and healthz base URL "
+                        "(repeatable; restarted in the given order)")
+    p.add_argument("--relaunch", default=None,
+                   help="shell command to relaunch a replica "
+                        "({port} substituted from its URL); omit to "
+                        "only drain-and-redistribute")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-step convergence timeout (default 120s)")
+    args = p.parse_args(argv)
+
+    replicas = []
+    for spec in args.replica:
+        pid_s, _, url = spec.partition("=")
+        if not url:
+            p.error(f"--replica wants PID=URL, got {spec!r}")
+        replicas.append((int(pid_s), url.rstrip("/")))
+
+    for i, (pid, url) in enumerate(replicas):
+        others = [u for j, (_, u) in enumerate(replicas) if j != i]
+        relaunch = None
+        if args.relaunch:
+            port = url.rsplit(":", 1)[-1]
+            relaunch = args.relaunch.format(port=port)
+        print(f"fleet_restart: [{i + 1}/{len(replicas)}] draining pid "
+              f"{pid} ({url})", flush=True)
+        restart_one(pid, url, others, relaunch=relaunch,
+                    timeout=args.timeout)
+        print(f"fleet_restart: [{i + 1}/{len(replicas)}] done", flush=True)
+    print("fleet_restart: rolling restart complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

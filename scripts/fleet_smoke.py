@@ -1,0 +1,410 @@
+"""FLEET_GATE end-to-end smoke: a REAL 3-replica serving fleet over one
+shared store root — one replica SIGKILLed mid-wave under concurrent
+ServiceClient drivers, then a scripted rolling restart of every replica
+— with every study's final trial history bit-identical to an undisturbed
+single-server reference, zero lost and zero duplicated tells, and every
+ask served within a bounded retry window.
+
+What it pins (the replication contract no unit test can):
+
+* phase 1 — **SIGKILL one of three, fleet converges bitwise**: replica
+  r1 runs a deterministic chaos schedule (``kill@tick:8`` — SIGKILL
+  inside a cohort-tick dispatch: mid-wave, post-draw, pre-journal, the
+  window the WAL ordering argument covers).  Nine concurrent clients
+  (three homed on the doomed replica) ride through the death on the
+  client's 307/503/connection-error retry ladder while the survivors'
+  stewards reclaim the dead replica's shard leases (TTL expiry,
+  rename-first) and adopt its studies by epoch-WAL replay.  The dead
+  replica is NEVER restarted — the fleet absorbs it.  Every study's
+  full (tid, params) sequence must equal the undisturbed in-process
+  single-scheduler reference at the same seeds, every study must end
+  with exactly its budget of trials and zero pending (no tell lost,
+  none double-applied — a 409 on a retried tell counts as the dedupe
+  working), and the measured ask p99 must stay under the retry-window
+  bound.
+
+* phase 2 — **rolling restart, zero lost tells**: all three replicas
+  are restarted IN TURN through ``scripts/fleet_restart.py``'s
+  SIGTERM → drain-exit-0 → survivors-cover-keyspace → relaunch →
+  healthz-ok sequence, with client traffic running throughout.  Same
+  bitwise + zero-lost/zero-duplicate assertions at the end.
+
+Opt in via ``FLEET_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+from fleet_restart import fetch_healthz, wait_coverage, wait_exit  # noqa: E402
+
+N_SHARDS = 6
+LEASE_TTL = 2.0
+
+
+def _env(chaos=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HYPEROPT_TPU_CHAOS", None)
+    if chaos:
+        env["HYPEROPT_TPU_CHAOS"] = chaos
+    return env
+
+
+def _launch(store, rid, port="0", chaos=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--announce", "--port", str(port), "--store", store,
+         "--fleet", "--fleet-shards", str(N_SHARDS),
+         "--lease-ttl", str(LEASE_TTL), "--replica-id", rid],
+        cwd=_REPO, env=_env(chaos=chaos), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 180
+    url = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SERVICE_URL "):
+            url = line.split(None, 1)[1].strip()
+            break
+        if proc.poll() is not None:
+            break
+    return proc, url
+
+
+def _loss(params, offset):
+    return float((params["x"] - offset) ** 2)
+
+
+def _offset(i, n):
+    return -4.0 + 8.0 * i / max(1, n - 1)
+
+
+def _reference_sequences(n_studies, budget, n_startup, seed0):
+    """Undisturbed in-process reference: same seeds, same serial
+    per-study ask→tell order, single scheduler, no store, no fleet."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.service import StudyScheduler
+
+    space = {"x": hp.uniform("x", -5, 5)}
+    ref = {}
+    for i in range(n_studies):
+        sched = StudyScheduler(wal=False, max_studies=64)
+        sid = sched.create_study(space, seed=seed0 + i,
+                                 n_startup_jobs=n_startup)
+        seq = []
+        for _ in range(budget):
+            a = sched.ask(sid)[0]
+            sched.tell(sid, a["tid"], _loss(a["params"], _offset(i, n_studies)))
+            seq.append((a["tid"], repr(a["params"]["x"])))
+        ref[i] = seq
+    return ref
+
+
+class _Driver(threading.Thread):
+    """One study's client: create → budget x (ask → tell), riding every
+    fleet event (307, 503, connection error, Retry-After) on the
+    client's deterministic retry ladder.  Records the (tid, params)
+    sequence, per-ask wall latencies and duplicate-tell count."""
+
+    def __init__(self, i, n_studies, urls, budget, n_startup, seed0):
+        super().__init__()
+        self.i = i
+        self.n = n_studies
+        self.urls = urls
+        self.budget = budget
+        self.n_startup = n_startup
+        self.seed0 = seed0
+        self.seq = None
+        self.study_id = None
+        self.ask_sec = []
+        self.duplicates = 0
+        self.error = None
+
+    def run(self):
+        from hyperopt_tpu.retry import RetryPolicy
+        from hyperopt_tpu.service import ServiceClient
+
+        # home each driver on a different replica; generous budget so a
+        # client rides TTL expiry + WAL replay + XLA compile on adopt
+        seeds = self.urls[self.i % len(self.urls):] \
+            + self.urls[:self.i % len(self.urls)]
+        client = ServiceClient(
+            seeds, key=self.i, timeout=60,
+            retry=RetryPolicy(max_retries=80, base_delay=0.2,
+                              max_delay=2.0))
+        spec = {"x": {"dist": "uniform", "args": [-5, 5]}}
+        try:
+            sid = client.create_study(
+                space=spec, seed=self.seed0 + self.i,
+                n_startup_jobs=self.n_startup, max_trials=self.budget)
+            seq = []
+            for _ in range(self.budget):
+                t0 = time.perf_counter()
+                t = client.ask(sid)[0]
+                self.ask_sec.append(time.perf_counter() - t0)
+                r = client.tell(sid, t["tid"],
+                                _loss(t["params"], _offset(self.i, self.n)))
+                if r.get("duplicate"):
+                    self.duplicates += 1
+                seq.append((t["tid"], repr(t["params"]["x"])))
+            self.seq = seq
+            self.study_id = sid
+        except Exception as e:  # noqa: BLE001
+            self.error = f"study {self.i}: {type(e).__name__}: {e}"
+
+
+def _merged_study_table(urls):
+    """Union of every live replica's /studies table (a study appears on
+    its current owner)."""
+    out = {}
+    for url in urls:
+        try:
+            with urllib.request.urlopen(url + "/studies", timeout=30) as r:
+                table = json.loads(r.read())
+        except Exception:  # noqa: BLE001 - dead replicas are expected
+            continue
+        for s in table.get("studies", []):
+            out[s["study_id"]] = s
+    return out
+
+
+def _store_counts(store, study_id):
+    """``(n_done, n_total)`` straight from the study's on-disk store —
+    the durable record a DONE study keeps after WAL compaction forgets
+    its registry entry (the documented ISSUE-10 bound)."""
+    import pickle
+
+    done = total = 0
+    root = os.path.join(store, study_id)
+    for state in ("new", "running", "done", "error", "cancel"):
+        d = os.path.join(root, state)
+        if not os.path.isdir(d):
+            continue
+        for fname in os.listdir(d):
+            if not fname.endswith(".pkl"):
+                continue
+            total += 1
+            if state == "done":
+                with open(os.path.join(d, fname), "rb") as f:
+                    doc = pickle.load(f)
+                if doc.get("result", {}).get("status") is not None:
+                    done += 1
+    return done, total
+
+
+def _check_results(drivers, ref, live_urls, budget, label, store):
+    """The acceptance bars shared by both phases: no client errors,
+    bitwise vs reference, zero pending / zero lost / zero duplicated
+    tells (live table for registered studies, the durable store for
+    DONE studies compaction already forgot), bounded p99."""
+    errors = [d.error for d in drivers if d.error]
+    if errors:
+        print(f"{label}: FAIL — client errors:", file=sys.stderr)
+        for e in errors[:10]:
+            print("  " + e, file=sys.stderr)
+        return False
+    bad = 0
+    for d in drivers:
+        if d.seq != ref[d.i]:
+            bad += 1
+            print(f"{label}: study {d.i} DIVERGED:\n  got  {d.seq}\n"
+                  f"  want {ref[d.i]}", file=sys.stderr)
+    if bad:
+        print(f"{label}: FAIL — {bad}/{len(drivers)} studies diverged "
+              "from the undisturbed reference", file=sys.stderr)
+        return False
+    table = _merged_study_table(live_urls)
+    lost = []
+    for d in drivers:
+        s = table.get(d.study_id)
+        if s is not None:
+            if s["n_trials"] != budget or s["n_pending"]:
+                lost.append((d.i, s["n_trials"], s["n_pending"]))
+        else:
+            # completed studies drop out of the registry at the next
+            # migration's compaction BY DESIGN; their trials are on disk
+            done, total = _store_counts(store, d.study_id)
+            if done != budget or total != budget:
+                lost.append((d.i, total, total - done))
+    if lost:
+        print(f"{label}: FAIL — {len(lost)} studies with lost or "
+              f"duplicated tells: {lost}", file=sys.stderr)
+        return False
+    lat = sorted(t for d in drivers for t in d.ask_sec)
+    p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+    dups = sum(d.duplicates for d in drivers)
+    # bounded: TTL expiry + steward poll + WAL replay + compile, well
+    # under the client's ~160s worst-case retry window
+    if p99 > 60.0:
+        print(f"{label}: FAIL — ask p99 {p99:.1f}s unbounded",
+              file=sys.stderr)
+        return False
+    print(f"{label}: ask p50 {lat[len(lat) // 2] * 1e3:.0f}ms "
+          f"p99 {p99 * 1e3:.0f}ms over {len(lat)} asks; "
+          f"{dups} duplicate-tell dedupes")
+    return True
+
+
+def phase1_sigkill():
+    print("fleet_smoke: phase 1 — SIGKILL one replica of three under "
+          "concurrent clients; fleet converges bitwise")
+    n_studies, budget, n_startup, seed0 = 9, 12, 3, 3000
+    ref = _reference_sequences(n_studies, budget, n_startup, seed0)
+
+    with tempfile.TemporaryDirectory() as store:
+        procs, urls = [], []
+        for i, chaos in enumerate([None, "11:kill@tick:8", None]):
+            proc, url = _launch(store, f"r{i}", chaos=chaos)
+            if url is None:
+                print(f"phase1: FAIL — replica r{i} never announced",
+                      file=sys.stderr)
+                return 1
+            procs.append(proc)
+            urls.append(url)
+        try:
+            if not wait_coverage(urls, timeout=60):
+                print("phase1: FAIL — fleet never covered the keyspace",
+                      file=sys.stderr)
+                return 1
+            drivers = [_Driver(i, n_studies, urls, budget, n_startup,
+                               seed0) for i in range(n_studies)]
+            for d in drivers:
+                d.start()
+            # supervise: the armed replica dies mid-wave; survivors
+            # absorb it — NO restart
+            deaths = 0
+            while any(d.is_alive() for d in drivers):
+                for i, proc in enumerate(procs):
+                    if proc is not None and proc.poll() is not None:
+                        deaths += 1
+                        print(f"phase1: replica r{i} died "
+                              f"(rc {proc.returncode}); survivors "
+                              "reclaim its shards", flush=True)
+                        procs[i] = None
+                time.sleep(0.1)
+            for d in drivers:
+                d.join()
+            if deaths != 1:
+                print(f"phase1: FAIL — expected exactly 1 chaos death, "
+                      f"saw {deaths}", file=sys.stderr)
+                return 1
+            live = [u for u, p in zip(urls, procs) if p is not None]
+            if not wait_coverage(live, timeout=60):
+                print("phase1: FAIL — survivors never re-covered the "
+                      "keyspace", file=sys.stderr)
+                return 1
+            if not _check_results(drivers, ref, live, budget, "phase1",
+                                  store):
+                return 1
+            # the survivors' healthz must show the adoption traffic
+            adopts = sum((fetch_healthz(u) or {}).get("adoptions", 0)
+                         for u in live)
+            print(f"phase1: PASS — {n_studies} studies x {budget} trials "
+                  f"bitwise-identical through 1 SIGKILL "
+                  f"({adopts} shard adoptions across survivors)")
+            return 0
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def phase2_rolling_restart():
+    print("fleet_smoke: phase 2 — scripted rolling restart of all "
+          "replicas under traffic; zero lost tells")
+    n_studies, budget, n_startup, seed0 = 6, 10, 3, 7000
+    ref = _reference_sequences(n_studies, budget, n_startup, seed0)
+
+    with tempfile.TemporaryDirectory() as store:
+        procs, urls = [], []
+        for i in range(3):
+            proc, url = _launch(store, f"s{i}")
+            if url is None:
+                print(f"phase2: FAIL — replica s{i} never announced",
+                      file=sys.stderr)
+                return 1
+            procs.append(proc)
+            urls.append(url)
+        try:
+            if not wait_coverage(urls, timeout=60):
+                print("phase2: FAIL — fleet never covered the keyspace",
+                      file=sys.stderr)
+                return 1
+            drivers = [_Driver(i, n_studies, urls, budget, n_startup,
+                               seed0) for i in range(n_studies)]
+            for d in drivers:
+                d.start()
+            time.sleep(1.0)  # let traffic build before the first drain
+            # the rolling restart: SIGTERM → drain exit 0 → survivors
+            # cover the keyspace → relaunch on the same port → healthz
+            # ok (scripts/fleet_restart.py's sequence, driven in-process
+            # so the relaunch can reuse _launch's announce handshake)
+            for i in range(3):
+                others = [u for j, u in enumerate(urls) if j != i]
+                procs[i].send_signal(signal.SIGTERM)
+                rc = wait_exit(procs[i].pid, timeout=90)
+                if rc not in (0, None):
+                    print(f"phase2: FAIL — replica s{i} drained with "
+                          f"exit {rc}, want 0", file=sys.stderr)
+                    return 1
+                if not wait_coverage(others, timeout=60):
+                    print("phase2: FAIL — survivors never re-adopted "
+                          f"s{i}'s shards", file=sys.stderr)
+                    return 1
+                port = urls[i].rsplit(":", 1)[1]
+                proc, url = _launch(store, f"s{i}", port=port)
+                if url is None:
+                    print(f"phase2: FAIL — relaunched s{i} never "
+                          "announced", file=sys.stderr)
+                    return 1
+                procs[i], urls[i] = proc, url
+                h = fetch_healthz(url)
+                if not (h and h.get("ok")):
+                    print(f"phase2: FAIL — relaunched s{i} healthz not "
+                          "ok", file=sys.stderr)
+                    return 1
+                print(f"phase2: restarted replica s{i} "
+                      f"({i + 1}/3)", flush=True)
+            for d in drivers:
+                d.join()
+            if not _check_results(drivers, ref, urls, budget, "phase2",
+                                  store):
+                return 1
+            handoffs = sum((fetch_healthz(u) or {}).get("handoffs", 0)
+                           for u in urls)
+            print(f"phase2: PASS — {n_studies} studies x {budget} trials "
+                  "bitwise-identical through a full rolling restart "
+                  f"(≥{handoffs} live handoffs visible post-restart)")
+            return 0
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def main():
+    for phase in (phase1_sigkill, phase2_rolling_restart):
+        rc = phase()
+        if rc:
+            return rc
+    print("fleet_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

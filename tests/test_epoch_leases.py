@@ -1,0 +1,168 @@
+"""Long-lived epoch-lease tests (ISSUE 12): claim exclusivity + epoch
+monotonicity, heartbeat/expiry/reclaim ordering under clock skew (fake
+clock via ``os.utime`` — lease age IS file mtime, exactly like
+tests/test_membership.py), two reclaimers racing, and the
+owner-verified heartbeat/release that fences a stalled holder.
+"""
+
+import os
+import time
+
+from hyperopt_tpu.obs.metrics import MetricsRegistry
+from hyperopt_tpu.parallel.membership import EpochLeases as _EpochLeases
+
+
+def EpochLeases(root, **kw):  # noqa: N802 - drop-in with isolated metrics
+    """The class under test, with a PRIVATE metrics registry per
+    instance: the default shares the process-global "fleet" namespace,
+    and these tests' reclaim/contention counts must not bleed into
+    tests/test_membership.py's exact-value assertions (or vice versa)."""
+    kw.setdefault("metrics", MetricsRegistry("epoch-leases-test"))
+    return _EpochLeases(root, **kw)
+
+
+def _age(leases, name, sec):
+    """Fake clock: push a lease's mtime ``sec`` seconds into the past
+    (clock skew between a holder and a reclaimer looks identical — the
+    reclaimer only ever sees the mtime)."""
+    path = leases._lease_path(name)
+    t = time.time() - sec
+    os.utime(path, (t, t))
+
+
+# ---------------------------------------------------------------------------
+# claims & epochs
+# ---------------------------------------------------------------------------
+
+
+def test_claim_is_exclusive_and_returns_epoch(tmp_path):
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=30)
+    b = EpochLeases(tmp_path, owner="b", lease_ttl=30)
+    assert a.try_claim("shard0000") == 1
+    assert b.try_claim("shard0000") is None  # exactly one winner
+    assert b.metrics.counter("lease.contention").value >= 1
+    assert a.holder("shard0000")["owner"] == "a"
+    assert a.holder("shard0000")["epoch"] == 1
+
+
+def test_epochs_strictly_monotonic_across_reclaim_cycles(tmp_path):
+    """Every claim bumps the durable counter — the fencing token the
+    (shard, epoch) WAL names depend on.  Release/reclaim/crash history
+    must never reuse an epoch."""
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="b", lease_ttl=5)
+    assert a.try_claim("s") == 1
+    assert a.release("s")
+    assert b.try_claim("s") == 2
+    _age(b, "s", 60)  # b dies
+    assert a.reclaim(["s"]) == ["s"]
+    assert a.try_claim("s") == 3
+    assert a.read_epoch("s") == 3
+
+
+def test_fresh_lease_not_reclaimed(tmp_path):
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=30)
+    b = EpochLeases(tmp_path, owner="b", lease_ttl=30)
+    assert a.try_claim("s") == 1
+    assert b.reclaim(["s"]) == []
+    assert b.try_claim("s") is None
+
+
+def test_stale_lease_reclaimed_then_claimable(tmp_path):
+    a = EpochLeases(tmp_path, owner="dead", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="live", lease_ttl=5)
+    assert a.try_claim("s") == 1
+    _age(a, "s", 60)  # heartbeats stopped long ago
+    assert b.reclaim(["s"]) == ["s"]
+    assert b.try_claim("s") == 2  # survivor takes over, epoch fenced up
+
+
+def test_reclaim_ordering_only_expired_leases(tmp_path):
+    """Clock-skew ordering: only the lease whose mtime aged past the
+    TTL is reclaimable; a fresh sibling survives the same sweep."""
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="b", lease_ttl=5)
+    assert a.try_claim("s0") == 1
+    assert a.try_claim("s1") == 1
+    _age(a, "s0", 60)  # only s0 expired
+    assert b.reclaim(["s0", "s1"]) == ["s0"]
+    assert b.try_claim("s0") == 2
+    assert b.try_claim("s1") is None  # fresh lease survives
+
+
+def test_heartbeat_defers_expiry(tmp_path):
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="b", lease_ttl=5)
+    assert a.try_claim("s") == 1
+    _age(a, "s", 60)
+    assert a.heartbeat("s")  # mtime -> NOW: the holder is alive
+    assert b.reclaim(["s"]) == []
+
+
+def test_two_reclaimers_race_single_winner(tmp_path):
+    """Rename-first claim-the-claim: two survivors sweeping the same
+    dead lease free it exactly once, and only one subsequent claim
+    wins the next epoch."""
+    a = EpochLeases(tmp_path, owner="dead", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="s1", lease_ttl=5)
+    c = EpochLeases(tmp_path, owner="s2", lease_ttl=5)
+    assert a.try_claim("s") == 1
+    _age(a, "s", 60)
+    freed = b.reclaim(["s"]) + c.reclaim(["s"])
+    assert freed == ["s"]
+    wins = [x.try_claim("s") for x in (b, c)]
+    assert sorted(w for w in wins if w is not None) == [2]
+
+
+# ---------------------------------------------------------------------------
+# owner-verified mutation (the stalled-holder fence)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_detects_loss_and_never_refreshes_the_new_owner(tmp_path):
+    """A holder that stalled past the TTL and was reclaimed must NOT
+    refresh (or free) the new owner's lease — the owner+epoch check
+    fences it out."""
+    a = EpochLeases(tmp_path, owner="stalled", lease_ttl=5)
+    b = EpochLeases(tmp_path, owner="survivor", lease_ttl=5)
+    assert a.try_claim("s") == 1
+    _age(a, "s", 60)
+    assert b.reclaim(["s"]) == ["s"]
+    assert b.try_claim("s") == 2
+    _age(b, "s", 60)  # even with b's lease stale...
+    assert not a.heartbeat("s")  # ...the stalled holder can't touch it
+    assert not a.verify_held("s")
+    assert not a.release("s")
+    assert b.holder("s")["owner"] == "survivor"
+    # and a no longer thinks it holds anything
+    assert a.held == {}
+
+
+def test_release_is_owner_verified(tmp_path):
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=30)
+    assert a.try_claim("s") == 1
+    assert a.release("s")
+    assert a.holder("s") is None
+    assert not a.release("s")  # idempotent: nothing held, nothing freed
+
+
+def test_unleased_lists_claimable_names(tmp_path):
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=30)
+    names = ["s0", "s1", "s2"]
+    assert a.unleased(names) == names
+    a.try_claim("s1")
+    assert a.unleased(names) == ["s0", "s2"]
+
+
+def test_torn_lease_body_is_not_a_holder(tmp_path):
+    """A crash between O_EXCL create and the body write leaves an empty
+    lease file: holder() answers None, verification fails, and the
+    reclaim path (after TTL) frees it like any other stale lease."""
+    a = EpochLeases(tmp_path, owner="a", lease_ttl=5)
+    with open(a._lease_path("s"), "w"):
+        pass  # empty claim, mid-crash artifact
+    assert a.holder("s") is None
+    assert not a.verify_held("s")
+    _age(a, "s", 60)
+    assert a.reclaim(["s"]) == ["s"]
+    assert a.try_claim("s") == 1

@@ -1,0 +1,459 @@
+"""Replicated serving fleet tests (ISSUE 12): the pinned study→shard
+map, collision-proof study-id minting, journal-compaction directory
+durability, in-process migration determinism through BOTH paths (drain
+handoff AND stale-lease reclaim — each bitwise vs the undisturbed
+single-scheduler reference), zombie-holder fencing, 307 routing over
+real HTTP with the client's bounded-hop redirect following, steward
+rebalance convergence, and the /healthz surface.
+"""
+
+import json
+import os
+import stat
+import time
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.filestore import new_run_id
+from hyperopt_tpu.service import (FleetReplica, ServiceClient,
+                                  ShardUnavailable, StudyScheduler,
+                                  shard_of)
+from hyperopt_tpu.service.client import ServiceUnavailable
+from hyperopt_tpu.service.journal import StudyJournal
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+def _replica(root, rid, n_shards=2, lease_ttl=5.0, **kw):
+    return FleetReplica(root, n_shards=n_shards, replica_id=rid,
+                        addr=f"http://{rid}", lease_ttl=lease_ttl,
+                        scheduler_kwargs={"wave_window": 0.0}, **kw)
+
+
+def _age_lease(replica, shard, sec=60.0):
+    path = replica.leases._lease_path(f"shard{shard:04d}")
+    t = time.time() - sec
+    os.utime(path, (t, t))
+
+
+def _kill(replica):
+    """The SIGKILL analog for an in-process replica: stop heartbeating
+    (age every lease + the member record); no drain, no compaction —
+    exactly what a killed process leaves behind."""
+    for shard in list(replica.schedulers):
+        _age_lease(replica, shard)
+    os.utime(replica._replica_path(), (time.time() - 600,) * 2)
+
+
+def _drive(server, sid, n, offset=0.0):
+    seq = []
+    for _ in range(n):
+        status, p = server.handle("POST", "/ask", {"study_id": sid})
+        assert status == 200, p
+        t = p["trials"][0]
+        status, p2 = server.handle("POST", "/tell", {
+            "study_id": sid, "tid": t["tid"],
+            "loss": float(t["params"]["x"] - offset) ** 2})
+        assert status == 200, p2
+        seq.append((t["tid"], repr(t["params"]["x"])))
+    return seq
+
+
+def _reference(seed, n, n_startup=2, offset=0.0):
+    sched = StudyScheduler(wal=False, max_studies=64)
+    sid = sched.create_study(SPACE, seed=seed, n_startup_jobs=n_startup)
+    seq = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], float(a["params"]["x"] - offset) ** 2)
+        seq.append((a["tid"], repr(a["params"]["x"])))
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# the study→shard map & id minting (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_of_is_pinned():
+    # literal pins: re-bucketing would strand every persisted study
+    # behind 307s to the wrong owner — the fleet analog of the
+    # shard_trials re-bucketing pin in test_membership.py
+    assert shard_of("study-000000000000", 8) == 2
+    assert shard_of("study-ee45d6db14f9", 8) == 6
+    assert shard_of("study-ee45d6db14f9", 1) == 0
+    # stable across repeated calls / processes (CRC32, not hash())
+    assert shard_of("abc", 4) == shard_of("abc", 4)
+
+
+def test_new_run_id_unique_dir_redraws_on_collision(tmp_path, monkeypatch):
+    draws = [b"\x00" * 6, b"\x00" * 6, b"\x01" * 6]
+    monkeypatch.setattr(os, "urandom", lambda n: draws.pop(0))
+    first = new_run_id("study", unique_dir=str(tmp_path))
+    assert first == "study-000000000000"
+    # the second replica draws the SAME 48 bits: mkdir loses, redraw
+    second = new_run_id("study", unique_dir=str(tmp_path))
+    assert second == "study-010101010101"
+    assert (tmp_path / first).is_dir()
+    assert (tmp_path / second).is_dir()
+
+
+def test_new_run_id_without_unique_dir_unchanged(tmp_path):
+    rid = new_run_id("study")
+    assert rid.startswith("study-") and len(rid) == len("study-") + 12
+    assert not os.path.exists(rid)
+
+
+# ---------------------------------------------------------------------------
+# journal compaction directory durability (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_rewrite_fsyncs_parent_directory(tmp_path, monkeypatch):
+    j = StudyJournal(str(tmp_path / "wal.jsonl"))
+    j.append({"kind": "admit", "sid": "s"})
+    j.sync()
+    synced = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (
+        synced.append(stat.S_ISDIR(os.fstat(fd).st_mode)),
+        real_fsync(fd)))
+    j.rewrite([{"kind": "snapshot", "sid": "s"}])
+    # the compaction fsynced the file AND the parent directory entry
+    # (ext4-ordered rename durability — a crash after os.replace must
+    # not resurrect the pre-compaction journal)
+    assert True in synced and False in synced
+    assert [r["kind"] for r in j.records()] == ["snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet: determinism through both migration paths
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_proposals_bitwise_vs_single_scheduler(tmp_path):
+    ra = _replica(str(tmp_path), "ra", n_shards=4)
+    ra.join()
+    ra.steward_once()
+    assert sorted(ra.schedulers) == [0, 1, 2, 3]
+    server = ServiceHTTPServer(0, fleet=ra)
+    status, p = server.handle("POST", "/study", {
+        "space": SPEC, "seed": 42, "n_startup_jobs": 2})
+    assert status == 200, p
+    seq = _drive(server, p["study_id"], 6)
+    assert seq == _reference(42, 6)
+
+
+def test_drain_handoff_migration_bitwise(tmp_path):
+    root = str(tmp_path)
+    ra = _replica(root, "ra")
+    ra.join()
+    ra.steward_once()
+    sa = ServiceHTTPServer(0, fleet=ra)
+    _, p = sa.handle("POST", "/study", {"space": SPEC, "seed": 7,
+                                        "n_startup_jobs": 2})
+    sid = p["study_id"]
+    seq = _drive(sa, sid, 5)
+    assert ra.drain()  # graceful: handoff quiesced + WAL compacted
+    rb = _replica(root, "rb")
+    rb.join()
+    rb.steward_once()
+    assert sorted(rb.schedulers) == [0, 1]
+    # adoption compacted the chain: ONE epoch file per shard remains
+    shard = shard_of(sid, 2)
+    assert len(rb.wal_chain(shard)) == 1
+    assert rb.epochs[shard] == 2
+    sb = ServiceHTTPServer(0, fleet=rb)
+    seq += _drive(sb, sid, 4)
+    assert seq == _reference(7, 9)
+
+
+def test_sigkill_reclaim_migration_bitwise(tmp_path):
+    root = str(tmp_path)
+    ra = _replica(root, "ra")
+    ra.join()
+    ra.steward_once()
+    sa = ServiceHTTPServer(0, fleet=ra)
+    _, p = sa.handle("POST", "/study", {"space": SPEC, "seed": 9,
+                                        "n_startup_jobs": 2})
+    sid = p["study_id"]
+    seq = _drive(sa, sid, 5)
+    _kill(ra)  # no drain, no compaction — the raw epoch WAL remains
+    rb = _replica(root, "rb")
+    rb.join()
+    rb.steward_once()  # reclaims the stale leases, adopts by replay
+    assert sorted(rb.schedulers) == [0, 1]
+    assert all(e == 2 for e in rb.epochs.values())
+    sb = ServiceHTTPServer(0, fleet=rb)
+    seq += _drive(sb, sid, 4)
+    assert seq == _reference(9, 9)
+    # a told-but-never-compacted study migrated with zero lost tells
+    status, tl = sb.handle("GET", f"/study/{sid}/timeline", {})
+    assert status == 200
+    assert tl["n_told"] == 9
+
+
+def test_zombie_holder_fenced_after_reclaim(tmp_path):
+    """A holder that stalls past the TTL and is reclaimed must stop
+    serving within its verification interval — answering 307 to the new
+    owner, never stale 200s forever."""
+    root = str(tmp_path)
+    ra = _replica(root, "ra", lease_ttl=0.8)  # verify every 0.2s
+    ra.join()
+    ra.steward_once()
+    sa = ServiceHTTPServer(0, fleet=ra)
+    _, p = sa.handle("POST", "/study", {"space": SPEC, "seed": 3,
+                                        "n_startup_jobs": 2})
+    sid = p["study_id"]
+    _drive(sa, sid, 3)
+    _kill(ra)
+    rb = _replica(root, "rb", lease_ttl=0.8)
+    rb.join()
+    rb.steward_once()
+    time.sleep(0.3)  # past ra's lease-verification interval
+    status, p = sa.handle("POST", "/ask", {"study_id": sid})
+    assert status == 307, p
+    assert p["location"] == "http://rb"
+    assert ra.leases_lost >= 1
+
+
+def test_unowned_shard_answers_retryable_503(tmp_path):
+    ra = _replica(str(tmp_path), "ra")
+    # no join/steward: nothing claimed, no ownership table entries
+    server = ServiceHTTPServer(0, fleet=ra)
+    status, p = server.handle("POST", "/ask", {"study_id": "study-x"})
+    assert status == 503, p
+    assert p["retry_after"] > 0
+    with pytest.raises(ShardUnavailable):
+        ra.place_study()
+
+
+def test_steward_rebalance_converges(tmp_path):
+    ra = _replica(str(tmp_path), "ra", n_shards=8)
+    ra.join()
+    ra.steward_once()
+    assert len(ra.schedulers) == 8  # alone: owns the whole keyspace
+    rb = _replica(str(tmp_path), "rb", n_shards=8)
+    rb.join()
+    for _ in range(8):  # handoffs are one-per-sweep (gradual)
+        ra.steward_once()
+        rb.steward_once()
+    assert len(ra.schedulers) == 4
+    assert len(rb.schedulers) == 4
+    assert ra.handoffs == 4 and rb.adoptions == 4
+    # the ownership table routes every shard to exactly one of them
+    owners = {s: ra.read_owner(s)["replica"] for s in range(8)}
+    assert sorted(owners.values()).count("ra") == 4
+    assert sorted(owners.values()).count("rb") == 4
+
+
+# ---------------------------------------------------------------------------
+# ask idempotency (the retried-ask dedupe)
+# ---------------------------------------------------------------------------
+
+
+def test_retried_ask_answers_the_same_trials():
+    """An ask whose response was lost (crash/disconnect AFTER the ask
+    became durable) is retried with the same ``req`` token and must
+    answer the ORIGINAL trials — a fresh seed draw would fork the
+    study's proposal stream from its deterministic reference (the
+    ask-side analog of 409-on-retried-tell)."""
+    sched = StudyScheduler(wal=False, max_studies=16)
+    sid = sched.create_study(SPACE, seed=11, n_startup_jobs=2)
+    # startup (rand, inline) path
+    a1 = sched.ask(sid, req_id="req-a")
+    again = sched.ask(sid, req_id="req-a")
+    assert [(t["tid"], repr(t["params"]["x"])) for t in a1] \
+        == [(t["tid"], repr(t["params"]["x"])) for t in again]
+    sched.tell(sid, a1[0]["tid"], 1.0)
+    b = sched.ask(sid, req_id="req-b")
+    sched.tell(sid, b[0]["tid"], 2.0)
+    # TPE (cohort wave) path
+    c1 = sched.ask(sid, req_id="req-c")
+    c2 = sched.ask(sid, req_id="req-c")
+    assert [(t["tid"], repr(t["params"]["x"])) for t in c1] \
+        == [(t["tid"], repr(t["params"]["x"])) for t in c2]
+    # distinct tokens draw distinct trials; dedupe is counted
+    d = sched.ask(sid, req_id="req-d")
+    assert d[0]["tid"] != c1[0]["tid"]
+    assert sched.metrics.counter("service.asks_deduped").value >= 2
+
+
+def test_ask_dedupe_survives_wal_resume(tmp_path):
+    """The idempotency map rides the WAL (ask records + snapshots), so
+    a client retrying into a restarted — or migrated — scheduler still
+    gets the original trials."""
+    root = str(tmp_path)
+    sched = StudyScheduler(store_root=root, max_studies=16)
+    sid = sched.create_study(SPACE, seed=13, n_startup_jobs=1,
+                             space_spec={"space": SPEC})
+    a = sched.ask(sid, req_id="boot-req")
+    del sched  # the crash
+    resumed = StudyScheduler(store_root=root, max_studies=16)
+    again = resumed.ask(sid, req_id="boot-req")
+    assert [(t["tid"], repr(t["params"]["x"])) for t in a] \
+        == [(t["tid"], repr(t["params"]["x"])) for t in again]
+
+
+def test_ask_dedupe_survives_fleet_migration(tmp_path):
+    root = str(tmp_path)
+    ra = _replica(root, "ra")
+    ra.join()
+    ra.steward_once()
+    sa = ServiceHTTPServer(0, fleet=ra)
+    _, p = sa.handle("POST", "/study", {"space": SPEC, "seed": 17,
+                                        "n_startup_jobs": 1})
+    sid = p["study_id"]
+    _, p = sa.handle("POST", "/ask", {"study_id": sid, "req": "lost-1"})
+    first = p["trials"]
+    _kill(ra)
+    rb = _replica(root, "rb")
+    rb.join()
+    rb.steward_once()
+    sb = ServiceHTTPServer(0, fleet=rb)
+    _, p = sb.handle("POST", "/ask", {"study_id": sid, "req": "lost-1"})
+    assert [(t["tid"], t["params"]) for t in p["trials"]] \
+        == [(t["tid"], t["params"]) for t in first]
+
+
+# ---------------------------------------------------------------------------
+# /healthz (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_fleet_shape(tmp_path):
+    ra = _replica(str(tmp_path), "ra", n_shards=2)
+    ra.join()
+    ra.steward_once()
+    server = ServiceHTTPServer(0, fleet=ra)
+    server.handle("POST", "/study", {"space": SPEC, "seed": 1})
+    status, h = server.handle("GET", "/healthz", {})
+    assert status == 200
+    assert h["ok"] is True and h["draining"] is False
+    assert h["replica"] == "ra"
+    assert h["n_shards"] == 2
+    assert h["shards_held"] == [0, 1]
+    for shard in ("0", "1"):
+        entry = h["shards"][shard]
+        assert entry["epoch"] == 1
+        assert set(entry["wal"]) == {"path", "appends", "syncs",
+                                     "compactions"}
+    assert h["wal_sync_errors"] >= 0
+    assert "replicas" in h and "adoptions" in h
+    json.dumps(h)  # machine-readable end to end
+
+
+def test_top_renders_fleet_row(tmp_path):
+    """obs.top's service view grows a FLEET row from the snapshot's
+    fleet block (replica, shards held, peers, adoption traffic)."""
+    from hyperopt_tpu.obs.top import render_frame
+
+    ra = _replica(str(tmp_path), "ra", n_shards=2)
+    ra.join()
+    ra.steward_once()
+    server = ServiceHTTPServer(0, fleet=ra)
+    server.handle("POST", "/study", {"space": SPEC, "seed": 1})
+    snap = server.snapshot_dict()
+    frame = render_frame([("replica-a", snap)], {})
+    assert "FLEET" in frame
+    assert "ra" in frame
+    assert "shards 2/2" in frame
+
+
+def test_healthz_single_server_shape():
+    server = ServiceHTTPServer(0, scheduler=StudyScheduler(wal=False))
+    status, h = server.handle("GET", "/healthz", {})
+    assert status == 200
+    assert h["ok"] is True
+    assert h["shards_held"] == [] and h["n_shards"] is None
+    json.dumps(h)
+
+
+# ---------------------------------------------------------------------------
+# 307 routing over real HTTP + the client's redirect following
+# ---------------------------------------------------------------------------
+
+
+def test_http_307_routing_redirect_cache_and_location_header(tmp_path):
+    root = str(tmp_path)
+    ra = _replica(root, "ra", lease_ttl=10.0)
+    rb = _replica(root, "rb", lease_ttl=10.0)
+    sa = ServiceHTTPServer(0, fleet=ra)
+    sb = ServiceHTTPServer(0, fleet=rb)
+    assert sa.start() and sb.start()
+    try:
+        ra.set_addr(sa.url)
+        rb.set_addr(sb.url)
+        ra.join()
+        rb.join()
+        for _ in range(4):
+            ra.steward_once()
+            rb.steward_once()
+        assert len(ra.schedulers) == 1 and len(rb.schedulers) == 1
+
+        cb = ServiceClient(sb.url, key=2)
+        sid_b = cb.create_study(space=SPEC, seed=9, n_startup_jobs=2)
+        # talk to B's study THROUGH A: one 307, followed transparently
+        ca = ServiceClient(sa.url, key=1)
+        t = ca.ask(sid_b)[0]
+        assert ca.redirects == 1
+        assert ca.tell(sid_b, t["tid"], 0.5) == {"duplicate": False}
+        # the resolved owner is cached: no second redirect
+        ca.ask(sid_b)
+        assert ca.redirects == 1
+        # the raw HTTP answer carries the Location header too
+        import urllib.request
+
+        req = urllib.request.Request(
+            sa.url + "/ask",
+            data=json.dumps({"study_id": sid_b}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError("expected a 307")
+        except urllib.error.HTTPError as e:
+            assert e.code == 307
+            assert e.headers["Location"] == sb.url
+    finally:
+        sa.stop()
+        sb.stop()
+
+
+def test_client_bounded_hops_degrade_to_retry(monkeypatch):
+    """A redirect loop (two replicas pointing at each other — a stale
+    ownership table) must exhaust the hop budget and degrade to plain
+    retry-with-backoff, not spin forever."""
+    client = ServiceClient("http://a", retry=2, sleep=lambda s: None)
+    calls = []
+
+    def fake_once(method, path, body):
+        calls.append(client.url)
+        other = "http://b" if client.url == "http://a" else "http://a"
+        return 307, {"ok": False, "location": other}, None
+
+    monkeypatch.setattr(client, "_once", fake_once)
+    with pytest.raises(ServiceUnavailable):
+        client.request("POST", "/ask", {"study_id": "s"})
+    # each retry attempt burns at most max_hops redirects
+    assert len(calls) <= (client.max_hops + 1) * 4
+    assert client.redirects > client.max_hops
+
+
+def test_client_rotates_seed_urls_on_connection_error(monkeypatch):
+    client = ServiceClient(["http://dead", "http://live"], retry=3,
+                           sleep=lambda s: None)
+    bases = []
+
+    def fake_once(method, path, body):
+        bases.append(client.url)
+        if client.url == "http://dead":
+            raise ConnectionRefusedError("refused")
+        return 200, {"ok": True, "trials": []}, None
+
+    monkeypatch.setattr(client, "_once", fake_once)
+    status, payload = client.request("POST", "/ask", {"study_id": "s"})
+    assert status == 200
+    assert bases == ["http://dead", "http://live"]
