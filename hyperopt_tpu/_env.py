@@ -465,6 +465,65 @@ def parse_service_slo(env=None):
     return targets
 
 
+# -- cold-start compile plane knobs (ISSUE 14) ------------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# serving plane it would have warmed.
+
+
+DEFAULT_COMPILE_BANK_TOP_N = 8
+
+
+def parse_compile_plane(env=None):
+    """``HYPEROPT_TPU_COMPILE_PLANE`` → arm the cold-start compile plane
+    (ISSUE 14): studies whose cohort program is not yet compiled are
+    served by flagged ``rand.suggest`` (the WARMING state) while one
+    background thread compiles, and a census-driven kernel bank pre-warms
+    common keys at server start.  Opt-in (default OFF): the disarmed
+    scheduler is byte-identical to the pre-ISSUE-14 path, and arming
+    changes the early proposals of brand-new cohort keys (rand until the
+    program lands — recorded in the WAL, so replay stays bit-identical
+    to the warming run itself)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_COMPILE_PLANE", "").strip().lower()
+    return raw in ("1", "on", "true", "yes", "auto")
+
+
+def parse_compile_bank_top_n(env=None):
+    """``HYPEROPT_TPU_COMPILE_BANK_TOP_N`` → how many census-ranked
+    cohort keys the kernel bank compiles SYNCHRONOUSLY at server start,
+    before the listener opens (the rest warm in the background; default
+    8).  ``0`` defers everything to the background."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_COMPILE_BANK_TOP_N", "").strip()
+    if not raw:
+        return DEFAULT_COMPILE_BANK_TOP_N
+    try:
+        v = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_COMPILE_BANK_TOP_N", raw, "an integer")
+        return DEFAULT_COMPILE_BANK_TOP_N
+    if v < 0:
+        _warn_once("HYPEROPT_TPU_COMPILE_BANK_TOP_N", raw,
+                   "a non-negative integer")
+        return DEFAULT_COMPILE_BANK_TOP_N
+    return v
+
+
+def parse_compile_widen(env=None):
+    """``HYPEROPT_TPU_COMPILE_WIDEN`` → widen cohort programs (ISSUE 14):
+    compatible spaces (same widened profile — unconditional, same
+    multiset of numeric/discrete shapes after pow2 label padding) share
+    ONE compiled program, with per-label params and label hashes as
+    runtime inputs.  Opt-in (default OFF): widened proposals route every
+    label through the grouped pipeline (singleton families included), so
+    they match the default path only to the grouped-vs-unrolled
+    agreement tolerance — keep the flag stable across restarts of a
+    WAL-resumed service."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_COMPILE_WIDEN", "").strip().lower()
+    return raw in ("1", "on", "true", "yes")
+
+
 # -- replicated serving fleet knobs (ISSUE 12) ------------------------------
 # Same warn-and-disable convention: a bad value must never take down the
 # fleet it would have partitioned.

@@ -51,7 +51,15 @@ __all__ = [
     "suggest_async",
     "suggest_sharded",
     "build_suggest_batched",
+    "build_suggest_batched_wide",
     "cohort_cache_stats",
+    "cohort_cache_contains",
+    "cohort_key",
+    "cohort_key_wide",
+    "jit_cache_stats",
+    "widened_profile",
+    "widened_params",
+    "build_propose_wide",
     "adaptive_parzen_normal",
     "linear_forgetting_weights",
     "normal_cdf",
@@ -425,6 +433,31 @@ def _parzen_from(dist: Dist):
         mu, sigma, q = p
         return mu, sigma, -inf, inf, q, True
     raise ValueError(f"no parzen prior for family {dist.family!r}")
+
+
+def _stack_parzen_statics(parz):
+    """Stack per-label ``_parzen_from`` tuples into the statics arrays
+    the grouped pipelines consume — the ONE place that owns the
+    placeholder conventions (unbounded groups never read low/high, so
+    0.0 keeps the stacked arrays finite; unquantized labels carry
+    q=1.0).  Shared by ``build_propose_with_scores`` (closed-over
+    constants) and ``widened_params`` (runtime inputs): the widened
+    kernel is pinned bitwise against the grouped path, so the two must
+    never drift."""
+    return {
+        "prior_mu": np.asarray([p[0] for p in parz], np.float32),
+        "prior_sigma": np.asarray([p[1] for p in parz], np.float32),
+        "low": np.asarray(
+            [p[2] if math.isfinite(p[2]) else 0.0 for p in parz],
+            np.float32),
+        "high": np.asarray(
+            [p[3] if math.isfinite(p[3]) else 0.0 for p in parz],
+            np.float32),
+        "q": np.asarray(
+            [p[4] if p[4] is not None else 1.0 for p in parz],
+            np.float32),
+        "islog": np.asarray([p[5] for p in parz], bool),
+    }
 
 
 def _prior_probs(dist: Dist) -> np.ndarray:
@@ -980,7 +1013,10 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
     discrete labels sharing a bucket count K.  A family with a single label
     keeps the per-label kernel (a width-1 vmap saves nothing).
     ``group=False`` forces the per-label path (used by the agreement
-    tests)."""
+    tests); ``group="all"`` routes EVERY label through the grouped
+    pipelines, singleton families included (width-1 vmaps) — the
+    label-layout the widened cohort kernel (:func:`build_propose_wide`)
+    is pinned bitwise against."""
     by_gkey = {}
     if group:
         for l in cs.labels:
@@ -992,7 +1028,8 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
                 gkey = ("num", q is not None,
                         math.isfinite(low) and math.isfinite(high))
             by_gkey.setdefault(gkey, []).append(l)
-        by_gkey = {k: ls for k, ls in by_gkey.items() if len(ls) >= 2}
+        if group != "all":
+            by_gkey = {k: ls for k, ls in by_gkey.items() if len(ls) >= 2}
     grouped = {l for ls in by_gkey.values() for l in ls}
 
     numeric_groups = []  # (labels, quantized, bounded, statics)
@@ -1008,22 +1045,8 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
         else:
             _, quantized, bounded = gkey
             parz = [_parzen_from(cs.params[l].dist) for l in ls]
-            statics = {
-                "prior_mu": jnp.asarray([p[0] for p in parz], jnp.float32),
-                "prior_sigma": jnp.asarray([p[1] for p in parz], jnp.float32),
-                # unbounded groups never read low/high; 0 placeholders keep
-                # the stacked statics finite
-                "low": jnp.asarray(
-                    [p[2] if math.isfinite(p[2]) else 0.0 for p in parz],
-                    jnp.float32),
-                "high": jnp.asarray(
-                    [p[3] if math.isfinite(p[3]) else 0.0 for p in parz],
-                    jnp.float32),
-                "q": jnp.asarray(
-                    [p[4] if p[4] is not None else 1.0 for p in parz],
-                    jnp.float32),
-                "islog": jnp.asarray([p[5] for p in parz], bool),
-            }
+            statics = {k: jnp.asarray(v)
+                       for k, v in _stack_parzen_statics(parz).items()}
             has_log = any(p[5] for p in parz)
             numeric_groups.append((ls, quantized, bounded, has_log, statics))
 
@@ -1325,6 +1348,45 @@ def cohort_cache_stats():
     return _cohort_jit_cache.stats()
 
 
+def jit_cache_stats():
+    """Hit/miss/size counters of the SINGLE-STUDY fused tell+ask program
+    LRU (``_suggest_jit_cache``) — the compile plane (ISSUE 14) exposes
+    these as ``service.compile.jit_cache.*`` gauges so cache behavior is
+    visible on the scrape plane, not just the cohort path's."""
+    return _suggest_jit_cache.stats()
+
+
+def cohort_cache_contains(key):
+    """Non-mutating membership probe of the cohort-program LRU: no hit or
+    miss is counted and the entry's recency is untouched.  The compile
+    plane's readiness check uses this — a readiness PROBE must not make
+    the probed entry look hot (or cold) to the eviction policy."""
+    return _cohort_jit_cache.contains(key)
+
+
+def cohort_key(cs, cfg, n_studies, cap, n_ids, donate=True, mesh=None):
+    """The cohort-program LRU key :func:`build_suggest_batched` will use
+    for these build parameters — factored out so the compile plane can
+    ask "is this program compiled?" without building anything."""
+    key = (cs.signature(), tuple(sorted(cfg.items())), "cohort",
+           int(n_studies), int(cap), int(n_ids), bool(donate))
+    if _pallas_armed():
+        key = key + ("pallas",)
+    if mesh is not None:
+        key = key + ("mesh", tuple(mesh.shape.items()),
+                     tuple(d.id for d in mesh.devices.flat))
+    return key
+
+
+def cohort_key_wide(profile, cfg, n_studies, cap, n_ids, donate=True):
+    """The LRU key of the WIDENED cohort program
+    (:func:`build_suggest_batched_wide`): keyed on the space's widened
+    PROFILE, not its exact signature — every space sharing the profile
+    shares this one compiled program (the whole point of widening)."""
+    return (tuple(profile), tuple(sorted(cfg.items())), "wide",
+            int(n_studies), int(cap), int(n_ids), bool(donate))
+
+
 def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
                           mesh=None):
     """Compile the STUDY-BATCHED fused tell+ask program:
@@ -1354,13 +1416,8 @@ def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
     preserved — ``n_studies`` must then divide the mesh's device count
     total.
     """
-    key = (cs.signature(), tuple(sorted(cfg.items())), "cohort",
-           int(n_studies), int(cap), int(n_ids), bool(donate))
-    if _pallas_armed():
-        key = key + ("pallas",)
-    if mesh is not None:
-        key = key + ("mesh", tuple(mesh.shape.items()),
-                     tuple(d.id for d in mesh.devices.flat))
+    key = cohort_key(cs, cfg, n_studies, cap, n_ids, donate=donate,
+                     mesh=mesh)
     fn = _cohort_jit_cache.get(key)
     if fn is None:
         propose = build_propose(cs, cfg)
@@ -1385,6 +1442,208 @@ def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
             in_sh, out_sh = _sh.suggest_batched_shardings(mesh, labels)
             fn = jax.jit(run, in_shardings=in_sh, out_shardings=out_sh,
                          **donate_kw)
+        _cohort_jit_cache.put(key, fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# widened cohort programs (ISSUE 14): distinct-but-compatible spaces share
+# ONE compiled program.  The per-label statics the grouped pipelines already
+# stack (prior mu/sigma, bounds, q, log flag, label hashes) are lifted from
+# closed-over constants to RUNTIME inputs, and the per-label dict layout is
+# replaced by a positional [W, ...] slot layout whose pytree carries no
+# label names — so the traced program (and its XLA executable) depends only
+# on the space's widened PROFILE: the multiset of (quantized?, bounded?)
+# numeric shapes and discrete bucket counts, each padded to a power-of-two
+# slot width.  Padding slots are inert: every per-slot computation is a
+# vmap lane, so a padded slot can never perturb a real label's proposal —
+# the space-padding extension of the pinned capacity-invariance contract
+# (padding rows are fully masked there; padding LANES are fully discarded
+# here).  Label names still reach the kernel — as runtime ``label_hash``
+# words feeding the same per-label ``fold_in`` — so proposals stay
+# bit-identical per label no matter which compatible space compiled the
+# program first.
+# ---------------------------------------------------------------------------
+
+
+def _pow2_up(n):
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+#: parzen statics of the inert padding slot: a uniform(0, 1) label with no
+#: observations — finite everywhere, and its lane's output is discarded
+_PAD_PARZEN = (0.5, 1.0, 0.0, 1.0, None, False)
+
+
+def widened_profile(cs):
+    """``(profile, slots)`` of a CompiledSpace, or ``None`` when the space
+    cannot widen (conditional parameters — their activation masks couple
+    labels, so the independent-lane argument above does not apply; such
+    spaces fall back to exact-signature programs).
+
+    ``profile`` is the hashable program identity: a sorted tuple of group
+    entries ``("num", quantized, bounded, W)`` / ``("disc", K, W)`` with
+    ``W`` the pow2-padded slot width.  ``slots`` lists each group's REAL
+    labels in ``cs.labels`` order (the canonical slot assignment —
+    padding occupies the group's tail)."""
+    if any(info.conditions for info in cs.params.values()):
+        return None
+    groups = {}
+    for l in cs.labels:
+        d = cs.params[l].dist
+        if d.family in ("categorical", "randint"):
+            gkey = ("disc", len(_prior_probs(d)))
+        else:
+            _, _, low, high, q, _ = _parzen_from(d)
+            gkey = ("num", q is not None,
+                    math.isfinite(low) and math.isfinite(high))
+        groups.setdefault(gkey, []).append(l)
+    profile, slots = [], []
+    for gkey in sorted(groups):
+        ls = groups[gkey]
+        profile.append(gkey + (_pow2_up(len(ls)),))
+        slots.append(tuple(ls))
+    return tuple(profile), tuple(slots)
+
+
+def widened_params(cs, profile, slots):
+    """The runtime parameter pytree of one space under a widened profile:
+    per group, the stacked per-slot statics the grouped kernels consume
+    (plus the ``label_hash`` words), padded to the profile's slot width
+    with the inert entries.  Host numpy — tiny arrays, converted at
+    dispatch."""
+    out = []
+    for entry, ls in zip(profile, slots):
+        Wg = entry[-1]
+        pad = Wg - len(ls)
+        hashes = [label_hash(l) for l in ls] + [0] * pad
+        if entry[0] == "disc":
+            K = entry[1]
+            ps = [_prior_probs(cs.params[l].dist) for l in ls]
+            ps += [np.full(K, 1.0 / K, np.float32)] * pad
+            offs = [int(cs.params[l].dist.params[0])
+                    if cs.params[l].dist.family == "randint" else 0
+                    for l in ls] + [0] * pad
+            out.append({
+                "hash": np.asarray(hashes, np.uint32),
+                "p": np.stack(ps).astype(np.float32),
+                "off": np.asarray(offs, np.int32),
+            })
+        else:
+            parz = [_parzen_from(cs.params[l].dist) for l in ls]
+            parz += [_PAD_PARZEN] * pad
+            out.append({"hash": np.asarray(hashes, np.uint32),
+                        **_stack_parzen_statics(parz)})
+    return tuple(out)
+
+
+def build_propose_wide(profile, cfg):
+    """One proposal step over the positional slot layout:
+    ``propose(history, wparams, key) -> values[W]`` where ``history`` is
+    ``{"vals": [W, cap], "active": [W, cap], "losses": [cap],
+    "has_loss": [cap]}`` and ``wparams`` is :func:`widened_params`' tuple.
+
+    Per slot this is EXACTLY the grouped pipeline of
+    :func:`build_propose_with_scores` (``group="all"``) — the same group
+    kernels, the same per-label keys, the same statics values (as traced
+    inputs instead of baked constants) — so a real slot's proposal is
+    bit-identical to the unwidened grouped path (pinned by test).
+    ``has_log`` is statically True for every numeric group: a linear
+    slot's ``jnp.where(islog, ...)`` selects the linear value exactly, so
+    the dead log branch never perturbs it — that staticness is what lets
+    log and linear spaces share one program."""
+    def propose(history, wparams, key):
+        losses = jnp.asarray(history["losses"]).astype(jnp.float32)
+        has_loss = jnp.asarray(history["has_loss"])
+        below, above = split_below_above(losses, has_loss, cfg["gamma"],
+                                         cfg["LF"])
+        vals = jnp.asarray(history["vals"]).astype(jnp.float32)
+        act = jnp.asarray(history["active"])
+        outs = []
+        off = 0
+        for entry, gp in zip(profile, wparams):
+            Wg = entry[-1]
+            sl = slice(off, off + Wg)
+            off += Wg
+            keys = jax.vmap(
+                lambda h: jax.random.fold_in(key, h))(gp["hash"])
+            obs = vals[sl]
+            b = below[None, :] & act[sl]
+            a = above[None, :] & act[sl]
+            if entry[0] == "disc":
+                v, _ = _propose_discrete_group(keys, obs, b, a, gp["p"],
+                                               gp["off"], cfg)
+            else:
+                _, quantized, bounded, _ = entry
+                statics = {k: gp[k] for k in
+                           ("prior_mu", "prior_sigma", "low", "high",
+                            "q", "islog")}
+                v, _ = _propose_numeric_group(keys, obs, b, a, statics,
+                                              cfg, quantized, bounded,
+                                              has_log=True)
+            outs.append(jnp.asarray(v, jnp.float32))
+        return jnp.concatenate(outs)
+
+    return propose
+
+
+def _apply_rows_wide(W, history, rows):
+    """:func:`_apply_rows` over the positional slot layout: ``rows`` is
+    ``[K, 2W+3]`` (slot-ordered val columns, slot-ordered active columns,
+    loss, has_loss, trial index) and the scatters write the same values
+    to the same (slot, trial) cells as the per-label dict path."""
+    idx = rows[:, 2 * W + 2].astype(jnp.int32)  # [K]
+    return {
+        "vals": history["vals"].at[:, idx].set(
+            rows[:, :W].T.astype(history["vals"].dtype), mode="drop"),
+        "active": history["active"].at[:, idx].set(
+            rows[:, W:2 * W].T > 0.5, mode="drop"),
+        "losses": history["losses"].at[idx].set(
+            rows[:, 2 * W].astype(history["losses"].dtype), mode="drop"),
+        "has_loss": history["has_loss"].at[idx].set(
+            rows[:, 2 * W + 1] > 0.5, mode="drop"),
+    }
+
+
+def build_suggest_batched_wide(profile, cfg, n_studies, cap, n_ids,
+                               donate=True):
+    """The WIDENED study-batched fused tell+ask program:
+
+        run(hist_stack, rows_stack, seed_words[S, 2], ids[S, B], wparams)
+            -> (hist_stack', packed[S, B, W])
+
+    ``hist_stack`` leaves carry a leading study axis over the positional
+    slot layout (``vals[S, W, cap]``, ``losses[S, cap]``, ...);
+    ``wparams`` (study-invariant — every study in a cohort shares the
+    space) rides unbatched.  The body is :func:`build_propose_wide`
+    under the same fold/key-derivation/vmap structure as
+    :func:`build_suggest_batched`; cached in the same cohort LRU under
+    :func:`cohort_key_wide` — keyed on the PROFILE, so every compatible
+    space reuses the entry.  No mesh variant: widened cohorts serve
+    single-device (the cold-start plane's regime is many small diverse
+    spaces, not one sharded giant)."""
+    key = cohort_key_wide(profile, cfg, n_studies, cap, n_ids,
+                          donate=donate)
+    fn = _cohort_jit_cache.get(key)
+    if fn is None:
+        propose = build_propose_wide(profile, cfg)
+        W = sum(entry[-1] for entry in profile)
+
+        def one(history, rows, seed_words, ids, wparams):
+            hist = _apply_rows_wide(W, history, rows)
+            k = jax.random.fold_in(
+                jax.random.PRNGKey(seed_words[0]), seed_words[1]
+            )
+            keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+            out = jax.vmap(lambda kk: propose(hist, wparams, kk))(keys)
+            return hist, out
+
+        run = jax.vmap(one, in_axes=(0, 0, 0, 0, None))
+        donate_kw = {"donate_argnums": (0,)} if donate else {}
+        fn = jax.jit(run, **donate_kw)
         _cohort_jit_cache.put(key, fn)
     return fn
 

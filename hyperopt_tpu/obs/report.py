@@ -402,6 +402,32 @@ def _service_section(metrics, out):
         if level:
             out.append("  DEGRADED: serving below full quality — see "
                        "service.degrade.* transitions")
+    comp_keys = [k for k in svc if k.startswith("service.compile.")]
+    if comp_keys:
+        # cold-start compile plane (ISSUE 14): warming traffic, the
+        # background queue, and the kernel bank's reuse
+        cc_h = int(svc.get("service.compile.cohort_cache.hits", 0))
+        cc_m = int(svc.get("service.compile.cohort_cache.misses", 0))
+        out.append(
+            f"  compile  warming studies "
+            f"{int(svc.get('service.compile.warming_studies', 0))}"
+            f"  warming asks "
+            f"{int(svc.get('service.compile.warming_asks', 0))}"
+            f"  promotions "
+            f"{int(svc.get('service.compile.promotions', 0))}"
+            f"  queue {int(svc.get('service.compile.queue_depth', 0))}"
+            f"  compiled "
+            f"{int(svc.get('service.compile.compiled_total', 0))}")
+        bank_keys = int(svc.get("service.compile.bank.keys", 0))
+        if bank_keys or cc_h or cc_m:
+            line = (f"  kernels  cohort cache {cc_h}h/{cc_m}m"
+                    f"  bank keys {bank_keys}"
+                    f"  bank hits "
+                    f"{int(svc.get('service.compile.bank.hits', 0))}")
+            errs = int(svc.get("service.compile.errors", 0))
+            if errs:
+                line += f"  COMPILE ERRORS {errs}"
+            out.append(line)
     wal_keys = [k for k in svc if k.startswith("service.wal.")]
     if wal_keys:
         out.append(

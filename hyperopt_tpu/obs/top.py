@@ -220,6 +220,22 @@ def _render_service_source(name, snap, out, w):
     if snap.get("draining"):
         line += "  DRAINING"
     out.append(line)
+    # the COMPILE row (ISSUE 14): warming-state admission + the
+    # background compile queue + kernel-bank reuse, from /snapshot's
+    # compile section — cold-start behavior at a glance
+    comp = snap.get("compile")
+    if comp:
+        cline = (f"  {'':<{w}}  COMPILE  warming "
+                 f"{comp.get('warming_studies', 0)}"
+                 f"  queue {comp.get('queue_depth', 0)}"
+                 f"  compiled {comp.get('compiled', 0)}"
+                 f"  bank {comp.get('bank_hits', 0)}/"
+                 f"{comp.get('bank_keys', 0)}")
+        if comp.get("widen"):
+            cline += "  WIDEN"
+        if comp.get("errors"):
+            cline += f"  ERRORS {comp['errors']}"
+        out.append(cline)
     # the FLEET row (ISSUE 12): which replica this is, the shard leases
     # (+ epochs) it holds out of the fleet's keyspace, live peer count,
     # adoption/handoff traffic and WAL sync health — the /healthz body

@@ -151,6 +151,22 @@ KEY_DIRECTIONS = {
     # poll; the loose bar catches a broken reclaim/adopt path (latency
     # jumping toward the client retry ceiling), not scheduler noise.
     "reclaim_latency_sec": {"direction": "lower", "threshold": 1.00},
+    # brand-new-space first-ask tail (bench.py coldstart stage, ISSUE
+    # 14): p99 of the FIRST TPE-eligible ask of never-seen spaces with
+    # the compile plane armed — served at the warming rand floor while
+    # the cohort program compiles off-thread.  Its regression mode is an
+    # ask BLOCKING on a compile (ms → seconds), which the loose relative
+    # bar catches comfortably while absorbing rand-floor noise.
+    "cold_study_ask_p99_ms": {"direction": "lower", "threshold": 1.00},
+    # background compile queue high-water mark during the cold phase:
+    # bounded by the distinct-cohort count; a jump means dedupe or the
+    # worker broke and the queue grew past the workload's key count.
+    "compile_queue_depth_max": {"direction": "lower", "threshold": 2.00},
+    # census kernel-bank reuse across the stage's simulated restart:
+    # warmed keys that actually served live traffic / keys warmed.
+    # Near 1.0 when the census round-trips; a collapse toward 0 means
+    # the bank stopped matching live cohort keys.
+    "bank_hit_frac": {"direction": "higher", "threshold": 0.40},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -165,7 +181,9 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "studies_per_sec", "study_ask_p99_ms",
                 "slot_utilization_frac",
                 "resume_latency_sec", "shed_rate_frac",
-                "fleet_studies_per_sec", "reclaim_latency_sec")
+                "fleet_studies_per_sec", "reclaim_latency_sec",
+                "cold_study_ask_p99_ms", "compile_queue_depth_max",
+                "bank_hit_frac")
 
 
 def trajectory_path(root=None):

@@ -12,9 +12,14 @@ processes over one store root partition the study keyspace into leased
 study-shards with per-(shard, epoch) WALs, 307 routing and
 bit-identical WAL-replay migration — one logical service that survives
 SIGKILLs and rolling restarts with zero lost tells.
+``service/compile_plane.py`` (ISSUE 14) takes XLA compilation off the
+serving path: cold cohort keys are served at a flagged warming rand
+floor while one background thread compiles, and a census-driven kernel
+bank pre-warms common keys before the listener opens on restart.
 """
 
 from .client import ServiceClient
+from .compile_plane import CompilePlane, SignatureCensus
 from .fleet import FleetReplica, ShardNotOwned, ShardUnavailable, shard_of
 from .journal import StudyJournal
 from .overload import AdmissionGuard, Deadline, DegradeLadder, OverloadError
@@ -25,5 +30,6 @@ from .spacespec import space_from_spec
 __all__ = ["StudyScheduler", "StudyQuotaError", "UnknownStudyError",
            "DrainingError", "StudyJournal", "AdmissionGuard", "Deadline",
            "DegradeLadder", "OverloadError", "ServiceClient",
+           "CompilePlane", "SignatureCensus",
            "FleetReplica", "ShardNotOwned", "ShardUnavailable", "shard_of",
            "space_from_spec"]

@@ -1,0 +1,535 @@
+"""Cold-start compile plane (ISSUE 14): warming admission, background
+compilation, and the census-driven ahead-of-time kernel bank.
+
+PAPER.md names the fused TPE tell+ask program as THE hot path — but for
+a serving fleet the p99 story is not the warm kernel, it is the XLA
+compile every new (space signature, TPE cfg, capacity bucket) cohort key
+pays ON the serving path, blocking the wave the new study joins.  This
+module moves that compile off-thread and, across restarts, off the
+request path entirely:
+
+* **Warming state** — :meth:`CompilePlane.ready_for` answers "is this
+  cohort's program compiled for these shapes?" without ever compiling;
+  a miss enqueues a background compile job and the scheduler serves the
+  cohort's asks host-side via ``rand.suggest`` (flagged ``warming`` in
+  the response; ``algo:"rand"`` in the WAL, so crash-resume and shard
+  migration replay the warming run bit-identically — the degrade
+  ladder's rand floor already proved this exact path end-to-end).  At
+  the first wave after the program lands the cohort serves on-device
+  and its studies are PROMOTED.
+
+* **Background compilation** — one daemon thread drains the job queue:
+  build the cohort program (``tpe.build_suggest_batched`` /
+  ``_wide``), then run one dummy tick at the exact input shapes and
+  dtypes so the jit's executable cache (and the persistent
+  ``HYPEROPT_TPU_COMPILE_CACHE`` on disk) is populated before any real
+  ask needs it.  A failing compile is counted and dropped — the plane
+  must never wedge the queue, and the affected cohort keeps serving at
+  the rand floor.
+
+* **AOT kernel bank** — a space-signature census
+  (:class:`SignatureCensus`, JSONL next to the WAL under the store
+  root) journals what users actually ask for: one record per cohort key
+  at pow2 count milestones, torn-line tolerant, O_APPEND so every fleet
+  replica shares one file.  At server start
+  :meth:`CompilePlane.warm_from_census` replays it — the top-N keys
+  (``HYPEROPT_TPU_COMPILE_BANK_TOP_N``) compile synchronously BEFORE
+  the listener opens, the rest in the background — so a restarted
+  service greets its returning spaces with warm programs (near-instant
+  when ``HYPEROPT_TPU_COMPILE_CACHE`` persists the XLA executables).
+
+Readiness is tracked as (program LRU key, rows-bucket) pairs validated
+against ``tpe.cohort_cache_contains`` — an LRU eviction demotes the key
+back to warming instead of letting the next tick compile synchronously.
+The plane is wholly opt-in (``HYPEROPT_TPU_COMPILE_PLANE``); disarmed,
+no thread starts and the scheduler path is byte-identical to
+pre-ISSUE-14.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..obs.metrics import get_metrics
+from ..obs.trace import iter_jsonl
+
+__all__ = ["CompilePlane", "SignatureCensus", "census_path_for"]
+
+logger = logging.getLogger(__name__)
+
+#: census file name under a store root (next to the WAL)
+CENSUS_BASENAME = "compile_census.jsonl"
+
+#: append a census record when a key's in-process tick count crosses one
+#: of these (bounded appends; the read side max-aggregates per key)
+_MILESTONES = frozenset({1, 8, 64, 512, 4096, 32768})
+
+
+def census_path_for(store_root):
+    """The default census location for a scheduler persisting into
+    ``store_root`` (shared by every fleet replica on that root)."""
+    return os.path.join(str(store_root), CENSUS_BASENAME)
+
+
+class SignatureCensus:
+    """Durable space-signature census: which cohort keys this service
+    actually compiles for, with approximate traffic counts.  Append-only
+    JSONL via ``O_APPEND`` single-line writes (fleet replicas share the
+    file; torn lines are skipped by ``iter_jsonl``).  Best-effort on the
+    write side — a census I/O failure costs future warm-start quality,
+    never a request."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._counts = {}  # key_id -> in-process tick count
+        self._lock = threading.Lock()
+        self._warned = False
+
+    @staticmethod
+    def key_id(spec, cfg, cap):
+        """Canonical identity of one bankable cohort class: the wire
+        space spec, the TPE cfg and the capacity bucket.  S and B are
+        deliberately OUT of the identity — they drift with live load;
+        the census records the latest observed shape instead."""
+        return json.dumps([spec, sorted(cfg.items()), int(cap)],
+                          sort_keys=True, separators=(",", ":"))
+
+    def note(self, spec, cfg, cap, S, B, widen=False, kid=None):
+        """Count one cohort tick for a key; journal at milestones.
+        ``spec`` is the study's wire space schema (or zoo wrapper) —
+        ``None`` (a direct-API study that never crossed the wire) is
+        uncountable and skipped: the bank could never rebuild it.
+        ``kid`` is the precomputed :meth:`key_id` — callers on the wave
+        hot path cache it per cohort so the per-tick cost is one dict
+        increment, not a JSON serialization of the whole space spec."""
+        if not isinstance(spec, dict):
+            return
+        if kid is None:
+            kid = self.key_id(spec, cfg, cap)
+        with self._lock:
+            n = self._counts.get(kid, 0) + 1
+            self._counts[kid] = n
+            if n in _MILESTONES:
+                self._append({
+                    "kind": "census", "spec": spec, "cfg": dict(cfg),
+                    "cap": int(cap), "S": int(S), "B": int(B),
+                    "widen": bool(widen), "count": n, "ts": time.time()})
+
+    def _append(self, rec):
+        line = (json.dumps(rec, sort_keys=True,
+                           separators=(",", ":")) + "\n").encode()
+        try:
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                logger.warning("census: cannot append to %s (%s); "
+                               "kernel-bank warm starts degrade",
+                               self.path, e)
+
+    def read(self):
+        """Aggregate the on-disk census: one entry per key with the MAX
+        recorded count (milestone appends are monotonic) and the latest
+        recorded shape, sorted most-used first."""
+        best = {}
+        if os.path.exists(self.path):
+            for rec in iter_jsonl(self.path):
+                if rec.get("kind") != "census":
+                    continue
+                spec, cfg = rec.get("spec"), rec.get("cfg")
+                if not isinstance(spec, dict) or not isinstance(cfg, dict):
+                    continue
+                try:
+                    kid = self.key_id(spec, cfg, rec.get("cap", 0))
+                except TypeError:
+                    continue
+                cur = best.get(kid)
+                if cur is None or rec.get("count", 0) >= cur.get("count", 0):
+                    best[kid] = rec
+        return sorted(best.values(),
+                      key=lambda r: (-int(r.get("count", 0)),
+                                     -float(r.get("ts", 0.0))))
+
+
+class _Job:
+    """One background compile: everything needed to build the program and
+    run a dummy tick at the exact shapes.  ``space`` is a built hp space
+    (live cohorts pass their CompiledSpace's source via the study) or a
+    wire spec dict (census jobs rebuild it lazily on the worker)."""
+
+    __slots__ = ("key", "cs", "spec", "cfg", "S", "cap", "B", "donate",
+                 "mesh", "widen", "source")
+
+    def __init__(self, key, cs, spec, cfg, S, cap, B, donate, mesh,
+                 widen, source):
+        self.key = key
+        self.cs = cs
+        self.spec = spec
+        self.cfg = dict(cfg)
+        self.S = int(S)
+        self.cap = int(cap)
+        self.B = int(B)
+        self.donate = bool(donate)
+        self.mesh = mesh
+        self.widen = bool(widen)
+        self.source = source  # "live" | "bank" | "growth"
+
+
+def _space_from_wire(spec):
+    """Rebuild an hp space from a census record's spec wrapper — the same
+    forms the WAL admit record uses."""
+    if "zoo" in spec:
+        from ..zoo import ZOO
+
+        rec = ZOO.get(str(spec["zoo"]))
+        return rec.space if rec is not None else None
+    if "space" in spec:
+        from .spacespec import space_from_spec
+
+        return space_from_spec(spec["space"])
+    return None
+
+
+class CompilePlane:
+    """The process's compile machinery: readiness probes, the background
+    compile thread, and the census-driven bank.  One instance per server
+    process (fleet mode shares it across every shard's scheduler via
+    ``scheduler_kwargs``); direct :class:`StudyScheduler` use builds one
+    per scheduler when ``HYPEROPT_TPU_COMPILE_PLANE`` arms it."""
+
+    def __init__(self, census_path=None, metrics=None):
+        from .._env import enable_persistent_compilation_cache
+
+        # the bank's restart story rides the persistent XLA cache: arm
+        # it here so serving processes get it without an fmin entry point
+        enable_persistent_compilation_cache()
+        self.census = (SignatureCensus(census_path)
+                       if census_path else None)
+        self.metrics = metrics if metrics is not None else get_metrics(
+            "service")
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._queued = set()   # keys in the queue (dedupe)
+        self._ready = {}       # program key -> set of ready rows-buckets
+        self._bank_keys = set()    # keys warmed from the census
+        self._bank_hit_keys = set()  # bank keys that served live traffic
+        self._thread = None
+        self._stopped = False
+        self.compiled = 0
+        self.errors = 0
+
+    # -- readiness ---------------------------------------------------------
+
+    def _is_ready(self, key, K):
+        from ..algos import tpe
+
+        buckets = self._ready.get(key)
+        if buckets is None or K not in buckets:
+            return False
+        if not tpe.cohort_cache_contains(key):
+            # LRU eviction demoted the program: forget it so the next
+            # probe re-enqueues instead of the tick compiling inline
+            self._ready.pop(key, None)
+            return False
+        return True
+
+    def mark_ready(self, key, K=1):
+        """Record that (program, rows-bucket) is compiled — called by the
+        worker after a dummy tick, and by the scheduler after any
+        successful live device tick (live traffic warms keys the plane
+        never compiled itself)."""
+        with self._cond:
+            self._ready.setdefault(key, set()).add(int(K))
+
+    def ready_for(self, key, K, job=None, job_factory=None):
+        """True when the program behind ``key`` is compiled for rows
+        bucket ``K``.  On a miss, ``job`` (a prepared :class:`_Job`) —
+        or ``job_factory()`` , built LAZILY so the steady-state ready
+        path never pays job construction — is enqueued for the
+        background thread and the caller serves the cohort at the rand
+        floor (warming)."""
+        with self._cond:
+            if self._is_ready(key, K):
+                if key in self._bank_keys and key not in self._bank_hit_keys:
+                    self._bank_hit_keys.add(key)
+                    self.metrics.counter("service.compile.bank.hits").inc()
+                return True
+            if job is None and job_factory is not None \
+                    and key not in self._queued:
+                job = job_factory()
+            if job is not None and key not in self._queued:
+                self._queue.append(job)
+                self._queued.add(key)
+                # the gauge counts OUTSTANDING work (queued + in-flight:
+                # _queued keeps a popped job's key until its finally) —
+                # "queue 0" must mean "nothing still compiling"
+                self.metrics.gauge("service.compile.queue_depth").set(
+                    len(self._queued))
+                self.metrics.counter("service.compile.enqueued").inc()
+                self._cond.notify()
+                self._ensure_thread()
+            return False
+
+    def make_job(self, cs, spec, cfg, S, cap, B, donate, mesh=None,
+                 widen=False, source="live"):
+        """Build the (key, job) pair for one cohort shape — the single
+        place the plane derives program keys, shared by the live probe
+        path and the census bank."""
+        from ..algos import tpe
+
+        if widen:
+            prof = tpe.widened_profile(cs)
+            if prof is None:
+                widen = False
+        if widen:
+            key = tpe.cohort_key_wide(prof[0], cfg, S, cap, B,
+                                      donate=donate)
+        else:
+            key = tpe.cohort_key(cs, cfg, S, cap, B, donate=donate,
+                                 mesh=mesh)
+        return key, _Job(key, cs, spec, cfg, S, cap, B, donate, mesh,
+                         widen, source)
+
+    # -- the background worker ---------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            if self._stopped:
+                return
+            if not self._atexit_armed:
+                # a daemon thread killed MID-XLA at interpreter teardown
+                # aborts the process ("terminate called without an
+                # active exception"); stop + bounded join beats that
+                self._atexit_armed = True
+                import atexit
+
+                atexit.register(self.stop)
+            self._thread = threading.Thread(
+                target=self._loop, name="hyperopt-compile-plane",
+                daemon=True)
+            self._thread.start()
+
+    _atexit_armed = False
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(timeout=1.0)
+                if self._stopped:
+                    return
+                job = self._queue.popleft()
+            try:
+                self._compile(job)
+            except Exception as e:  # noqa: BLE001 - never wedge the queue
+                self.errors += 1
+                self.metrics.counter("service.compile.errors").inc()
+                logger.warning("compile plane: job for %r failed: %s",
+                               job.key[:2], e)
+            finally:
+                with self._cond:
+                    self._queued.discard(job.key)
+                    self.metrics.gauge("service.compile.queue_depth").set(
+                        len(self._queued))
+                    self._cond.notify_all()  # drain() waiters
+
+    def _compile(self, job):
+        """Build the program and run ONE dummy tick at the exact shapes
+        (K=1 rows bucket), so the jit's executable cache — and the
+        persistent on-disk cache — hold it before any real ask does."""
+        from .._env import parse_hist_dtype
+        from ..algos import tpe
+
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        cs = job.cs
+        if cs is None:
+            space = _space_from_wire(job.spec or {})
+            if space is None:
+                return  # unresumable census entry: nothing to warm
+            from ..base import Domain
+
+            cs = Domain(None, space).cs
+        S, cap, B = job.S, job.cap, job.B
+        L = len(cs.labels)
+        dt = jnp.dtype(parse_hist_dtype())
+        wparams = None
+        if job.widen:
+            profile, slots = tpe.widened_profile(cs)
+            W = sum(e[-1] for e in profile)
+            fn = tpe.build_suggest_batched_wide(profile, job.cfg, S, cap,
+                                                B, donate=job.donate)
+            hist = {
+                "vals": jnp.zeros((S, W, cap), dt),
+                "active": jnp.zeros((S, W, cap), bool),
+                "losses": jnp.full((S, cap), jnp.inf, dt),
+                "has_loss": jnp.zeros((S, cap), bool),
+            }
+            rows = np.zeros((S, 1, 2 * W + 3), np.float32)
+            rows[:, :, 2 * W + 2] = float(cap)  # no-op scatter row
+            wparams = tuple(
+                {k: jnp.asarray(v) for k, v in gp.items()}
+                for gp in tpe.widened_params(cs, profile, slots))
+        else:
+            fn = tpe.build_suggest_batched(cs, job.cfg, S, cap, B,
+                                           donate=job.donate,
+                                           mesh=job.mesh)
+            hist = {
+                "vals": {l: jnp.zeros((S, cap), dt) for l in cs.labels},
+                "active": {l: jnp.zeros((S, cap), bool)
+                           for l in cs.labels},
+                "losses": jnp.full((S, cap), jnp.inf, dt),
+                "has_loss": jnp.zeros((S, cap), bool),
+            }
+            rows = np.zeros((S, 1, 2 * L + 3), np.float32)
+            rows[:, :, 2 * L + 2] = float(cap)
+        seed_words = np.zeros((S, 2), np.uint32)
+        ids = np.zeros((S, B), np.uint32)
+        args = (hist, rows, seed_words, ids)
+        if wparams is not None:
+            args = args + (wparams,)
+        out = fn(*args)
+        # block so "compiled" means COMPILED, not dispatched
+        import jax
+
+        jax.block_until_ready(out[1])
+        self.mark_ready(job.key, K=1)
+        self.compiled += 1
+        dt_s = time.perf_counter() - t0
+        self.metrics.counter("service.compile.compiled_total").inc()
+        self.metrics.histogram("service.compile.compile_sec").observe(dt_s)
+        if job.source == "bank":
+            with self._cond:
+                self._bank_keys.add(job.key)
+
+    # -- the census bank ---------------------------------------------------
+
+    def census_note(self, spec, cfg, cap, S, B, widen=False, kid=None):
+        if self.census is not None:
+            self.census.note(spec, cfg, cap, S, B, widen=widen, kid=kid)
+
+    def warm_from_census(self, top_n=None, donate=None, widen=False):
+        """Replay the census into warm programs: the ``top_n``
+        most-counted keys compile synchronously ON THIS THREAD (the
+        pre-listener phase — a server calls this before binding so its
+        first requests meet warm programs), the rest enqueue for the
+        background thread.  Returns ``(warmed_sync, enqueued)``.
+
+        ``donate`` defaults to the LIVE path's donation mode
+        (``tpe._donation_enabled()``): the program key includes the
+        donate flag, so a hardcoded value here would warm keys the
+        serving probe never asks for whenever HYPEROPT_TPU_NO_DONATION
+        is set — wasted pre-listener compile time AND a cold restart."""
+        from .._env import parse_compile_bank_top_n
+        from ..algos import tpe
+
+        if self.census is None:
+            return 0, 0
+        if donate is None:
+            donate = tpe._donation_enabled()
+        if top_n is None:
+            top_n = parse_compile_bank_top_n()
+        entries = self.census.read()
+        warmed = enqueued = 0
+        for i, rec in enumerate(entries):
+            spec = rec.get("spec")
+            space = _space_from_wire(spec or {})
+            if space is None:
+                continue
+            from ..base import Domain
+
+            cs = Domain(None, space).cs
+            cfg = rec.get("cfg") or {}
+            try:
+                key, job = self.make_job(
+                    cs, spec, cfg, rec.get("S", 1), rec.get("cap", 16),
+                    rec.get("B", 1), donate,
+                    widen=bool(rec.get("widen", widen)), source="bank")
+            except Exception:  # noqa: BLE001 - hostile census entry
+                continue
+            with self._cond:
+                self._bank_keys.add(key)
+                already = self._is_ready(key, 1)
+            if already:
+                continue
+            if i < top_n:
+                try:
+                    self._compile(job)
+                    warmed += 1
+                except Exception as e:  # noqa: BLE001
+                    self.errors += 1
+                    logger.warning("kernel bank: sync warm failed: %s", e)
+            else:
+                self.ready_for(key, 1, job=job)  # enqueues
+                enqueued += 1
+        self.metrics.gauge("service.compile.bank.keys").set(
+            len(self._bank_keys))
+        return warmed, enqueued
+
+    # -- observability / lifecycle -----------------------------------------
+
+    def publish(self):
+        """Refresh the plane's gauges (called at scrape/snapshot time) and
+        return the status dict the ``/snapshot`` compile section embeds."""
+        with self._cond:
+            depth = len(self._queued)
+            ready = sum(len(v) for v in self._ready.values())
+            bank_keys = len(self._bank_keys)
+            bank_hits = len(self._bank_hit_keys)
+        g = self.metrics.gauge
+        g("service.compile.queue_depth").set(depth)
+        g("service.compile.ready_programs").set(ready)
+        g("service.compile.bank.keys").set(bank_keys)
+        return {
+            "queue_depth": depth,
+            "ready_programs": ready,
+            "compiled": self.compiled,
+            "errors": self.errors,
+            "bank_keys": bank_keys,
+            "bank_hits": bank_hits,
+            "census_path": (self.census.path
+                            if self.census is not None else None),
+        }
+
+    def queue_depth(self):
+        """Outstanding compiles: enqueued + in-flight."""
+        with self._cond:
+            return len(self._queued)
+
+    def bank_stats(self):
+        with self._cond:
+            return {"keys": len(self._bank_keys),
+                    "hits": len(self._bank_hit_keys)}
+
+    def drain(self, timeout=60.0):
+        """Block until the queue empties (tests and the bench stage)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            while (self._queue or self._queued) and \
+                    time.monotonic() < deadline:
+                self._cond.wait(timeout=0.05)
+            return not (self._queue or self._queued)
+
+    def stop(self, timeout=30.0):
+        """Stop the worker and join it (bounded — an in-flight compile
+        finishes first; letting teardown kill the thread inside XLA
+        aborts the whole process).  Idempotent."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)

@@ -182,6 +182,12 @@ class Study:
         self.last_active = self.created
         self.n_asked = 0
         self.n_told = 0
+        # warming (ISSUE 14): True while this study's cohort program is
+        # still compiling in the background and its TPE-eligible asks
+        # are served by flagged rand.suggest; cleared ("promoted") at
+        # the first wave served on-device.  Pure serving metadata —
+        # never feeds the RNG or the WAL replay.
+        self.warming = False
         # the live audit timeline (ISSUE 11): one bounded ring of
         # lifecycle events — admit, every ask (wave/algo/degrade/trace),
         # every tell, shed/void, evict/re-admit, resume boundary —
@@ -270,6 +276,7 @@ class Study:
             "created": self.created,
             "last_active": self.last_active,
             "seed": self.seed,
+            "warming": self.warming,
         }
 
 
@@ -282,7 +289,7 @@ class _AskReq:
 
     __slots__ = ("study", "new_ids", "seed", "docs", "error", "algo",
                  "degraded", "replay", "deadline", "journaled", "trace",
-                 "wave", "req")
+                 "wave", "req", "warming")
 
     def __init__(self, study, new_ids, seed, deadline=None, replay=False,
                  trace=None, req=None):
@@ -293,6 +300,10 @@ class _AskReq:
         self.error = None
         self.algo = "tpe"
         self.degraded = False
+        # served at the rand floor because the cohort program is still
+        # compiling (ISSUE 14) — flagged in the response, recorded as
+        # algo:"rand" in the WAL exactly like the degrade floor
+        self.warming = False
         self.replay = replay
         self.deadline = deadline
         # request-trace id (ISSUE 11): captured from the ambient context
@@ -340,7 +351,7 @@ class _Cohort:
 
     _ROW_BUCKET = 16  # one fixed row bucket, like PaddedHistory's
 
-    def __init__(self, cs, cfg, cap, hist_dtype="float32"):
+    def __init__(self, cs, cfg, cap, hist_dtype="float32", widen=None):
         self.cs = cs
         self.cfg = dict(cfg)
         self.cap = int(cap)
@@ -350,6 +361,33 @@ class _Cohort:
         self._dev = None     # stacked history pytree, or None (rebuild)
         self._synced = {}    # slot -> host rows already folded on device
         self.ticks = 0
+        self.last_key = None  # (program LRU key, K) of the latest tick
+        # compile-plane hot-path caches (ISSUE 14): program keys per
+        # (S, B, donate, mesh geom) and the census key id — both pure
+        # functions of the cohort's identity, recomputed otherwise on
+        # EVERY wave forever
+        self._plane_keys = {}
+        self._census_kid = None
+        # widened-program mode (ISSUE 14): the device stack uses the
+        # positional [S, W, cap] slot layout and ticks run the
+        # profile-keyed program every compatible space shares.  ``widen``
+        # is (profile, slots, wparams) from tpe.widened_profile/params.
+        self.widen = widen
+        if widen is not None:
+            profile, wslots, wparams = widen
+            self.wide_profile = profile
+            self.wide_W = sum(e[-1] for e in profile)
+            self.wparams = wparams
+            # canonical slot index of every real label, in cs.labels order
+            # (what extract() selects out of the packed [B, W] readback)
+            slot_of_label = {}
+            off = 0
+            for entry, ls in zip(profile, wslots):
+                for i, l in enumerate(ls):
+                    slot_of_label[l] = off + i
+                off += entry[-1]
+            self.wide_cols = np.asarray(
+                [slot_of_label[l] for l in cs.labels], np.intp)
 
     @property
     def n_slots(self):
@@ -391,11 +429,20 @@ class _Cohort:
 
     def _upload_stack(self, mesh=None):
         """Full build of the stacked device mirror from every slotted
-        study's host arrays (admission / growth / recovery path)."""
+        study's host arrays (admission / growth / recovery path).
+        Widened cohorts build the positional ``[S, W, cap]`` layout
+        instead of the per-label dict — same values in the real slots,
+        zeros (inactive) in the padding lanes."""
         L = self.cs.labels
         S, cap = self.n_slots, self.cap
-        vals = {l: np.zeros((S, cap), np.float32) for l in L}
-        active = {l: np.zeros((S, cap), bool) for l in L}
+        wide = self.widen is not None
+        if wide:
+            W = self.wide_W
+            vals_w = np.zeros((S, W, cap), np.float32)
+            active_w = np.zeros((S, W, cap), bool)
+        else:
+            vals = {l: np.zeros((S, cap), np.float32) for l in L}
+            active = {l: np.zeros((S, cap), bool) for l in L}
         losses = np.full((S, cap), np.inf, np.float32)
         has_loss = np.zeros((S, cap), bool)
         for slot, st in enumerate(self.slots):
@@ -404,9 +451,14 @@ class _Cohort:
             ph = self._history(st)
             host = ph.host_padded()
             c = min(cap, ph.cap)  # live prefix; the rest stays padding
-            for l in L:
-                vals[l][slot, :c] = host["vals"][l][:c]
-                active[l][slot, :c] = host["active"][l][:c]
+            for j, l in enumerate(L):
+                if wide:
+                    w = self.wide_cols[j]
+                    vals_w[slot, w, :c] = host["vals"][l][:c]
+                    active_w[slot, w, :c] = host["active"][l][:c]
+                else:
+                    vals[l][slot, :c] = host["vals"][l][:c]
+                    active[l][slot, :c] = host["active"][l][:c]
             losses[slot, :c] = host["losses"][:c]
             has_loss[slot, :c] = host["has_loss"][:c]
             self._synced[slot] = ph.n
@@ -426,12 +478,20 @@ class _Cohort:
                     arr, NamedSharding(mesh, P(mesh.axis_names)))
             return arr
 
-        self._dev = {
-            "vals": {l: put(vals[l], True) for l in L},
-            "active": {l: put(active[l], False) for l in L},
-            "losses": put(losses, True),
-            "has_loss": put(has_loss, False),
-        }
+        if wide:
+            self._dev = {
+                "vals": put(vals_w, True),
+                "active": put(active_w, False),
+                "losses": put(losses, True),
+                "has_loss": put(has_loss, False),
+            }
+        else:
+            self._dev = {
+                "vals": {l: put(vals[l], True) for l in L},
+                "active": {l: put(active[l], False) for l in L},
+                "losses": put(losses, True),
+                "has_loss": put(has_loss, False),
+            }
 
     def tick(self, demand, donate=True, mesh=None, cand_scale=1.0):
         """One batched fused tell+ask DISPATCH for the whole cohort.
@@ -450,6 +510,13 @@ class _Cohort:
         the memory- and compute-heavy axis) without touching the
         cohort's identity; the scaled program gets its own LRU entry.
         """
+        if self.widen is not None:
+            # widened cohorts serve single-device by contract (DESIGN
+            # §20): build_suggest_batched_wide has no mesh variant, and
+            # a NamedSharding-placed stack would silently recompile the
+            # wide jit against sharded inputs — voiding the compile
+            # plane's readiness signal (its dummy tick runs unsharded)
+            mesh = None
         self.ticks += 1
         L = len(self.cs.labels)
         B = _pow2(max((len(ids) for ids, _ in demand.values()), default=1))
@@ -504,10 +571,23 @@ class _Cohort:
             cfg = dict(cfg)
             cfg["n_EI_candidates"] = max(
                 1, int(cfg["n_EI_candidates"] * cand_scale))
-        run = tpe.build_suggest_batched(
-            self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh)
+        if self.widen is not None:
+            rows = self._widen_rows(rows)
+            run = tpe.build_suggest_batched_wide(
+                self.wide_profile, cfg, S, self.cap, B, donate=donate)
+            self.last_key = (tpe.cohort_key_wide(
+                self.wide_profile, cfg, S, self.cap, B, donate=donate), K)
+            args = (self._dev, rows, seed_words, ids,
+                    tuple({k: jnp.asarray(v) for k, v in gp.items()}
+                          for gp in self.wparams))
+        else:
+            run = tpe.build_suggest_batched(
+                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh)
+            self.last_key = (tpe.cohort_key(
+                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh), K)
+            args = (self._dev, rows, seed_words, ids)
         try:
-            new_dev, packed = run(self._dev, rows, seed_words, ids)
+            new_dev, packed = run(*args)
         except BaseException:
             # with donation armed the input stack may already be invalid:
             # drop it and rebuild from the authoritative host arrays
@@ -517,6 +597,44 @@ class _Cohort:
         self._dev = new_dev
         self._synced.update(pending_sync)
         return packed
+
+    def _widen_rows(self, rows):
+        """Permute label-ordered tell rows ``[S, K, 2L+3]`` into the
+        widened slot order ``[S, K, 2W+3]``: val/active columns move to
+        their canonical slots (padding slots stay zero — an inactive
+        write into a lane whose output is discarded), the trailing
+        (loss, has_loss, index) triple is shared."""
+        L = len(self.cs.labels)
+        W = self.wide_W
+        S, K = rows.shape[0], rows.shape[1]
+        out = np.zeros((S, K, 2 * W + 3), np.float32)
+        out[:, :, self.wide_cols] = rows[:, :, :L]
+        out[:, :, W + self.wide_cols] = rows[:, :, L:2 * L]
+        out[:, :, 2 * W:] = rows[:, :, 2 * L:]
+        return out
+
+    def extract(self, mat_slot, n):
+        """One slot's proposals as an ``[n, L]`` matrix in ``cs.labels``
+        order — the identity on the exact-signature layout; widened
+        cohorts select the real label columns out of the packed
+        ``[B, W]`` slot readback."""
+        mat = mat_slot[:n]
+        if self.widen is not None:
+            mat = mat[:, self.wide_cols]
+        return mat
+
+    def row_delta(self):
+        """Largest pending tell-row count across slots (what the next
+        tick's K bucket would be sized by) — the compile plane's K=1
+        enforcement reads this before dispatch."""
+        delta = 0
+        for slot, st in enumerate(self.slots):
+            if st is None:
+                continue
+            ph = self._history(st)
+            if ph.n <= self.cap:
+                delta = max(delta, ph.n - self._synced.get(slot, 0))
+        return delta if self._dev is not None else 0
 
     def abandon_device(self):
         """Drop the (possibly donated-and-poisoned) device stack after a
@@ -562,8 +680,10 @@ class StudyScheduler:
 
     def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
                  store_root=None, wave_window=0.0, wal=None, degrade=None,
-                 overload=None, auto_resume=True):
-        from .._env import (parse_service_degrade,
+                 overload=None, auto_resume=True, compile_plane=None,
+                 widen=None):
+        from .._env import (parse_compile_plane, parse_compile_widen,
+                            parse_service_degrade,
                             parse_service_idle_sec,
                             parse_service_max_pending,
                             parse_service_max_studies,
@@ -592,6 +712,27 @@ class StudyScheduler:
         self._wave_seq = 0  # wave sequence: the id request spans fan into
         self.metrics = get_metrics("service")
         self.overload = overload
+        # cold-start compile plane (ISSUE 14): None resolves
+        # HYPEROPT_TPU_COMPILE_PLANE (default off — disarmed, the wave
+        # path is byte-identical to pre-ISSUE-14), False disarms, an
+        # instance arms explicitly (the server/fleet share one across
+        # schedulers).  ``widen`` likewise resolves
+        # HYPEROPT_TPU_COMPILE_WIDEN and is cached here so a scheduler's
+        # program layout never flips mid-flight.
+        self._owns_plane = False
+        if compile_plane is None and parse_compile_plane():
+            from .compile_plane import CompilePlane, census_path_for
+
+            compile_plane = CompilePlane(
+                census_path=(census_path_for(store_root)
+                             if store_root is not None else None),
+                metrics=self.metrics)
+            # built here → stopped here (drain): a shared plane (server
+            # main / fleet scheduler_kwargs) is its creator's to stop
+            self._owns_plane = True
+        self.compile_plane = compile_plane or None
+        self.widen = (parse_compile_widen() if widen is None
+                      else bool(widen))
         # ownership fence (ISSUE 12): fleet mode installs a callable
         # answering "does this scheduler's shard lease still stand?".
         # Checked at every DURABILITY point (ask ingress, wave start,
@@ -724,8 +865,15 @@ class StudyScheduler:
         if cohort is None:
             from .._env import parse_hist_dtype
 
+            widen_info = None
+            if self.widen:
+                prof = tpe.widened_profile(st.domain.cs)
+                if prof is not None:
+                    widen_info = (prof[0], prof[1], tpe.widened_params(
+                        st.domain.cs, prof[0], prof[1]))
             cohort = self._cohorts[key] = _Cohort(
-                st.domain.cs, st.cfg, cap, hist_dtype=parse_hist_dtype())
+                st.domain.cs, st.cfg, cap, hist_dtype=parse_hist_dtype(),
+                widen=widen_info)
         if st.study_id not in cohort.slot_of:
             # evict from any smaller-capacity cohort it may still occupy
             self._evict_from_cohort(st)
@@ -878,7 +1026,8 @@ class StudyScheduler:
         st.trials.insert_trial_docs(docs)
         st.trials.refresh()
 
-    def _answers(self, st, docs, algo="tpe", degraded=False):
+    def _answers(self, st, docs, algo="tpe", degraded=False,
+                 warming=False):
         out = [{"study_id": st.study_id, "tid": d["tid"],
                 "params": spec_from_misc(d["misc"])} for d in docs]
         if degraded:
@@ -887,6 +1036,14 @@ class StudyScheduler:
             # search) instead of silently getting worse suggestions
             for a in out:
                 a["degraded"] = True
+                a["algo"] = algo
+        if warming:
+            # same in-band honesty for the compile plane's warming
+            # state: this proposal is random search while the cohort
+            # program compiles — NOT a fault, the study promotes to TPE
+            # at the next wave after the program lands
+            for a in out:
+                a["warming"] = True
                 a["algo"] = algo
         return out
 
@@ -906,9 +1063,82 @@ class StudyScheduler:
         self.metrics.counter("service.degraded_asks").inc(len(r.new_ids))
         return docs
 
+    def _cohort_plane_key(self, cohort, S, B, donate, mesh):
+        """The program LRU key for one cohort shape, cached on the
+        cohort — the readiness probe runs on EVERY wave forever, and
+        re-deriving signatures/profiles there is pure hot-path waste."""
+        geom = (None if mesh is None
+                else (tuple(mesh.shape.items()),
+                      tuple(d.id for d in mesh.devices.flat)))
+        ck = (S, B, donate, geom)
+        key = cohort._plane_keys.get(ck)
+        if key is None:
+            if cohort.widen is not None:
+                key = tpe.cohort_key_wide(cohort.wide_profile, cohort.cfg,
+                                          S, cohort.cap, B, donate=donate)
+            else:
+                key = tpe.cohort_key(cohort.cs, cohort.cfg, S, cohort.cap,
+                                     B, donate=donate, mesh=mesh)
+            cohort._plane_keys[ck] = key
+        return key
+
+    def _plane_ready(self, cohort, cohort_reqs, mesh):
+        """One cohort's compile-plane gate: census-count the tick, probe
+        program readiness (enqueueing a background compile job on a
+        miss — built lazily, the ready path never constructs one),
+        enforce the K=1 rows-bucket contract when ready, and pre-warm
+        the doubled slot count when the cohort is about to grow.
+        Returns False when the wave must serve this cohort at the
+        warming floor."""
+        plane = self.compile_plane
+        B = _pow2(max(len(r.new_ids) for r in cohort_reqs))
+        S, cap = cohort.n_slots, cohort.cap
+        donate = tpe._donation_enabled()
+        widen = cohort.widen is not None
+        pmesh = None if widen else mesh
+        spec0 = next((r.study.space_spec for r in cohort_reqs
+                      if r.study.space_spec is not None), None)
+        if plane.census is not None and spec0 is not None:
+            from .compile_plane import SignatureCensus
+
+            if cohort._census_kid is None:
+                cohort._census_kid = SignatureCensus.key_id(
+                    spec0, cohort.cfg, cap)
+            plane.census_note(spec0, cohort.cfg, cap, S, B, widen=widen,
+                              kid=cohort._census_kid)
+        key = self._cohort_plane_key(cohort, S, B, donate, pmesh)
+
+        def live_job():
+            return plane.make_job(cohort.cs, spec0, cohort.cfg, S, cap,
+                                  B, donate, mesh=pmesh, widen=widen,
+                                  source="live")[1]
+
+        if not plane.ready_for(key, 1, job_factory=live_job):
+            return False
+        # the plane only ever compiles the K=1 rows bucket; a larger
+        # pending delta would jit a fresh K variant synchronously in the
+        # tick — rebuild from the authoritative host arrays instead
+        # (full upload, K back to 1)
+        if cohort.row_delta() > 1:
+            cohort.abandon_device()
+        if cohort.n_live == cohort.n_slots:
+            # the next admission doubles the slot count — a brand-new
+            # study would otherwise demote the WHOLE cohort to warming
+            # for a wave; compile the grown shape ahead of it
+            gkey = self._cohort_plane_key(cohort, 2 * S, B, donate, pmesh)
+            plane.ready_for(
+                gkey, 1,
+                job_factory=lambda: plane.make_job(
+                    cohort.cs, spec0, cohort.cfg, 2 * S, cap, B, donate,
+                    mesh=pmesh, widen=widen, source="growth")[1])
+        return True
+
     def _finish_req(self, r, docs):
         """Journal (write-ahead) + land one served ask.  Replay reqs are
-        already in the WAL and must not journal twice."""
+        already in the WAL and must not journal twice.  Warming/promote
+        transitions live here: the study enters warming with its first
+        rand-floor-because-cold ask and is promoted at the first wave an
+        on-device program serves it."""
         if not r.replay:
             self._journal_ask(r.study, r.new_ids, r.seed, r.algo,
                               trace=r.trace, req=r.req)
@@ -916,9 +1146,17 @@ class StudyScheduler:
         self._land(r.study, docs)
         r.study.remember_req(r.req, r.new_ids)
         r.docs = docs
+        if r.warming and not r.study.warming:
+            r.study.warming = True
+            r.study.note("warming", wave=r.wave, trace=r.trace)
+        elif r.study.warming and not r.warming and r.algo == "tpe":
+            r.study.warming = False
+            r.study.note("promote", wave=r.wave, trace=r.trace)
+            self.metrics.counter("service.compile.promotions").inc()
         r.study.note("ask", tids=[int(t) for t in r.new_ids], algo=r.algo,
                      wave=r.wave, trace=r.trace,
                      degraded=True if r.degraded else None,
+                     warming=True if r.warming else None,
                      replay=True if r.replay else None)
 
     def _dispatch_cohort(self, cohort, cohort_reqs, mesh, spec):
@@ -930,6 +1168,22 @@ class StudyScheduler:
         hop)."""
         if spec["rand"] or (spec["cap_limit"] is not None
                             and cohort.cap > spec["cap_limit"]):
+            return None
+        if (self.compile_plane is not None
+                and spec["cand_scale"] == 1.0
+                and not any(r.replay for r in cohort_reqs)
+                and not self._plane_ready(cohort, cohort_reqs, mesh)):
+            # warming (ISSUE 14): the cohort's program is still
+            # compiling off-thread — serve this wave's reqs at the rand
+            # floor (flagged), never block the wave on XLA.  Replay reqs
+            # bypass the gate: a WAL record that says "tpe" MUST
+            # regenerate through tpe, compile cost and all.  Ladder
+            # levels below normal bypass too — the fault path already
+            # retries synchronously and owns its own floor.
+            for r in cohort_reqs:
+                r.warming = True
+            self.metrics.counter("service.compile.warming_asks").inc(
+                len(cohort_reqs))
             return None
         chaos.io_point("tick", self.metrics)
         demand = {}
@@ -957,7 +1211,8 @@ class StudyScheduler:
         except BaseException:
             cohort.abandon_device()
             raise
-        live = [mat[cohort.slot_of[r.study.study_id]][: len(r.new_ids)]
+        live = [cohort.extract(mat[cohort.slot_of[r.study.study_id]],
+                               len(r.new_ids))
                 for r in cohort_reqs
                 if r.study.study_id in cohort.slot_of]
         if live and not all(np.all(np.isfinite(x)) for x in live):
@@ -971,7 +1226,8 @@ class StudyScheduler:
             try:
                 slot = cohort.slot_of[r.study.study_id]
                 flats = rand.unpack_flats(
-                    cohort.cs, mat[slot], len(r.new_ids))
+                    cohort.cs, cohort.extract(mat[slot], len(r.new_ids)),
+                    len(r.new_ids))
                 docs = rand.flat_to_new_trial_docs(
                     r.study.domain, r.study.trials, r.new_ids, flats)
                 if self.degrade is not None and self.degrade.degraded:
@@ -979,14 +1235,29 @@ class StudyScheduler:
                 self._finish_req(r, docs)
             except Exception as e:  # noqa: BLE001
                 r.error = e
+        if self.compile_plane is not None and cohort.last_key is not None:
+            # a live device tick IS a compile proof: record it so the
+            # plane never demotes a traffic-warmed program to warming
+            self.compile_plane.mark_ready(*cohort.last_key)
         self.metrics.counter("service.ticks").inc()
         self.metrics.counter("service.tick_asks").inc(len(cohort_reqs))
 
     def _serve_cohort_host_side(self, cohort_reqs):
-        """Serve a cohort's reqs entirely host-side (the rand floor)."""
+        """Serve a cohort's reqs entirely host-side (the rand floor) —
+        either the degrade ladder's floor or the compile plane's warming
+        state (same ids + seed through ``rand.suggest``, same WAL
+        ``algo:"rand"`` record, different response flag)."""
         for r in cohort_reqs:
             try:
-                docs = self._serve_rand_fallback(r)
+                if r.warming:
+                    docs = rand.suggest(r.new_ids, r.study.domain,
+                                        r.study.trials, r.seed)
+                    r.algo = "rand"
+                    self.metrics.counter(
+                        "service.compile.warming_served").inc(
+                        len(r.new_ids))
+                else:
+                    docs = self._serve_rand_fallback(r)
                 self._finish_req(r, docs)
             except Exception as e:  # noqa: BLE001
                 r.error = e
@@ -1145,6 +1416,10 @@ class StudyScheduler:
             stats["misses"])
         self.metrics.gauge("service.slot_utilization").set(
             self.slot_utilization())
+        if self.compile_plane is not None:
+            self.metrics.gauge("service.compile.warming_studies").set(
+                sum(1 for s in self._studies.values()
+                    if s.warming and s.state == "active"))
 
     def ask(self, study_id, n=1, deadline=None, req_id=None):
         """Propose ``n`` new trials for one study.  Concurrent callers
@@ -1225,7 +1500,7 @@ class StudyScheduler:
         self.metrics.histogram("service.ask_sec").observe(
             time.perf_counter() - t0)
         return self._answers(req.study, req.docs, algo=req.algo,
-                             degraded=req.degraded)
+                             degraded=req.degraded, warming=req.warming)
 
     def ask_many(self, requests):
         """Explicit wave: ``[(study_id, n), ...]`` asked in ONE batched
@@ -1265,7 +1540,8 @@ class StudyScheduler:
                 else:
                     out.setdefault(r.study.study_id, []).extend(
                         self._answers(r.study, r.docs, algo=r.algo,
-                                      degraded=r.degraded))
+                                      degraded=r.degraded,
+                                      warming=r.warming))
             if failed:
                 if not out:
                     raise failed[0].error
@@ -1611,7 +1887,12 @@ class StudyScheduler:
                     self.journal.close()
                 except JournalError:
                     pass
-            return quiesced
+        if self._owns_plane and self.compile_plane is not None:
+            # outside the lock: stop() joins the worker, and a worker
+            # mid-compile never needs the scheduler lock — but joining
+            # under it would still serialize drain behind XLA
+            self.compile_plane.stop(timeout=5.0)
+        return quiesced
 
     # -- status ------------------------------------------------------------
 
@@ -1650,6 +1931,13 @@ class StudyScheduler:
             }
             if self.degrade is not None:
                 out["degrade"] = self.degrade.status()
+            if self.compile_plane is not None:
+                comp = self.compile_plane.publish()
+                comp["warming_studies"] = sum(
+                    1 for s in self._studies.values()
+                    if s.warming and s.state == "active")
+                comp["widen"] = self.widen
+                out["compile"] = comp
             if self.journal is not None:
                 out["wal"] = {
                     "path": self.journal.path,

@@ -151,6 +151,14 @@ class ServiceHTTPServer:
             self.scheduler = scheduler if scheduler is not None else (
                 StudyScheduler(store_root=store_root, wave_window=0.005))
             self.metrics = self.scheduler.metrics
+        # the process's compile plane (ISSUE 14), for scrape-time gauge
+        # refresh: fleet replicas share one via scheduler_kwargs,
+        # single-scheduler mode reads the scheduler's
+        if fleet is not None:
+            self.compile_plane = (fleet.scheduler_kwargs.get(
+                "compile_plane") or None)
+        else:
+            self.compile_plane = self.scheduler.compile_plane
         self.guard = (guard if guard is not None
                       else AdmissionGuard(metrics=self.metrics))
         if fleet is not None:
@@ -442,11 +450,18 @@ class ServiceHTTPServer:
                     self.guard.release(token)
                 out = {"ok": True, "study_id": study_id,
                        "trials": [{k: t[k] for k in
-                                   ("tid", "params", "degraded", "algo")
+                                   ("tid", "params", "degraded", "algo",
+                                    "warming")
                                    if k in t}
                                   for t in trials]}
                 if any(t.get("degraded") for t in trials):
                     out["degraded"] = True
+                if any(t.get("warming") for t in trials):
+                    # in-band cold-start honesty (ISSUE 14): this
+                    # proposal is random search while the cohort program
+                    # compiles off-thread; the study promotes to TPE at
+                    # the next wave after the program lands
+                    out["warming"] = True
                 return 200, out
             if path == "/tell":
                 study_id = self._required(body, "study_id")
@@ -574,16 +589,32 @@ class ServiceHTTPServer:
             **kwargs)
         return {"ok": True, "study_id": study_id}
 
+    def _refresh_compile_gauges(self):
+        """Publish the compile-visibility gauges (ISSUE 14 satellite):
+        the cohort-program LRU and the single-study jit LRU counters as
+        ``service.compile.*``, refreshed at scrape/snapshot time — cache
+        behavior used to be invisible to the scrape plane."""
+        from ..algos import tpe
+
+        g = self.metrics.gauge
+        for name, stats in (("cohort_cache", tpe.cohort_cache_stats()),
+                            ("jit_cache", tpe.jit_cache_stats())):
+            for k in ("hits", "misses", "size"):
+                g(f"service.compile.{name}.{k}").set(stats[k])
+
     def snapshot_dict(self):
         """``/snapshot``: the service metrics namespace plus the study
         table — the obs-plane view of the serving layer.  Carries the
         SLO section (budget/burn per objective, freshly evaluated) and
         the degrade-ladder state so ``obs.top``'s service view renders
         from one GET."""
+        from ..algos import tpe
+
         out = {"ts": time.time(), "endpoint": "snapshot",
                "service": True}
         if self.slo is not None:
             out["slo"] = self.slo.publish()  # refresh gauges on scrape
+        self._refresh_compile_gauges()
         out["sections"] = {
             "service": self.metrics.snapshot()["metrics"]}
         status = self._studies_status()
@@ -593,9 +624,12 @@ class ServiceHTTPServer:
         out["cohorts"] = status["cohorts"]
         out["slot_utilization"] = status["slot_utilization"]
         out["cohort_cache"] = status["cohort_cache"]
+        out["jit_cache"] = tpe.jit_cache_stats()
         out["draining"] = status.get("draining", False)
         if "degrade" in status:
             out["degrade"] = status["degrade"]
+        if "compile" in status:
+            out["compile"] = status["compile"]
         if "wal" in status:
             out["wal"] = status["wal"]
         return out
@@ -714,6 +748,12 @@ def _make_handler(server):
                             server.slo.publish()
                         except Exception:  # noqa: BLE001 - fail-open scrape
                             pass
+                    try:  # cache + compile-plane gauges, same contract
+                        server._refresh_compile_gauges()
+                        if server.compile_plane is not None:
+                            server.compile_plane.publish()
+                    except Exception:  # noqa: BLE001 - fail-open scrape
+                        pass
                     server._count_response(method, path, 200)
                     self._answer(
                         200, prometheus_text().encode(),
@@ -787,6 +827,16 @@ def main(argv=None):
                    help="write-ahead journal: 'auto' (default — under "
                         "--store when given), 'off', or an explicit path "
                         "(default: $HYPEROPT_TPU_SERVICE_WAL)")
+    p.add_argument("--compile-plane", default=None,
+                   choices=("on", "off"),
+                   help="cold-start compile plane (ISSUE 14): warming "
+                        "admission + background compilation + census "
+                        "kernel bank (default: "
+                        "$HYPEROPT_TPU_COMPILE_PLANE or off)")
+    p.add_argument("--bank-top-n", type=int, default=None,
+                   help="census keys to pre-compile synchronously before "
+                        "the listener opens (default: "
+                        "$HYPEROPT_TPU_COMPILE_BANK_TOP_N or 8)")
     p.add_argument("--fleet", action="store_true",
                    help="join the replicated serving fleet on --store: "
                         "lease-partitioned study shards, per-shard epoch "
@@ -812,6 +862,20 @@ def main(argv=None):
     port = args.port if args.port is not None else parse_service()
     if port is None:
         p.error("no port: pass --port or set HYPEROPT_TPU_SERVICE")
+    # cold-start compile plane (ISSUE 14): built HERE — before any
+    # scheduler — so the census bank can pre-warm the top-N cohort
+    # programs synchronously BEFORE the listener opens, and every shard
+    # scheduler (fleet mode) shares one plane/queue/thread
+    from .._env import parse_compile_plane
+
+    plane = None
+    if (args.compile_plane == "on"
+            or (args.compile_plane is None and parse_compile_plane())):
+        from .compile_plane import CompilePlane, census_path_for
+
+        plane = CompilePlane(
+            census_path=(census_path_for(args.store)
+                         if args.store else None))
     wal = None  # env-resolved
     if args.wal is not None:
         # the SAME token sets as _env.parse_service_wal — '--wal true'
@@ -844,7 +908,11 @@ def main(argv=None):
                 "max_pending": args.max_pending,
                 "idle_sec": args.idle_sec,
                 "wave_window": 0.005,
+                "compile_plane": plane if plane is not None else False,
             })
+        if plane is not None:
+            # kernel bank: top-N census keys compile before the bind
+            plane.warm_from_census(top_n=args.bank_top_n)
         server = ServiceHTTPServer(port, fleet=replica)
         if not server.start():
             return 1
@@ -859,7 +927,15 @@ def main(argv=None):
                                idle_sec=args.idle_sec,
                                store_root=args.store,
                                wal=wal,
-                               wave_window=0.005)
+                               wave_window=0.005,
+                               compile_plane=(plane if plane is not None
+                                              else False))
+        if plane is not None:
+            # kernel bank pre-warm AFTER the WAL resume (the ctor's
+            # replay may itself have compiled programs) and BEFORE the
+            # listener opens: returning spaces meet warm programs on
+            # their very first ask
+            plane.warm_from_census(top_n=args.bank_top_n)
         server = ServiceHTTPServer(port, scheduler=sched)
         if not server.start():
             return 1
@@ -881,6 +957,8 @@ def main(argv=None):
     finally:
         signal.signal(signal.SIGTERM, prev)
         quiesced = server.drain()
+        if plane is not None:
+            plane.stop()
         logger.info("service: drained (quiesced=%s); exiting", quiesced)
     return 0
 

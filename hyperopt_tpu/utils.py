@@ -42,37 +42,70 @@ class LRUCache:
     or per-call lambdas.
 
     ``hits``/``misses`` count ``get`` outcomes for the obs metrics registry
-    (``device_fmin`` publishes its compiled-run cache's rates)."""
+    (``device_fmin`` publishes its compiled-run cache's rates).
+
+    Thread-safe: the compile plane (ISSUE 14) builds programs into the
+    cohort jit cache from a background thread while serving threads get
+    and probe it — without the lock, ``put``'s eviction iterator racing
+    a concurrent ``get``'s pop/re-insert raises "dictionary changed
+    size during iteration" inside a live tick, and ``get``'s transient
+    pop window makes a membership probe miss a present key."""
 
     def __init__(self, maxsize):
+        import threading
+
         self.maxsize = int(maxsize)
         # maxsize < 1 would make put() evict from an empty dict
         # (StopIteration from next(iter({}))) — fail at construction, not
         # at the first insert (ADVICE.md round 5)
         assert self.maxsize >= 1, f"LRUCache maxsize must be >= 1, got {maxsize}"
         self._d = {}
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
+    # pickle support (device_fmin's run cache rides Trials pickles):
+    # locks are process-local, rebuild on load
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def get(self, key, default=None):
         # sentinel, not None: a stored None value must register as a hit
-        v = self._d.pop(key, _LRU_MISS)
-        if v is _LRU_MISS:
-            self.misses += 1
-            return default
-        self.hits += 1
-        self._d[key] = v  # re-insert: most-recently-used at the end
-        return v
+        with self._lock:
+            v = self._d.pop(key, _LRU_MISS)
+            if v is _LRU_MISS:
+                self.misses += 1
+                return default
+            self.hits += 1
+            self._d[key] = v  # re-insert: most-recently-used at the end
+            return v
 
     def put(self, key, value):
-        self._d.pop(key, None)  # overwrite must not evict an extra entry
-        while len(self._d) >= self.maxsize:
-            self._d.pop(next(iter(self._d)))  # evict least-recently-used
-        self._d[key] = value
+        with self._lock:
+            self._d.pop(key, None)  # overwrite must not evict an extra entry
+            while len(self._d) >= self.maxsize:
+                self._d.pop(next(iter(self._d)))  # evict least-recently-used
+            self._d[key] = value
+
+    def contains(self, key):
+        """Non-mutating membership probe: no hit/miss counted, recency
+        untouched (the compile plane's readiness check must not make the
+        probed entry look hot to the eviction policy)."""
+        with self._lock:
+            return key in self._d
 
     def stats(self):
-        return {"hits": self.hits, "misses": self.misses,
-                "size": len(self._d), "maxsize": self.maxsize}
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "size": len(self._d), "maxsize": self.maxsize}
 
     def __len__(self):
         return len(self._d)

@@ -380,8 +380,16 @@ def _ml_logreg_cv():
     def obj(d):
         lr, l2, mom = d["lr"], d["l2"], d["momentum"]
         # folds are a static unroll (4 iterations), each a lax.scan train loop
-        return jnp.mean(jnp.stack([_train_fold(i, lr, l2, mom)
+        loss = jnp.mean(jnp.stack([_train_fold(i, lr, l2, mom)
                                    for i in range(folds)]))
+        # a diverged run (lr high enough that the weights blow up to
+        # inf/NaN) must surface as a FINITE terrible loss, not NaN: NaN
+        # raises InvalidLoss and fails the trial, punching holes in the
+        # posterior exactly where TPE most needs "this region is bad"
+        # evidence (and tripping every all-finite-losses pin).  50 is
+        # ~100x the task's tuned CV logloss — ranked worse than any real
+        # configuration, cheap for the EI split to learn from.
+        return jnp.where(jnp.isfinite(loss), loss, jnp.float32(50.0))
 
     return DomainZoo(
         name="ml_logreg_cv",

@@ -51,6 +51,13 @@
 # every study must finish bit-identical to the undisturbed
 # single-server reference with zero lost and zero duplicated tells and
 # bounded ask p99.
+# Opt-in compile gate: COMPILE_GATE=1 additionally re-runs the
+# cold-start compile-plane suite and then scripts/coldstart_smoke.py —
+# a real subprocess server with the plane armed serves brand-new spaces
+# under concurrent load with no ask ever blocking on an XLA compile
+# (warming rand floor, flagged), promotes them once the background
+# queue drains, and a restart on the same store pre-warms the census
+# kernel bank so the same spaces' first TPE asks are served on-device.
 # Opt-in SLO gate: SLO_GATE=1 additionally re-runs the request-trace /
 # SLO / timeline suites and then scripts/slo_smoke.py — a real
 # subprocess server with tracing + SLO + access log armed serves one
@@ -115,6 +122,12 @@ if [ "${FLEET_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_epoch_leases.py \
         tests/test_service_fleet.py tests/test_membership.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || exit 1
+fi
+if [ "${COMPILE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_compile_plane.py tests/test_service.py \
+        -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/coldstart_smoke.py || exit 1
 fi
 if [ "${SLO_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -88,6 +88,10 @@ DEFAULT_THRESHOLDS = {
     # at the largest replica count, and the shard reclaim/adopt latency
     "fleet_studies_per_sec": 0.35,
     "reclaim_latency_sec": 1.00,
+    # cold-start compile plane (bench.py coldstart stage, ISSUE 14)
+    "cold_study_ask_p99_ms": 1.00,
+    "compile_queue_depth_max": 2.00,
+    "bank_hit_frac": 0.40,
 }
 
 _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
@@ -97,13 +101,16 @@ _TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                  "studies_per_sec", "study_ask_p99_ms",
                  "slot_utilization_frac",
                  "resume_latency_sec", "shed_rate_frac",
-                 "fleet_studies_per_sec", "reclaim_latency_sec")
+                 "fleet_studies_per_sec", "reclaim_latency_sec",
+                 "cold_study_ask_p99_ms", "compile_queue_depth_max",
+                 "bank_hit_frac")
 
 # latency and peak-memory metrics regress UPWARD
 LOWER_IS_BETTER = ("ask_p50_ms", "ask_p95_ms", "ask_p99_ms",
                    "study_ask_p99_ms",
                    "peak_hbm_bytes", "history_bytes",
-                   "resume_latency_sec", "reclaim_latency_sec")
+                   "resume_latency_sec", "reclaim_latency_sec",
+                   "cold_study_ask_p99_ms", "compile_queue_depth_max")
 
 
 def bench_files(root):

@@ -1,0 +1,483 @@
+"""Cold-start compile plane (ISSUE 14): warming admission, background
+compilation, the census kernel bank, and widened cohort programs.
+
+The determinism doctrine carried from ISSUEs 9/10/12: everything the
+plane does must either leave proposals bit-identical (disarmed path,
+bank warms, padding lanes) or be RECORDED so replay regenerates it
+bit-identically (warming asks journal ``algo:"rand"`` exactly like the
+degrade floor).  The warming WINDOW itself is wall-clock dependent (a
+program is ready when XLA finishes), so the tests that need determinism
+pin it with :class:`GatedPlane` — a plane whose readiness answers are a
+deterministic schedule rather than a race against the compiler.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.service.compile_plane import (CompilePlane,
+                                                SignatureCensus,
+                                                census_path_for)
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.spacespec import space_from_spec
+from hyperopt_tpu.spaces import compile_space
+
+WIRE = {"x": {"dist": "uniform", "args": [-3, 3]},
+        "y": {"dist": "loguniform", "args": [-4, 1]}}
+
+CFG = {"prior_weight": 1.0, "n_EI_candidates": 24, "gamma": 0.25,
+       "LF": 25, "ei_select": "argmax", "ei_tau": 1.0, "prior_eps": 0.0}
+
+
+class GatedPlane(CompilePlane):
+    """Deterministic warming window: the first ``n_cold`` readiness
+    probes answer cold (enqueueing as usual); after that the plane
+    drains its queue synchronously before answering, so the schedule of
+    warming-vs-device waves is a pure function of the probe count."""
+
+    def __init__(self, n_cold, census_path=None):
+        super().__init__(census_path=census_path)
+        self.n_cold = n_cold
+
+    def ready_for(self, key, K, job=None, job_factory=None):
+        if self.n_cold > 0:
+            self.n_cold -= 1
+            super().ready_for(key, K, job=job, job_factory=job_factory)
+            return False
+        if not super().ready_for(key, K, job=job,
+                                 job_factory=job_factory):
+            self.drain(timeout=300)
+            return super().ready_for(key, K)
+        return True
+
+
+def drive(sched, sid, n, losses=None, collect=None):
+    losses = losses if losses is not None else iter(
+        float(np.sin(i * 0.73)) for i in range(10 * n))
+    for _ in range(n):
+        answers = sched.ask(sid)
+        if collect is not None:
+            collect.append(answers[0])
+        sched.tell(sid, answers[0]["tid"], loss=next(losses))
+
+
+def trial_vals(sched, sid):
+    st = sched._studies[sid]
+    return [(d["tid"],
+             {k: v[0] for k, v in d["misc"]["vals"].items() if v})
+            for d in st.trials._dynamic_trials]
+
+
+# ---------------------------------------------------------------------------
+# warming semantics
+# ---------------------------------------------------------------------------
+
+
+def test_warming_flagged_then_promoted_at_wave_boundary():
+    plane = GatedPlane(2)
+    sched = StudyScheduler(compile_plane=plane, wave_window=0.0)
+    sid = sched.create_study(space_from_spec(WIRE), seed=3,
+                             n_startup_jobs=1)
+    answers = []
+    drive(sched, sid, 5, collect=answers)
+    # ask 0: startup rand — not warming; asks 1, 2: warming-flagged
+    # rand; asks 3+: promoted TPE (the gate opens at probe 3)
+    assert "warming" not in answers[0]
+    for a in answers[1:3]:
+        assert a["warming"] is True and a["algo"] == "rand"
+    for a in answers[3:]:
+        assert "warming" not in a and "algo" not in a
+    st = sched._studies[sid]
+    assert st.warming is False
+    events = [e["event"] for e in st.events]
+    assert "warming" in events and "promote" in events
+    # promotion happens AT a wave boundary: the promote event carries
+    # the wave the first device tick served
+    promo = next(e for e in st.events if e["event"] == "promote")
+    assert promo["wave"] is not None
+    assert st.status_dict()["warming"] is False
+    plane.stop()
+
+
+def test_warming_asks_journal_algo_rand(tmp_path):
+    plane = GatedPlane(1)
+    sched = StudyScheduler(compile_plane=plane, wave_window=0.0,
+                           store_root=str(tmp_path))
+    sid = sched.create_study(space_from_spec(WIRE), seed=3,
+                             n_startup_jobs=1,
+                             space_spec={"space": WIRE})
+    drive(sched, sid, 3)
+    recs = [r for r in sched.journal.records() if r.get("kind") == "ask"]
+    # ask 0 startup rand, ask 1 warming rand, ask 2 tpe
+    assert [r["algo"] for r in recs] == ["rand", "rand", "tpe"]
+    plane.stop()
+
+
+def test_warming_crash_resume_bit_identical(tmp_path):
+    """The acceptance pin: a warming→crash→resume run replays
+    bit-identically vs an uninterrupted one (same deterministic warming
+    window), with the resumed side's programs warmed from the census
+    bank so its post-resume asks are device-served like the
+    reference's."""
+    def run(root, crash_after=None):
+        sched = StudyScheduler(
+            store_root=root, wave_window=0.0,
+            compile_plane=GatedPlane(2, census_path_for(root)))
+        sid = sched.create_study(space_from_spec(WIRE), seed=5,
+                                 study_id="study-fixed",
+                                 space_spec={"space": WIRE},
+                                 n_startup_jobs=2)
+        losses = iter(float(x) for x in np.sin(np.arange(40) * 0.73))
+        for i in range(8):
+            t = sched.ask(sid)
+            sched.tell(sid, t[0]["tid"], loss=next(losses))
+            if crash_after is not None and i == crash_after:
+                return sched, losses
+        return sched, losses
+
+    ref_root = str(tmp_path / "ref")
+    crash_root = str(tmp_path / "crash")
+    os.makedirs(ref_root), os.makedirs(crash_root)
+    s_ref, _ = run(ref_root)
+    ref = trial_vals(s_ref, "study-fixed")
+    assert any(e["event"] == "warming"
+               for e in s_ref._studies["study-fixed"].events)
+
+    _, losses = run(crash_root, crash_after=5)  # scheduler dropped = crash
+    plane = CompilePlane(census_path=census_path_for(crash_root))
+    warmed, _ = plane.warm_from_census()
+    assert warmed >= 1  # the census round-tripped the cohort key
+    resumed = StudyScheduler(store_root=crash_root, wave_window=0.0,
+                             compile_plane=plane)
+    assert "study-fixed" in resumed._studies
+    post = []
+    for _ in range(6, 8):
+        t = resumed.ask("study-fixed")
+        post.append(t[0])
+        resumed.tell("study-fixed", t[0]["tid"], loss=next(losses))
+    # bank-warmed: the resumed side never re-enters warming
+    assert not any(a.get("warming") for a in post)
+    assert ref == trial_vals(resumed, "study-fixed")
+    plane.stop()
+
+
+def test_disarmed_scheduler_has_no_plane_and_no_thread():
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    sched = StudyScheduler(wave_window=0.0)
+    assert sched.compile_plane is None
+    sid = sched.create_study({"x": hp.uniform("x", 0, 1)}, seed=0,
+                             n_startup_jobs=1)
+    drive(sched, sid, 3)
+    after = {t.name for t in threading.enumerate()}
+    assert not any("compile-plane" in n for n in after - before)
+
+
+def test_replay_bypasses_warming_gate(tmp_path):
+    """A WAL record that says "tpe" must regenerate through tpe even on
+    a stone-cold plane — replay compiles synchronously, it never
+    substitutes the rand floor (that would fork the proposal stream)."""
+    root = str(tmp_path)
+    sched = StudyScheduler(store_root=root, wave_window=0.0,
+                           compile_plane=GatedPlane(1, None))
+    sid = sched.create_study(space_from_spec(WIRE), seed=9,
+                             n_startup_jobs=1,
+                             space_spec={"space": WIRE})
+    drive(sched, sid, 4)
+    ref = trial_vals(sched, sid)
+    # wipe the per-study store so replay must REGENERATE the asks, on a
+    # fresh scheduler whose plane reports everything cold forever
+    import shutil
+
+    shutil.rmtree(os.path.join(root, sid))
+
+    class NeverReady(CompilePlane):
+        def ready_for(self, key, K, job=None, job_factory=None):
+            return False
+
+    resumed = StudyScheduler(store_root=root, wave_window=0.0,
+                             compile_plane=NeverReady())
+    assert trial_vals(resumed, sid) == ref
+
+
+# ---------------------------------------------------------------------------
+# census + kernel bank
+# ---------------------------------------------------------------------------
+
+
+def test_census_appends_and_aggregates(tmp_path):
+    path = str(tmp_path / "census.jsonl")
+    c = SignatureCensus(path)
+    for _ in range(10):
+        c.note({"space": WIRE}, CFG, 16, 1, 1)
+    c.note({"zoo": "quadratic1"}, CFG, 16, 2, 1)
+    c.note(None, CFG, 16, 1, 1)  # unresumable: never recorded
+    entries = SignatureCensus(path).read()
+    assert len(entries) == 2
+    # most-used first, max count wins across milestone appends
+    assert entries[0]["spec"] == {"space": WIRE}
+    assert entries[0]["count"] == 8  # milestones 1 and 8 appended
+    assert entries[1]["spec"] == {"zoo": "quadratic1"}
+
+
+def test_census_write_failure_is_nonfatal(tmp_path):
+    c = SignatureCensus(str(tmp_path / "no" / "such" / "dir" / "c.jsonl"))
+    for _ in range(3):
+        c.note({"space": WIRE}, CFG, 16, 1, 1)  # warns once, never raises
+    assert SignatureCensus(c.path).read() == []
+
+
+def test_bank_warm_marks_ready_without_live_traffic(tmp_path):
+    path = str(tmp_path / "census.jsonl")
+    SignatureCensus(path).note({"space": WIRE}, CFG, 16, 1, 1)
+    plane = CompilePlane(census_path=path)
+    warmed, enqueued = plane.warm_from_census(top_n=8)
+    assert (warmed, enqueued) == (1, 0)
+    cs = compile_space(space_from_spec(WIRE))
+    key, _ = plane.make_job(cs, {"space": WIRE}, CFG, 1, 16, 1,
+                            donate=tpe._donation_enabled())
+    assert plane.ready_for(key, 1) is True
+    assert plane.bank_stats() == {"keys": 1, "hits": 1}
+    plane.stop()
+
+
+def test_ready_demotes_on_lru_eviction(tmp_path):
+    """An LRU-evicted program must demote to warming (re-enqueue), not
+    let the next tick compile synchronously on the serving path."""
+    plane = CompilePlane()
+    # a signature no other test (or suite in this process) compiles, so
+    # the cohort LRU genuinely lacks it
+    cs = compile_space({"zz": hp.uniform("zz", -3.123, 3.077)})
+    key, job = plane.make_job(cs, None, CFG, 1, 16, 1, donate=True)
+    plane.mark_ready(key, 1)
+    # the program is NOT in the cohort LRU (never built): readiness
+    # must answer False and re-enqueue
+    assert not tpe.cohort_cache_contains(key)
+    assert plane.ready_for(key, 1, job=job) is False
+    plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# widened cohort programs
+# ---------------------------------------------------------------------------
+
+
+def _mk_history(cs, cap=16, n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    hist = {
+        "vals": {l: np.zeros(cap, np.float32) for l in cs.labels},
+        "active": {l: np.zeros(cap, bool) for l in cs.labels},
+        "losses": np.full(cap, np.inf, np.float32),
+        "has_loss": np.zeros(cap, bool),
+    }
+    for i in range(n):
+        for l in cs.labels:
+            fam = cs.params[l].dist.family
+            if fam in ("randint", "uniformint", "categorical"):
+                hist["vals"][l][i] = rng.integers(0, 3)
+            else:
+                hist["vals"][l][i] = abs(rng.standard_normal()) + 0.01
+            hist["active"][l][i] = True
+        hist["losses"][i] = rng.standard_normal()
+        hist["has_loss"][i] = True
+    return hist
+
+
+WIDE_SPACE = {
+    "lr": hp.loguniform("lr", -5, 0),
+    "l2": hp.loguniform("l2", -8, 0),
+    "mom": hp.uniform("mom", 0.0, 0.98),
+    "n": hp.normal("n", 0.0, 1.0),
+    "layers": hp.randint("layers", 1, 5),
+    "opt": hp.choice("opt", [0, 1, 2]),
+}
+
+
+def test_widened_profile_identity_and_compatibility():
+    cs = compile_space(WIDE_SPACE)
+    prof_a = tpe.widened_profile(cs)
+    assert prof_a is not None
+    # a DIFFERENT space with the same shape multiset (other labels,
+    # other bounds, other declaration order) shares the profile — that
+    # is the program-sharing contract
+    cs_b = compile_space({
+        "w": hp.uniform("w", -9, 9),
+        "a": hp.loguniform("a", -2, 2),
+        "b": hp.loguniform("b", -1, 0),
+        "g": hp.normal("g", 5.0, 2.0),
+        "k": hp.randint("k", 10, 14),
+        "c": hp.choice("c", ["x", "y", "z"]),
+    })
+    prof_b = tpe.widened_profile(cs_b)
+    assert prof_a[0] == prof_b[0]
+    assert (tpe.cohort_key_wide(prof_a[0], CFG, 1, 16, 1)
+            == tpe.cohort_key_wide(prof_b[0], CFG, 1, 16, 1))
+    # conditional spaces cannot widen
+    cond = compile_space(hp.choice("arch", [
+        {"width": hp.uniformint("width", 1, 8)}, {"fixed": 3}]))
+    assert tpe.widened_profile(cond) is None
+
+
+def test_widened_propose_bitwise_vs_group_all_jit():
+    """The widening pin: the profile-keyed program (params + hashes as
+    runtime inputs, positional slots, padding lanes) proposes BIT-
+    IDENTICALLY to the unwidened grouped pipeline (``group="all"``)
+    under jit — traced statics change nothing, padding lanes touch
+    nothing."""
+    cs = compile_space(WIDE_SPACE)
+    profile, slots = tpe.widened_profile(cs)
+    wp = tpe.widened_params(cs, profile, slots)
+    hist = _mk_history(cs)
+    key = jax.random.PRNGKey(7)
+
+    ref = jax.jit(tpe.build_propose(cs, CFG, group="all"))(
+        {"vals": {l: jnp.asarray(hist["vals"][l]) for l in cs.labels},
+         "active": {l: jnp.asarray(hist["active"][l])
+                    for l in cs.labels},
+         "losses": jnp.asarray(hist["losses"]),
+         "has_loss": jnp.asarray(hist["has_loss"])}, key)
+
+    W = sum(e[-1] for e in profile)
+    cap = 16
+    vals_w = np.zeros((W, cap), np.float32)
+    act_w = np.zeros((W, cap), bool)
+    pos = {}
+    off = 0
+    for entry, ls in zip(profile, slots):
+        for i, l in enumerate(ls):
+            pos[l] = off + i
+            vals_w[off + i] = hist["vals"][l]
+            act_w[off + i] = hist["active"][l]
+        off += entry[-1]
+    out = np.asarray(jax.jit(tpe.build_propose_wide(profile, CFG))(
+        {"vals": jnp.asarray(vals_w), "active": jnp.asarray(act_w),
+         "losses": jnp.asarray(hist["losses"]),
+         "has_loss": jnp.asarray(hist["has_loss"])},
+        jax.tree_util.tree_map(jnp.asarray, wp), key))
+    for l in cs.labels:
+        assert np.array_equal(np.float32(np.asarray(ref[l])),
+                              np.float32(out[pos[l]])), l
+
+
+def test_widened_padding_invariance():
+    """The space-padding extension of the cap-invariance pin: widening a
+    group's slot axis with EXTRA inert lanes leaves every real label's
+    proposal bitwise unchanged (vmap lanes are independent; padding
+    outputs are discarded)."""
+    cs = compile_space({"a": hp.uniform("a", -1, 1),
+                        "b": hp.uniform("b", 0, 5)})
+    profile, slots = tpe.widened_profile(cs)
+    assert profile == (("num", False, True, 2),)
+    hist = _mk_history(cs)
+    key = jax.random.PRNGKey(11)
+    cap = 16
+
+    def run_with(profile_w):
+        wp = tpe.widened_params(cs, profile_w, slots)
+        W = profile_w[0][-1]
+        vals_w = np.zeros((W, cap), np.float32)
+        act_w = np.zeros((W, cap), bool)
+        for i, l in enumerate(slots[0]):
+            vals_w[i] = hist["vals"][l]
+            act_w[i] = hist["active"][l]
+        return np.asarray(jax.jit(
+            tpe.build_propose_wide(profile_w, CFG))(
+            {"vals": jnp.asarray(vals_w), "active": jnp.asarray(act_w),
+             "losses": jnp.asarray(hist["losses"]),
+             "has_loss": jnp.asarray(hist["has_loss"])},
+            jax.tree_util.tree_map(jnp.asarray, wp), key))[:2]
+
+    tight = run_with((("num", False, True, 2),))
+    padded = run_with((("num", False, True, 8),))  # 6 inert lanes
+    assert np.array_equal(tight, padded)
+
+
+def test_widened_cohort_end_to_end_shares_programs():
+    """Through the real scheduler: two compatible spaces tick through
+    ONE compiled widened program (zero extra cohort-cache misses for
+    the second), each study deterministic across repeat runs."""
+    space_a = {"lr": hp.loguniform("lr", -5, 0),
+               "mom": hp.uniform("mom", 0, 1)}
+    space_b = {"alpha": hp.loguniform("alpha", -3, -1),
+               "beta": hp.uniform("beta", -2, 2)}
+
+    def drive_widened(space, seed):
+        sched = StudyScheduler(wave_window=0.0, widen=True)
+        sid = sched.create_study(space, seed=seed, n_startup_jobs=2)
+        out = []
+        for i in range(6):
+            t = sched.ask(sid)
+            out.append(t[0]["params"])
+            sched.tell(sid, t[0]["tid"], loss=float(np.sin(i * 1.7)))
+        return out
+
+    v1 = drive_widened(space_a, 7)
+    v2 = drive_widened(space_a, 7)
+    assert v1 == v2
+    m0 = tpe.cohort_cache_stats()["misses"]
+    drive_widened(space_b, 11)  # compatible: reuses space_a's program
+    assert tpe.cohort_cache_stats()["misses"] == m0
+
+
+def test_widen_defaults_off_and_env_arms(monkeypatch):
+    sched = StudyScheduler(wave_window=0.0)
+    assert sched.widen is False
+    monkeypatch.setenv("HYPEROPT_TPU_COMPILE_WIDEN", "1")
+    sched2 = StudyScheduler(wave_window=0.0)
+    assert sched2.widen is True
+
+
+# ---------------------------------------------------------------------------
+# scrape-plane visibility (the cache-counter satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_gauges_on_metrics_and_snapshot():
+    from hyperopt_tpu.obs.serve import prometheus_text
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    plane = GatedPlane(1)
+    sched = StudyScheduler(compile_plane=plane, wave_window=0.0)
+    server = ServiceHTTPServer(0, scheduler=sched, slo=False)
+    sid = sched.create_study(space_from_spec(WIRE), seed=3,
+                             n_startup_jobs=1)
+    drive(sched, sid, 3)
+    snap = server.snapshot_dict()
+    assert snap["compile"]["compiled"] >= 1
+    assert snap["compile"]["warming_studies"] == 0
+    assert "hits" in snap["cohort_cache"] and "hits" in snap["jit_cache"]
+    server._refresh_compile_gauges()
+    text = prometheus_text()
+    for family in ("service_compile_cohort_cache_hits",
+                   "service_compile_jit_cache_size",
+                   "service_compile_warming_studies",
+                   "service_compile_queue_depth"):
+        assert family in text, family
+    # the /ask response carries the warming flag over the wire shape
+    status, payload = server.handle("POST", "/ask", {"study_id": sid})
+    assert status == 200 and "warming" not in payload
+    plane.stop()
+
+
+def test_pre_issue14_wal_resumes_unchanged(tmp_path):
+    """A journal with no ISSUE-14-era traffic (no warming records, no
+    census) resumes bit-identically on a plane-armed scheduler — the
+    plane only ever gates LIVE dispatch."""
+    root = str(tmp_path)
+    sched = StudyScheduler(store_root=root, wave_window=0.0)
+    sid = sched.create_study(space_from_spec(WIRE), seed=13,
+                             n_startup_jobs=1,
+                             space_spec={"space": WIRE})
+    drive(sched, sid, 4)
+    ref = trial_vals(sched, sid)
+    resumed = StudyScheduler(store_root=root, wave_window=0.0,
+                             compile_plane=CompilePlane())
+    assert trial_vals(resumed, sid) == ref
+    resumed.compile_plane.stop()

@@ -323,13 +323,25 @@ def test_grouped_sampler_bitwise_matches_unrolled():
             ref = draw_dist(info.dist, jax.random.fold_in(key, label_hash(label)))
             assert np.array_equal(np.asarray(ref), np.asarray(grouped[label])), label
 
+    # under jit+vmap (the rand suggest kernel's shape) the reference must
+    # be the UNROLLED sampler in the SAME compilation context: XLA fuses
+    # `mu + sigma * x` into an fma inside a jitted program but not across
+    # eager per-op dispatches, so eager-vs-jit comparisons of the normal
+    # families differ in the last ulp (an XLA codegen property, not a
+    # sampler property — the eager-vs-eager loop above already pins the
+    # grouped/unrolled agreement there).  Grouped vs unrolled inside one
+    # jit IS bitwise: same fold_in keys, same formulas, same fusion.
+    def unrolled_flat(key):
+        return {l: draw_dist(cs.params[l].dist,
+                             jax.random.fold_in(key, label_hash(l)))
+                for l in cs.labels}
+
     keys = jax.vmap(
         lambda i: jax.random.fold_in(jax.random.PRNGKey(0), i)
     )(jnp.arange(4, dtype=jnp.uint32))
     outj = jax.jit(jax.vmap(cs.sample_flat))(keys)
+    refj = jax.jit(jax.vmap(unrolled_flat))(keys)
     for j in range(4):
-        for label, info in cs.params.items():
-            ref = draw_dist(info.dist,
-                            jax.random.fold_in(keys[j], label_hash(label)))
-            assert np.array_equal(np.asarray(ref),
+        for label in cs.params:
+            assert np.array_equal(np.asarray(refj[label][j]),
                                   np.asarray(outj[label][j])), (j, label)
