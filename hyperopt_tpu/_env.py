@@ -36,6 +36,8 @@ __all__ = [
     "parse_reqtrace",
     "parse_service_access_log",
     "parse_service_slo",
+    "parse_store_watermark",
+    "parse_store_gc",
 ]
 
 logger = logging.getLogger(__name__)
@@ -577,6 +579,49 @@ def parse_fleet_addr(env=None):
     if raw.lower() in ("", "0", "off", "false", "no"):
         return None
     return raw.rstrip("/")
+
+
+# -- storage-integrity knobs (ISSUE 15) -------------------------------------
+
+DEFAULT_STORE_WATERMARK = 0.02
+
+
+def parse_store_watermark(env=None):
+    """``HYPEROPT_TPU_STORE_WATERMARK`` → the low-disk threshold that
+    trips the space-pressure degrade rung (compact quiescent WALs, run
+    bounded store GC, then shed asks with 507 until space returns):
+
+    * unset → the default (free fraction below 0.02);
+    * a value in ``(0, 1)`` → minimum free FRACTION of the filesystem;
+    * a value ``>= 1`` → minimum free BYTES;
+    * ``0`` / ``off`` → disarmed (gauges still publish at scrape time).
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_STORE_WATERMARK", "").strip()
+    if not raw:
+        return DEFAULT_STORE_WATERMARK
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_STORE_WATERMARK", raw,
+                   "a free fraction in (0,1), a byte count, or 0/off")
+        return DEFAULT_STORE_WATERMARK
+    if v <= 0:
+        return None
+    return v
+
+
+def parse_store_gc(env=None):
+    """``HYPEROPT_TPU_STORE_GC`` → whether the disk-watermark degrade
+    rung may run the bounded store GC (settle-superseded doc copies,
+    stale tmp files, expired flight dumps, compaction-superseded
+    ancestor epoch WALs) before shedding.  Default on; ``0``/``off``
+    disables GC (the rung still compacts WALs and sheds)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_STORE_GC", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
 
 
 _CACHE_CONFIGURED = False

@@ -15,6 +15,14 @@ the same fail-open convention as every observability env var)::
     term@<site>:<n>         SIGTERM on the n-th hit (flight recorder dumps)
     ioerr@<site>:<p>        raise OSError with probability p per hit
     stall@<site>:<p>:<sec>  sleep <sec> seconds with probability p per hit
+    enospc@<site>:<p>       raise OSError(ENOSPC) with probability p per
+                            hit (io sites only — the disk-full analog of
+                            ioerr; ISSUE 15's backpressure gate drives it)
+    corrupt@<site>:<p>      flip ONE seeded bit in the just-written record
+                            with probability p (``corrupt_bytes`` sites —
+                            the WAL append; the write SUCCEEDS, the medium
+                            lies: what the checksum/quarantine plane must
+                            catch at the next replay or scrub)
 
 Sites are plain strings named by the instrumented call sites:
 
@@ -62,11 +70,11 @@ import signal
 import time
 
 __all__ = ["ChaosPlan", "parse_spec", "get_plan", "configure", "armed",
-           "point", "io_point"]
+           "point", "io_point", "corrupt_bytes"]
 
 logger = logging.getLogger(__name__)
 
-_ACTIONS = ("kill", "term", "ioerr", "stall")
+_ACTIONS = ("kill", "term", "ioerr", "stall", "enospc", "corrupt")
 
 _UNSET = object()
 _plan = _UNSET  # lazily resolved from the environment on first use
@@ -120,17 +128,38 @@ class ChaosPlan:
         sites never do (an OSError can only escape where the caller
         expects filesystem failure)."""
         due = []
-        matched = [r for r in self.rules if r.site == site]
+        # corrupt rules never fire at point()/io_point(): they mutate a
+        # payload, not control flow — corrupt_bytes() owns them (its own
+        # hit counter, so mixed rules at one site stay deterministic)
+        matched = [r for r in self.rules
+                   if r.site == site and r.action != "corrupt"]
         if not matched:
             return due
         n = self.hits.get(site, 0) + 1
         self.hits[site] = n
         for r in matched:
-            if r.action == "ioerr" and not io:
+            if r.action in ("ioerr", "enospc") and not io:
                 continue
             if r.fires(n):
                 due.append((r.action,) if r.sec is None else (r.action, r.sec))
         return due
+
+    def mutate_rule(self, site):
+        """The corrupt rule due at this ``corrupt_bytes`` hit, or None.
+        Separate hit counter (``<site>!corrupt``): the mutate probe runs
+        on a different cadence than point()/io_point() at the same
+        site, and sharing one counter would skew both schedules."""
+        matched = [r for r in self.rules
+                   if r.site == site and r.action == "corrupt"]
+        if not matched:
+            return None
+        key = f"{site}!corrupt"
+        n = self.hits.get(key, 0) + 1
+        self.hits[key] = n
+        for r in matched:
+            if r.fires(n):
+                return r
+        return None
 
 
 def parse_spec(raw):
@@ -166,7 +195,7 @@ def parse_spec(raw):
                     raise ValueError
                 rules.append(_Rule(action, site, count=int(args[0]),
                                    seed=seed, text=part))
-            elif action == "ioerr":
+            elif action in ("ioerr", "enospc", "corrupt"):
                 if len(args) != 1:
                     raise ValueError
                 rules.append(_Rule(action, site, prob=float(args[0]),
@@ -247,6 +276,12 @@ def _execute(site, actions, metrics):
         elif name == "ioerr":
             logger.warning("chaos: injected I/O error at %s", site)
             raise OSError(f"chaos: injected I/O error at {site}")
+        elif name == "enospc":
+            import errno
+
+            logger.warning("chaos: injected ENOSPC at %s", site)
+            raise OSError(errno.ENOSPC,
+                          f"chaos: injected ENOSPC at {site}")
 
 
 def point(site, metrics=None):
@@ -260,10 +295,44 @@ def point(site, metrics=None):
 
 
 def io_point(site="io", metrics=None):
-    """A filesystem chaos site: like :func:`point`, but ``ioerr`` rules
-    RAISE ``OSError`` here — callers are the store paths whose error
-    handling the chaos gate exists to exercise."""
+    """A filesystem chaos site: like :func:`point`, but ``ioerr`` and
+    ``enospc`` rules RAISE ``OSError`` here — callers are the store
+    paths whose error handling the chaos gate exists to exercise."""
     plan = _plan if _plan is not _UNSET else get_plan()
     if plan is None:
         return
     _execute(site, plan.check(site, io=True), metrics)
+
+
+def corrupt_bytes(site, data, metrics=None):
+    """A payload-mutation chaos site (ISSUE 15): when a ``corrupt`` rule
+    is due, flip ONE seeded bit in ``data`` (never the trailing
+    newline — the line framing must survive so the corruption lands
+    MID-file, the case the torn-tail reader cannot excuse) and return
+    the mutated copy; otherwise ``data`` unchanged.  Disarmed cost: one
+    attribute check.  Deterministic: the flip position draws from the
+    rule's own seeded stream, one draw per fired hit."""
+    plan = _plan if _plan is not _UNSET else get_plan()
+    if plan is None:
+        return data
+    rule = plan.mutate_rule(site)
+    if rule is None:
+        return data
+    n = len(data) - (1 if data.endswith(b"\n") else 0)
+    if n <= 0:
+        return data
+    pos = rule.rng.randrange(n * 8)
+    out = bytearray(data)
+    out[pos // 8] ^= 1 << (pos % 8)
+    if metrics is not None:
+        metrics.counter(f"chaos.corrupt.{site}").inc()
+    try:
+        from .obs.flight import get_flight
+
+        get_flight().record({"kind": "chaos", "ts": time.time(),
+                             "action": "corrupt", "site": site,
+                             "bit": pos, "pid": os.getpid()})
+    except Exception:
+        pass
+    logger.warning("chaos: flipped bit %d in a %s record", pos, site)
+    return bytes(out)

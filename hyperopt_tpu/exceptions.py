@@ -44,6 +44,17 @@ class FleetDegraded(HyperoptTpuError):
     half of the preemption story (docs/DESIGN.md §15)."""
 
 
+class StoreFullError(OSError):
+    """The backing filesystem refused a durable write for lack of space
+    (``ENOSPC``/``EDQUOT``) — RETRYABLE: the store-integrity plane
+    (docs/DESIGN.md §21) sheds load, compacts WALs and GCs the store,
+    and the write succeeds once space frees.  Subclasses ``OSError`` so
+    pre-ISSUE-15 handlers that absorb store I/O failures keep working;
+    typed so the serving path can answer 507 + ``Retry-After`` instead
+    of a generic 500, and the worker/executor retry path can back off
+    instead of burning its budget on a full disk."""
+
+
 class StaleHistoryError(HyperoptTpuError):
     """Raised when a device-resident trial history is touched after its
     buffers were DONATED to a fused tell+ask dispatch and the program's

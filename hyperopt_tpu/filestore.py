@@ -36,6 +36,7 @@ analog — see ``worker.py``.
 
 from __future__ import annotations
 
+import errno
 import fcntl
 import logging
 import os
@@ -44,6 +45,7 @@ import threading
 import time
 
 from . import chaos
+from .exceptions import StoreFullError
 from .retry import RetryPolicy
 from .base import (
     JOB_STATE_CANCEL,
@@ -67,9 +69,13 @@ from .obs.events import (
     load_events,
 )
 
-__all__ = ["FileStore", "FileTrials", "ReserveTimeout", "new_run_id"]
+__all__ = ["FileStore", "FileTrials", "ReserveTimeout", "StoreFullError",
+           "new_run_id"]
 
 logger = logging.getLogger(__name__)
+
+#: "no space" errnos translated to the typed, retryable StoreFullError
+_ENOSPC_ERRNOS = {errno.ENOSPC, getattr(errno, "EDQUOT", errno.ENOSPC)}
 
 _STATE_DIRS = {
     JOB_STATE_NEW: "new",
@@ -100,19 +106,30 @@ _RESERVE_BACKOFF = RetryPolicy(max_retries=0, base_delay=0.001,
 
 
 def _atomic_write(path, payload: bytes):
-    # deterministic fault injection (HYPEROPT_TPU_CHAOS ioerr@io:<p>):
-    # every durable write in the store — docs, heartbeats, attachments,
-    # checkpoints, fleet results — shares this one failure point, which is
-    # exactly the surface a flaky NFS/GCS-fuse mount presents
+    # deterministic fault injection (HYPEROPT_TPU_CHAOS ioerr@io:<p> /
+    # enospc@io:<p>): every durable write in the store — docs,
+    # heartbeats, attachments, checkpoints, fleet results — shares this
+    # one failure point, which is exactly the surface a flaky
+    # NFS/GCS-fuse mount (or a full disk) presents
     chaos.io_point("io")
     # pid AND thread id: two same-process threads writing the same target
     # (a heartbeat thread racing the claim path, concurrent reclaim+cancel)
     # would otherwise share one tmp name — the loser's os.replace then
     # crashes on the winner's already-consumed tmp file
     tmp = f"{path}.tmp.{_claim_suffix()}"
-    with open(tmp, "wb") as f:
-        f.write(payload)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except OSError as e:
+        _remove_quiet(tmp)
+        if getattr(e, "errno", None) in _ENOSPC_ERRNOS:
+            # typed + retryable (ISSUE 15): a full disk is a transient
+            # STATE, not a store bug — the serving plane sheds with 507,
+            # the worker/executor backs off and retries
+            raise StoreFullError(
+                e.errno, f"store write failed, disk full: {path}") from e
+        raise
 
 
 def _touch(path):
@@ -725,6 +742,72 @@ class FileStore:
                              from_state=_STATE_DIRS[state])
             return True
         return False
+
+    # -- store hygiene (ISSUE 15: the space-pressure degrade rung) ---------
+
+    def gc(self, tmp_max_age=300.0, flight_max_age=7 * 86400.0):
+        """Bounded garbage collection: reclaim bytes that are provably
+        redundant without touching any live trial state.
+
+        * ``new``/``running`` copies SUPERSEDED by a terminal doc (the
+          tell path settles NEW→DONE and drops them eagerly, but a
+          crash between the write and the drop leaves them for state
+          precedence to hide forever);
+        * precedence-loser terminal duplicates
+          (:meth:`_prune_terminal_duplicates`);
+        * ``*.tmp.*`` atomic-write leftovers of dead writers, once
+          older than ``tmp_max_age`` (a LIVE write's tmp file exists
+          for milliseconds);
+        * flight-recorder crash dumps older than ``flight_max_age``
+          (forensics age out; ``*.quarantined`` evidence never does).
+
+        Returns ``{reclaimed_bytes, removed}``.  Every removal is
+        tolerant of concurrent writers — losing a race to a path that
+        vanished is a no-op, exactly like the claim machinery."""
+        stats = {"reclaimed_bytes": 0, "removed": 0}
+
+        def rm(path):
+            try:
+                size = os.path.getsize(path)
+                os.remove(path)
+            except OSError:
+                return
+            stats["removed"] += 1
+            stats["reclaimed_bytes"] += size
+
+        now = time.time()
+        self._prune_terminal_duplicates()
+        for state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+            d = os.path.join(self.root, _STATE_DIRS[state])
+            for fname in os.listdir(d):
+                if fname.endswith(".pkl") and self._settled(fname[:-4]):
+                    rm(os.path.join(d, fname))
+        for d in ("attachments", *_STATE_DIRS.values()):
+            dirpath = os.path.join(self.root, d)
+            for fname in os.listdir(dirpath):
+                if ".tmp." not in fname:
+                    continue
+                path = os.path.join(dirpath, fname)
+                try:
+                    if now - os.path.getmtime(path) > tmp_max_age:
+                        rm(path)
+                except OSError:
+                    continue
+        att = os.path.join(self.root, "attachments")
+        for fname in os.listdir(att):
+            if (fname.startswith(_FLIGHT_PREFIX)
+                    and fname.endswith(".jsonl")):
+                path = os.path.join(att, fname)
+                try:
+                    if now - os.path.getmtime(path) > flight_max_age:
+                        rm(path)
+                except OSError:
+                    continue
+        if stats["removed"]:
+            self.metrics.counter("gc.removed").inc(stats["removed"])
+            self.metrics.counter("gc.reclaimed_bytes").inc(
+                stats["reclaimed_bytes"])
+        return stats
 
 
 class FileTrials(Trials):

@@ -460,6 +460,67 @@ def _service_section(metrics, out):
     _slo_lines(metrics, out)
 
 
+def _storage_section(metrics, out):
+    """Storage integrity (ISSUE 15): checksum verification traffic,
+    quarantines with reasons, disk watermarks, GC reclaim and the
+    ENOSPC shed state — rendered only when the stream recorded any
+    integrity/store metric (a healthy in-memory run keeps its report
+    unchanged)."""
+    keys = {k: v for k, v in metrics.items()
+            if k.startswith(("service.integrity.", "store.",
+                             "service.shed.store_full",
+                             "scrub."))}
+    if not keys:
+        return
+    out.append("")
+    out.append("== storage integrity " + "=" * 43)
+    verified = int(keys.get("service.integrity.verified", 0))
+    unchecked = int(keys.get("service.integrity.unchecked", 0))
+    corrupt = int(keys.get("service.integrity.corrupt_records", 0))
+    torn = int(keys.get("service.integrity.torn", 0))
+    if verified or unchecked or corrupt or torn:
+        out.append(f"  checksums  verified {verified}"
+                   f"  unchecked(pre-15) {unchecked}"
+                   f"  torn-tail {torn}  corrupt {corrupt}")
+    quarantines = int(keys.get("service.integrity.quarantines", 0))
+    if quarantines or corrupt:
+        out.append(
+            f"  quarantine studies {quarantines}"
+            f"  records-skipped "
+            f"{int(keys.get('service.integrity.quarantine_skipped', 0))}"
+            f"  snapshot-recovered "
+            f"{int(keys.get('service.integrity.snapshot_recovered', 0))}"
+            f"  unattributed "
+            f"{int(keys.get('service.integrity.corrupt_unattributed', 0))}")
+        if quarantines:
+            out.append("  QUARANTINED: corrupt studies answer 410 — "
+                       "run `python -m hyperopt_tpu.service.scrub "
+                       "<root> --repair`")
+    free = keys.get("store.free_bytes")
+    if free is not None:
+        used = float(keys.get("store.used_frac", 0.0) or 0.0)
+        line = (f"  disk       free {_fmt_bytes(float(free))}"
+                f"  used {used:.1%}")
+        if keys.get("store.full"):
+            line += "  STORE-FULL (shedding 507)"
+        out.append(line)
+    shed = int(keys.get("service.shed.store_full", 0))
+    enospc = int(keys.get("store.enospc_errors", 0))
+    if shed or enospc:
+        out.append(f"  enospc     sheds {shed}  append-errors {enospc}")
+    gc_bytes = keys.get("store.gc.reclaimed_bytes")
+    if gc_bytes is not None:
+        out.append(
+            f"  gc         runs {int(keys.get('store.gc.runs', 0))}"
+            f"  reclaimed {_fmt_bytes(float(gc_bytes))}")
+    scrub_recs = keys.get("scrub.records")
+    if scrub_recs is not None:
+        out.append(
+            f"  scrub      records {int(scrub_recs)}"
+            f"  corrupt {int(keys.get('scrub.corrupt', 0))}"
+            f"  repaired {int(keys.get('scrub.repaired', 0))}")
+
+
 def _slo_lines(metrics, out):
     """SLO error-budget lines (ISSUE 11): one row per objective from the
     ``slo.*`` gauges, budget bar + fast/slow burn rates, with the
@@ -816,6 +877,7 @@ def render(records, top=5):
     _pipeline_section(spans, _last_snapshot_metrics(records), out)
     _resilience_section(_last_snapshot_metrics(records), out)
     _service_section(_last_snapshot_metrics(records), out)
+    _storage_section(_last_snapshot_metrics(records), out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)
     out.append("")

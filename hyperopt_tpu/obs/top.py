@@ -263,6 +263,30 @@ def _render_service_source(name, snap, out, w):
         if fleet.get("draining"):
             fline += "  DRAINING"
         out.append(fline)
+    # the STORE row (ISSUE 15): disk watermark, store-full shed state,
+    # quarantined studies and GC reclaim — the storage-integrity plane
+    # at a glance, from /snapshot's store section
+    store = snap.get("store")
+    if store and (store.get("free_bytes") is not None
+                  or store.get("store_full")
+                  or store.get("quarantined")):
+        sline = f"  {'':<{w}}  STORE "
+        free = store.get("free_bytes")
+        if free is not None:
+            gb = float(free) / 1e9
+            sline += (f" free {gb:.1f}G"
+                      f"  used {float(store.get('used_frac', 0)):.0%}")
+        if store.get("store_full"):
+            sline += "  FULL (507 shed)"
+        elif store.get("low"):
+            sline += "  LOW"
+        q = int(store.get("quarantined") or 0)
+        if q:
+            sline += f"  QUARANTINED {q}"
+        gc = store.get("gc") or {}
+        if gc.get("reclaimed_bytes"):
+            sline += f"  gc {gc['reclaimed_bytes'] / 1e6:.1f}M"
+        out.append(sline)
     degrade = snap.get("degrade")
     if degrade and (degrade.get("level") or degrade.get("faults")):
         out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"

@@ -167,6 +167,25 @@ KEY_DIRECTIONS = {
     # Near 1.0 when the census round-trips; a collapse toward 0 means
     # the bank stopped matching live cohort keys.
     "bank_hit_frac": {"direction": "higher", "threshold": 0.40},
+    # WAL checksum overhead on the serving path (bench.py
+    # store_integrity stage, ISSUE 15): relative min-of-reps
+    # wall-clock delta of real ask+tell round loops through handle()
+    # with sealed records vs the checksum-disabled baseline.  The seal
+    # is a constant per-record cost (never tail-concentrated), so this
+    # mean-side bound bounds its study_ask_p99_ms contribution too.
+    # Absolute fixed bar at the acceptance criterion: within 5% or the
+    # CRC is too hot for the hot path.
+    "checksum_overhead_frac": {"direction": "lower", "threshold": 0.05,
+                               "absolute": True},
+    # bytes the bounded store GC reclaimed from the stage's seeded
+    # garbage (superseded copies, stale tmps, expired dumps).  The
+    # stage plants a known-size garbage set, so a collapse means the
+    # GC stopped finding it, not that the workload shrank.
+    "gc_reclaimed_bytes": {"direction": "higher", "threshold": 0.50},
+    # offline scrub throughput over the stage's WAL (records/sec).
+    # Loose bar: the scan is pure-Python CRC; a collapse means the
+    # verifier went accidentally quadratic.
+    "scrub_records_per_sec": {"direction": "higher", "threshold": 0.50},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -183,7 +202,9 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "resume_latency_sec", "shed_rate_frac",
                 "fleet_studies_per_sec", "reclaim_latency_sec",
                 "cold_study_ask_p99_ms", "compile_queue_depth_max",
-                "bank_hit_frac")
+                "bank_hit_frac",
+                "checksum_overhead_frac", "gc_reclaimed_bytes",
+                "scrub_records_per_sec")
 
 
 def trajectory_path(root=None):

@@ -16,20 +16,30 @@ SIGKILLs and rolling restarts with zero lost tells.
 serving path: cold cohort keys are served at a flagged warming rand
 floor while one background thread compiles, and a census-driven kernel
 bank pre-warms common keys before the listener opens on restart.
+``service/integrity.py`` + ``service/scrub.py`` (ISSUE 15) are the
+storage-integrity survival plane: CRC32C-sealed WAL/census/ownership
+records, per-study corruption quarantine (410, never a boot failure),
+ENOSPC backpressure (507 + Retry-After, compact-and-GC degrade rung)
+and an offline scrub/repair tool.
 """
 
+from ..exceptions import StoreFullError
 from .client import ServiceClient
 from .compile_plane import CompilePlane, SignatureCensus
 from .fleet import FleetReplica, ShardNotOwned, ShardUnavailable, shard_of
 from .journal import StudyJournal
-from .overload import AdmissionGuard, Deadline, DegradeLadder, OverloadError
-from .scheduler import (DrainingError, StudyQuotaError, StudyScheduler,
+from .overload import (AdmissionGuard, Deadline, DegradeLadder,
+                       OverloadError, StoreFullShed)
+from .scheduler import (DrainingError, QuarantinedStudyError,
+                        StudyQuotaError, StudyScheduler,
                         UnknownStudyError)
 from .spacespec import space_from_spec
 
 __all__ = ["StudyScheduler", "StudyQuotaError", "UnknownStudyError",
-           "DrainingError", "StudyJournal", "AdmissionGuard", "Deadline",
-           "DegradeLadder", "OverloadError", "ServiceClient",
+           "DrainingError", "QuarantinedStudyError", "StudyJournal",
+           "AdmissionGuard", "Deadline",
+           "DegradeLadder", "OverloadError", "StoreFullError",
+           "StoreFullShed", "ServiceClient",
            "CompilePlane", "SignatureCensus",
            "FleetReplica", "ShardNotOwned", "ShardUnavailable", "shard_of",
            "space_from_spec"]

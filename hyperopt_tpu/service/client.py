@@ -6,11 +6,12 @@ re-invented (differently) what to do about a 429, a draining 503 or a
 connection reset.  This helper wires :class:`~hyperopt_tpu.retry.RetryPolicy`
 into one place:
 
-* **Retryable**: 429 and 503 responses (honoring the server's
+* **Retryable**: 429, 503 and 507 responses (honoring the server's
   ``Retry-After`` as a FLOOR under the policy's jittered exponential
-  backoff — ``RetryPolicy.delay_after``), connection-level failures
-  (refused / reset / timeout — the crash-restart window the WAL resume
-  gate drives traffic through).
+  backoff — ``RetryPolicy.delay_after``; 507 is the ISSUE-15
+  store-full shed — the disk is compacting/GCing and recovers),
+  connection-level failures (refused / reset / timeout — the
+  crash-restart window the WAL resume gate drives traffic through).
 * **Not retryable**: every other status.  A 409 on ``tell`` deserves a
   special note: it means "already told" — for a client retrying a tell
   whose RESPONSE was lost, that is success, and :meth:`tell` reports it
@@ -181,7 +182,8 @@ class ServiceClient:
                 payload = {"ok": False, "error": f"HTTP {e.code}"}
             return e.code, payload, retry_after
 
-    def request(self, method, path, body=None, retryable=(429, 503)):
+    def request(self, method, path, body=None,
+                retryable=(429, 503, 507)):
         """One logical request with retry/backoff.  Returns
         ``(status, payload)`` for any non-retryable answer; raises
         :class:`ServiceUnavailable` when retries run out.  With tracing

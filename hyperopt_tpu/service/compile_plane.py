@@ -58,8 +58,6 @@ from collections import deque
 import numpy as np
 
 from ..obs.metrics import get_metrics
-from ..obs.trace import iter_jsonl
-
 __all__ = ["CompilePlane", "SignatureCensus", "census_path_for"]
 
 logger = logging.getLogger(__name__)
@@ -123,8 +121,13 @@ class SignatureCensus:
                     "widen": bool(widen), "count": n, "ts": time.time()})
 
     def _append(self, rec):
-        line = (json.dumps(rec, sort_keys=True,
-                           separators=(",", ":")) + "\n").encode()
+        from . import integrity
+
+        # sealed like every WAL line (ISSUE 15) so scrub verifies the
+        # census too; best-effort on ANY OSError — ENOSPC included — a
+        # full disk must cost warm-start quality, never a request (and
+        # never a slot of the shed budget)
+        line = (integrity.seal(rec) + "\n").encode()
         try:
             fd = os.open(self.path,
                          os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
@@ -145,7 +148,18 @@ class SignatureCensus:
         recorded shape, sorted most-used first."""
         best = {}
         if os.path.exists(self.path):
-            for rec in iter_jsonl(self.path):
+            from . import integrity
+
+            for chk in integrity.iter_checked_jsonl(self.path):
+                if chk.status == integrity.CORRUPT:
+                    # a bit-flipped census record only costs one bank
+                    # candidate — skip it loudly, never fail a warm-up
+                    logger.warning("census: %s:%d corrupt record "
+                                   "skipped", self.path, chk.lineno)
+                    continue
+                if chk.rec is None:
+                    continue
+                rec = chk.rec
                 if rec.get("kind") != "census":
                     continue
                 spec, cfg = rec.get("spec"), rec.get("cfg")

@@ -72,6 +72,7 @@ from ..filestore import _atomic_write, new_run_id
 from ..obs.metrics import get_metrics
 from ..parallel.membership import (EpochLeases, publish_params_once,
                                    rotate_for_owner)
+from . import integrity
 from .journal import StudyJournal, _fsync_dir
 
 __all__ = ["FleetReplica", "ShardNotOwned", "ShardUnavailable",
@@ -217,18 +218,30 @@ class FleetReplica:
     def read_owner(self, shard):
         """The shard's published owner entry ``{replica, addr, epoch}``,
         or None.  Advisory — the LEASE is ownership; this table only
-        tells routers where to redirect."""
+        tells routers where to redirect.  Entries are CRC32C-sealed
+        (ISSUE 15): a corrupt entry reads as ABSENT (retryable 503
+        until the owner's next heartbeat republishes) instead of
+        routing 307s to a bit-flipped address; pre-ISSUE-15 unsealed
+        entries stay readable."""
         try:
             with open(self._owner_path(shard)) as f:
                 rec = json.loads(f.read())
-            return rec if isinstance(rec, dict) else None
+            if not isinstance(rec, dict):
+                return None
+            if integrity.verify_obj(rec) == integrity.CORRUPT:
+                logger.warning("fleet: ownership entry for shard %s is "
+                               "corrupt; treating as unowned", shard)
+                return None
+            return rec
         except (OSError, ValueError):
             return None
 
     def _publish_ownership(self, shard, epoch):
         _atomic_write(self._owner_path(shard), json.dumps(
-            {"shard": int(shard), "replica": self.replica_id,
-             "addr": self.addr, "epoch": int(epoch), "ts": time.time()},
+            integrity.seal_obj(
+                {"shard": int(shard), "replica": self.replica_id,
+                 "addr": self.addr, "epoch": int(epoch),
+                 "ts": time.time()}),
             sort_keys=True).encode())
 
     def _clear_ownership(self, shard):

@@ -28,6 +28,19 @@ and ``sid``)::
                n_asked, n_told, state}        study's registry entry + RNG
                                               position; its trials live in
                                               the FileStore
+    quarantine {reason}                       the study's journal state was
+                                              found corrupt (ISSUE 15):
+                                              410 on ask/tell until the
+                                              operator intervenes
+
+Integrity (ISSUE 15): every appended/rewritten line carries a CRC32C
+suffix field (``"c":"<hex>"`` over the canonical record bytes — see
+``service/integrity.py``); replay classifies each line as ok /
+torn-tail / corrupt-mid-file through ``integrity.iter_checked_jsonl``
+and the scheduler quarantines per study instead of failing the boot.
+Pre-ISSUE-15 journals (no ``c`` field) replay unchanged, pinned
+bitwise.  ENOSPC on append/fsync raises the typed, retryable
+:class:`JournalFullError` (HTTP 507 + store-full shed).
 
 Ordering and idempotency (the replay argument, DESIGN.md §17): records
 append in the order the scheduler applied them, and studies are
@@ -64,14 +77,20 @@ import os
 import time
 
 from .. import chaos
-from ..obs.trace import iter_jsonl
+from . import integrity
+from .integrity import StoreFullError
 
-__all__ = ["StudyJournal", "JournalError", "wal_path_for"]
+__all__ = ["StudyJournal", "JournalError", "JournalFullError",
+           "JournalCorruptError", "wal_path_for"]
 
 logger = logging.getLogger(__name__)
 
 #: journal file name under a store root (``wal_path_for``)
 WAL_BASENAME = "service.wal.jsonl"
+
+#: suffix a quarantined journal segment is renamed under (evidence —
+#: never replayed, never GC'd, readable by scrub and post-mortems)
+QUARANTINE_SUFFIX = ".quarantined"
 
 
 class JournalError(OSError):
@@ -80,10 +99,39 @@ class JournalError(OSError):
     scheduler advancing past state the journal never captured."""
 
 
+class JournalFullError(JournalError, StoreFullError):
+    """The journal write failed with ENOSPC (ISSUE 15).  Both a
+    :class:`JournalError` (every existing handler keeps working) and a
+    :class:`~hyperopt_tpu.exceptions.StoreFullError` (the serving path
+    answers a typed, retryable 507 and arms the store-full shed)."""
+
+
+class JournalCorruptError(JournalError):
+    """A compaction refused to run because the chain it would discard
+    holds records that fail checksum verification — rewriting would
+    launder the corruption into the only surviving copy.  The old chain
+    is kept; scrub/resume quarantine the affected studies."""
+
+
 def wal_path_for(store_root):
     """The default journal location for a scheduler persisting into
     ``store_root`` (the WAL shares the store's durability story)."""
     return os.path.join(str(store_root), WAL_BASENAME)
+
+
+_METRICS = None
+
+
+def _metrics():
+    """Lazy process-global service registry for the journal's chaos
+    sites, so injected wal faults/corruptions land in /metrics (the
+    smoke gate's ground truth for '100% of injections detected')."""
+    global _METRICS
+    if _METRICS is None:
+        from ..obs.metrics import get_metrics
+
+        _METRICS = get_metrics("service")
+    return _METRICS
 
 
 def _fsync_dir(path):
@@ -114,13 +162,18 @@ class StudyJournal:
     the scheduler already serializes every mutation under its lock, and
     the journal is only touched there."""
 
-    def __init__(self, path):
+    def __init__(self, path, checksum=True):
         self.path = str(path)
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._fh = None
         self._dirty = False
+        # checksummed records (ISSUE 15): every appended/rewritten line
+        # carries the CRC32C suffix field.  Off only for the bench's
+        # overhead baseline and back-compat pins — production journals
+        # are always sealed.
+        self.checksum = bool(checksum)
         self.appends = 0
         self.syncs = 0
         self.compactions = 0
@@ -129,22 +182,40 @@ class StudyJournal:
 
     def _handle(self):
         if self._fh is None:
-            self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh = open(self.path, "ab")
         return self._fh
+
+    def _line(self, rec):
+        if self.checksum:
+            return (integrity.seal(rec) + "\n").encode("utf-8")
+        return (json.dumps(rec, sort_keys=True,
+                           separators=(",", ":")) + "\n").encode("utf-8")
+
+    @staticmethod
+    def _raise_typed(what, e):
+        if integrity.is_enospc(e):
+            raise JournalFullError(
+                e.errno, f"journal {what} failed, disk full: {e}") from e
+        raise JournalError(f"journal {what} failed: {e}") from e
 
     def append(self, rec):
         """One record onto the journal (buffered — call :meth:`sync` at
         the durability point).  Any OSError surfaces as
-        :class:`JournalError` so the serving path fails THIS request
-        instead of silently losing the record."""
-        chaos.io_point("wal")
+        :class:`JournalError` — ENOSPC as the retryable
+        :class:`JournalFullError` — so the serving path fails THIS
+        request instead of silently losing the record."""
         try:
+            chaos.io_point("wal", _metrics())
+            # the chaos 'corrupt' site: the write SUCCEEDS but the
+            # medium lies — exactly the fault class the checksum
+            # exists to catch
+            data = chaos.corrupt_bytes("wal", self._line(rec),
+                                       _metrics())
             fh = self._handle()
-            fh.write(json.dumps(rec, sort_keys=True,
-                                separators=(",", ":")) + "\n")
+            fh.write(data)
         except OSError as e:
             self._drop_handle()
-            raise JournalError(f"journal append failed: {e}") from e
+            self._raise_typed("append", e)
         self._dirty = True
         self.appends += 1
 
@@ -158,7 +229,7 @@ class StudyJournal:
             os.fsync(self._fh.fileno())
         except OSError as e:
             self._drop_handle()
-            raise JournalError(f"journal fsync failed: {e}") from e
+            self._raise_typed("fsync", e)
         self._dirty = False
         self.syncs += 1
 
@@ -180,12 +251,28 @@ class StudyJournal:
     # -- replay / compaction side -----------------------------------------
 
     def records(self):
-        """Every parseable record, in append order.  Torn lines (the
-        crash artifact batched fsync allows at the tail) are skipped by
-        ``iter_jsonl`` — a WAL is readable after ANY crash."""
+        """Every verified record, in append order, with the checksum
+        field stripped.  Torn tails (the crash artifact batched fsync
+        allows) are skipped as always; CORRUPT lines are skipped WITH a
+        warning — callers that must react per-study (the scheduler's
+        quarantine, scrub) read :meth:`checked_records` instead."""
+        for chk in self.checked_records():
+            if chk.status in (integrity.OK, integrity.UNCHECKED):
+                yield chk.rec
+            elif chk.status == integrity.CORRUPT:
+                logger.warning(
+                    "%s:%d: CORRUPT journal record (checksum/framing "
+                    "failure mid-file) skipped by an unchecked reader",
+                    self.path, chk.lineno)
+
+    def checked_records(self):
+        """Every line, classified (:class:`~hyperopt_tpu.service
+        .integrity.Checked`): ok / unchecked (pre-ISSUE-15) / corrupt /
+        torn.  The scheduler's resume and the scrub tool drive their
+        quarantine decisions from this."""
         if not os.path.exists(self.path):
             return
-        yield from iter_jsonl(self.path)
+        yield from integrity.iter_checked_jsonl(self.path)
 
     def size_bytes(self):
         try:
@@ -193,31 +280,95 @@ class StudyJournal:
         except OSError:
             return 0
 
-    def rewrite(self, records):
+    def rewrite(self, records, verify_old=True):
         """Atomically replace the journal with ``records`` (compaction).
         The append handle reopens on the next :meth:`append`, so a
-        concurrent-append-after-compact lands in the NEW file."""
-        chaos.io_point("wal")
+        concurrent-append-after-compact lands in the NEW file.
+
+        Two integrity refusals (ISSUE 15 — compaction must never
+        LAUNDER corruption into the only surviving copy):
+
+        * with ``verify_old`` the existing chain is checksum-verified
+          first; a corrupt record aborts (:class:`JournalCorruptError`)
+          keeping the old chain, so scrub/resume still see the
+          evidence and quarantine precisely;
+        * the freshly-written snapshot is re-read and re-verified
+          before the ``os.replace`` — a write the disk corrupted in
+          flight aborts the same way instead of becoming the journal.
+        """
+        try:
+            chaos.io_point("wal", _metrics())
+        except OSError as e:
+            self._raise_typed("compaction", e)
+        if verify_old and self.checksum and os.path.exists(self.path):
+            for chk in integrity.iter_checked_jsonl(self.path):
+                if chk.status == integrity.CORRUPT:
+                    raise JournalCorruptError(
+                        f"{self.path}:{chk.lineno}: corrupt record in "
+                        "the chain compaction would discard; keeping "
+                        "the old chain (quarantine via resume/scrub)")
         self._drop_handle()
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
+            with open(tmp, "wb") as f:
                 for rec in records:
-                    f.write(json.dumps(rec, sort_keys=True,
-                                       separators=(",", ":")) + "\n")
+                    f.write(self._line(rec))
                 f.flush()
                 os.fsync(f.fileno())
+            if self.checksum:
+                for chk in integrity.iter_checked_jsonl(tmp):
+                    if chk.status != integrity.OK:
+                        raise JournalCorruptError(
+                            f"{tmp}:{chk.lineno}: compaction snapshot "
+                            "failed re-read verification; keeping the "
+                            "old chain")
             os.replace(tmp, self.path)
         except OSError as e:
             try:
                 os.remove(tmp)
             except OSError:
                 pass
-            raise JournalError(f"journal compaction failed: {e}") from e
+            if isinstance(e, JournalError):
+                raise
+            self._raise_typed("compaction", e)
         # the rename is durable only once the parent directory entry is
         # too (ISSUE 12 satellite — see _fsync_dir)
         _fsync_dir(self.path)
         self.compactions += 1
+
+    def quarantine_segment(self, reason):
+        """Move this journal FILE aside as evidence (ISSUE 15): rename
+        to ``<path>.quarantined`` (suffixed with a counter if one
+        already exists), append a sealed reason record to the renamed
+        file, fsync the directory.  The live path is then free — the
+        caller rewrites it from the healthy replayed state (or the
+        next append recreates it).  Returns the quarantine path, or
+        None when there was nothing to rename."""
+        self._drop_handle()
+        if not os.path.exists(self.path):
+            return None
+        qpath = self.path + QUARANTINE_SUFFIX
+        n = 1
+        while os.path.exists(qpath):
+            qpath = f"{self.path}{QUARANTINE_SUFFIX}.{n}"
+            n += 1
+        try:
+            os.replace(self.path, qpath)
+            with open(qpath, "ab") as f:
+                f.write((integrity.seal({
+                    "kind": "quarantine_reason", "reason": str(reason),
+                    "path": self.path, "ts": time.time()}) + "\n")
+                    .encode("utf-8"))
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            logger.warning("could not quarantine journal segment %s: %s",
+                           self.path, e)
+            return None
+        _fsync_dir(self.path)
+        logger.warning("journal segment quarantined: %s -> %s (%s)",
+                       self.path, qpath, reason)
+        return qpath
 
     # -- record constructors (one place owns the schema) -------------------
 
@@ -264,6 +415,15 @@ class StudyJournal:
         if trace is not None:
             rec["trace"] = str(trace)
         return rec
+
+    @staticmethod
+    def quarantine_rec(study_id, reason):
+        """Durable per-study quarantine marker (ISSUE 15): replay marks
+        the study quarantined (410 on ask/tell, listed in ``/studies``)
+        without touching any other study — the resume-twice idempotence
+        of the corruption path rides on this record."""
+        return {"kind": "quarantine", "sid": study_id,
+                "reason": str(reason), "ts": time.time()}
 
     @staticmethod
     def snapshot_rec(study):

@@ -42,8 +42,8 @@ import threading
 import time
 
 __all__ = ["Deadline", "OverloadError", "DeadlineExceeded",
-           "AdmissionGuard", "DegradeLadder", "LADDER_LEVELS",
-           "NonFiniteProposal", "is_device_fault"]
+           "StoreFullShed", "AdmissionGuard", "DegradeLadder",
+           "LADDER_LEVELS", "NonFiniteProposal", "is_device_fault"]
 
 
 class OverloadError(RuntimeError):
@@ -59,6 +59,15 @@ class DeadlineExceeded(OverloadError):
     Subclasses :class:`OverloadError` so the HTTP mapping (429 +
     ``Retry-After``) rides along — the client should come back when the
     service is less loaded, which is the same remedy."""
+
+
+class StoreFullShed(OverloadError):
+    """Ask shed because the store is (or just was) out of disk space
+    (ISSUE 15): HTTP **507** + ``Retry-After``.  Distinct from 429 so
+    clients and dashboards can tell load pressure from disk pressure;
+    retryable either way.  Tells are NOT shed on this state — they
+    preserve client work and shed last (the existing 4x policy), only
+    a genuinely failing WAL append refuses one (also 507)."""
 
 
 class Deadline:
@@ -121,7 +130,43 @@ class AdmissionGuard:
         self._lock = threading.Lock()
         self._inflight = {"ask": 0, "tell": 0}
         self._wave_ewma = None  # seconds; None until the first wave lands
+        # store-full shed latch (ISSUE 15): armed by the scheduler when
+        # a WAL/store write hit ENOSPC (or the disk watermark tripped);
+        # expires after its window so ONE probe request reaches the
+        # scheduler and re-tests the disk, re-arming on failure —
+        # recovery is automatic when space returns, no operator needed
+        self._store_full_until = None
+        self._store_full_reason = ""
+        self._store_retry_after = 1.0
         self.metrics = metrics
+
+    # -- store-full latch (ISSUE 15) ---------------------------------------
+
+    def set_store_full(self, full, reason="", retry_after=1.0):
+        """Arm/disarm the store-full ask shed for one latch window
+        (``2 x retry_after``, so shed clients retrying on the hint meet
+        an open probe window)."""
+        with self._lock:
+            if full:
+                self._store_full_until = (self._clock()
+                                          + 2.0 * float(retry_after))
+                self._store_full_reason = str(reason)
+                self._store_retry_after = float(retry_after)
+            else:
+                self._store_full_until = None
+            self._gauge("service.store_full",
+                        1.0 if full else 0.0)
+
+    def _store_full_locked(self):
+        until = self._store_full_until
+        if until is None:
+            return False
+        if self._clock() >= until:
+            # latch window over: let the next ask through as the probe
+            self._store_full_until = None
+            self._gauge("service.store_full", 0.0)
+            return False
+        return True
 
     # -- admission ---------------------------------------------------------
 
@@ -129,8 +174,15 @@ class AdmissionGuard:
         """Admit one ask or shed.  Sheds when the queue is full OR when
         the request's remaining deadline cannot cover even the predicted
         wait (``queued waves x wave EWMA``) — refusing up front beats
-        burning a wave slot on an answer the client will have abandoned."""
+        burning a wave slot on an answer the client will have abandoned.
+        A store-full latch (ISSUE 15) sheds with 507 before either."""
         with self._lock:
+            if self._store_full_locked():
+                self._count("service.shed.store_full")
+                raise StoreFullShed(
+                    f"store full: {self._store_full_reason or 'disk'}"
+                    " — retry after space frees",
+                    retry_after=self._store_retry_after)
             depth = self._inflight["ask"]
             if depth >= self.max_queue:
                 self._count("service.shed.ask")

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -70,17 +71,28 @@ from ..base import (
 from ..obs import reqtrace
 from ..obs.metrics import get_metrics
 from ..obs.trace import Tracer
+from . import integrity
+from .integrity import StoreFullError
 from .journal import JournalError, StudyJournal, wal_path_for
 from .overload import (LADDER_LEVELS, DeadlineExceeded, DegradeLadder,
                        NonFiniteProposal, is_device_fault)
 
 __all__ = ["StudyScheduler", "Study", "StudyQuotaError",
            "UnknownStudyError", "DuplicateTellError", "DrainingError",
-           "StaleOwnershipError"]
+           "StaleOwnershipError", "QuarantinedStudyError"]
 
 
 class UnknownStudyError(KeyError):
     """No live study with that id (never created, or closed)."""
+
+
+class QuarantinedStudyError(RuntimeError):
+    """The study's journal state was found corrupt and the study is
+    quarantined (ISSUE 15): ask/tell/close answer HTTP **410 Gone** —
+    permanent until an operator repairs the store (``python -m
+    hyperopt_tpu.service.scrub --repair``).  Every OTHER study on the
+    same root keeps serving bit-identically; quarantine is a per-study
+    fault, never a process fault."""
 
 
 class StudyQuotaError(RuntimeError):
@@ -687,7 +699,8 @@ class StudyScheduler:
                             parse_service_idle_sec,
                             parse_service_max_pending,
                             parse_service_max_studies,
-                            parse_service_wal)
+                            parse_service_wal, parse_store_gc,
+                            parse_store_watermark)
 
         self.max_studies = (parse_service_max_studies()
                             if max_studies is None else int(max_studies))
@@ -766,6 +779,26 @@ class StudyScheduler:
         self.degrade = (DegradeLadder(patience, metrics=self.metrics)
                         if patience is not None else None)
 
+        # storage-integrity plane (ISSUE 15): per-study quarantine map
+        # (sid -> {reason, ts}; durable via `quarantine` WAL records),
+        # the disk watermark over whatever durable root this scheduler
+        # writes, and the store-full shed latch the ENOSPC path arms
+        self._quarantined = {}
+        self._gc_enabled = parse_store_gc()
+        self._store_full = False
+        self._store_full_src = None  # "watermark" | "enospc" | None
+        self._last_rung = 0.0
+        self._rung_running = False
+        self.last_gc = None
+        self.watermark = None
+        wm_root = (store_root if store_root is not None
+                   else (os.path.dirname(self.journal.path) or "."
+                         if self.journal is not None else None))
+        if wm_root is not None:
+            self.watermark = integrity.DiskWatermark(
+                wm_root, threshold=parse_store_watermark(),
+                metrics=self.metrics)
+
         self.last_resume = None  # stats dict of the latest WAL replay
         if auto_resume and self.journal is not None:
             self.resume()
@@ -812,10 +845,16 @@ class StudyScheduler:
                        space_spec=space_spec, **kwargs)
             trace = reqtrace.current_trace_id()
             if self.journal is not None and not _replay:
-                self.journal.append(StudyJournal.admit_rec(
-                    study_id, space_spec, st.seed, st.admit_kwargs,
-                    trace=trace))
-                self.journal.sync()  # admits are rare; durable immediately
+                try:
+                    self.journal.append(StudyJournal.admit_rec(
+                        study_id, space_spec, st.seed, st.admit_kwargs,
+                        trace=trace))
+                    self.journal.sync()  # admits are rare; durable now
+                except StoreFullError as e:
+                    # typed 507 to the client; arm the shed so the next
+                    # admissions fail fast at the guard
+                    self._enter_store_full(f"admit WAL append: {e}")
+                    raise
             st.note("admit", trace=trace,
                     replay=True if _replay else None)
             self._studies[study_id] = st
@@ -848,10 +887,145 @@ class StudyScheduler:
             self._maybe_compact()
 
     def _get(self, study_id):
+        if study_id in self._quarantined:
+            raise QuarantinedStudyError(
+                f"{study_id} is quarantined "
+                f"({self._quarantined[study_id].get('reason', 'corrupt')})")
         st = self._studies.get(study_id)
         if st is None:
             raise UnknownStudyError(study_id)
         return st
+
+    # -- storage-integrity plane (ISSUE 15) --------------------------------
+
+    def _quarantine_study(self, sid, reason):
+        """Per-study corruption fault: mark the study quarantined (410
+        on ask/tell, listed in ``/studies``), free its cohort slot,
+        emit the timeline event.  The study's trials stay on disk
+        untouched — evidence, like the renamed WAL segment."""
+        if sid in self._quarantined:
+            return
+        self._quarantined[sid] = {"reason": str(reason),
+                                  "ts": time.time()}
+        st = self._studies.get(sid)
+        if st is not None:
+            st.state = "quarantined"
+            self._evict_from_cohort(st)
+            st.note("quarantine", reason=str(reason))
+        self.metrics.counter("service.integrity.quarantines").inc()
+        logging.getLogger(__name__).warning(
+            "service: study %s QUARANTINED (%s) — 410 on ask/tell; "
+            "every other study keeps serving", sid, reason)
+
+    def _enter_store_full(self, reason, retry_after=1.0,
+                          source="enospc"):
+        """Arm the store-full shed: the admission guard answers asks
+        with 507 + Retry-After for one latch window, then lets a probe
+        request through to re-test the disk (re-arming on failure) —
+        recovery is automatic when space returns.  Kicks the degrade
+        rung (compact + bounded GC) off-thread: reclaiming space beats
+        shedding, but running it on the request path under the
+        scheduler lock would block every concurrent tell behind an
+        I/O sweep of an already-sick disk.
+
+        ``source`` records WHO armed us: a ``watermark`` latch clears
+        when statvfs says space returned; an ``enospc`` latch clears
+        only on a SUCCESSFUL durable write (the guard window expiry is
+        its probe) — EDQUOT, and injected faults, can report plenty of
+        free blocks while every write still fails."""
+        self._run_space_rung_async()
+        self._store_full = True
+        self._store_full_src = source
+        self.metrics.gauge("store.full").set(1)
+        if self.overload is not None:
+            self.overload.set_store_full(
+                True, reason=reason, retry_after=retry_after)
+
+    def _exit_store_full(self):
+        if not self._store_full:
+            return
+        self._store_full = False
+        self._store_full_src = None
+        self.metrics.gauge("store.full").set(0)
+        if self.overload is not None:
+            self.overload.set_store_full(False)
+
+    def _run_space_rung_async(self, cooldown=5.0):
+        """Spawn the space-pressure degrade rung on a daemon thread:
+        compact the quiescent WAL (dead records are reclaimable bytes)
+        and run the bounded store GC.  Cooldown-limited and
+        single-flight — the rung must not become its own I/O storm
+        while the disk stays full, and requests shed cheaply at the
+        guard while it works."""
+        now = time.monotonic()
+        if now - self._last_rung < cooldown or self._rung_running:
+            return
+        self._last_rung = now
+        self._rung_running = True
+
+        def rung():
+            try:
+                try:
+                    with self._lock:
+                        self._maybe_compact()
+                except Exception:  # noqa: BLE001 - full disks fail this
+                    pass
+                if self._gc_enabled and self.store_root is not None:
+                    try:
+                        self.last_gc = integrity.gc_store_root(
+                            self.store_root, metrics=self.metrics)
+                    except Exception:  # noqa: BLE001
+                        logging.getLogger(__name__).warning(
+                            "service: store gc failed", exc_info=True)
+            finally:
+                self._rung_running = False
+
+        threading.Thread(target=rung, name="hyperopt-store-rung",
+                         daemon=True).start()
+
+    def _check_store(self, force=False):
+        """The per-wave / per-scrape watermark poll.  Entering
+        low-space runs the rung and arms the shed; while space STAYS
+        low the guard latch is re-armed each poll (it expires on its
+        own window otherwise — one ~2s shed and then full traffic onto
+        a filling disk); leaving low-space clears a watermark-armed
+        latch.  An ``enospc``-armed latch is deliberately NOT cleared
+        here — statvfs can show free blocks while every write fails
+        (EDQUOT, failing controller); only a successful durable write
+        clears it.  Cheap on the hot path — statvfs at most once per
+        second."""
+        if self.watermark is None:
+            return None
+        state = self.watermark.sample(force=force)
+        if state is None:
+            return None
+        if state["low"]:
+            reason = (f"disk watermark: {state['free_bytes']} bytes "
+                      f"free ({state['free_frac']:.1%})")
+            if not self._store_full:
+                self._enter_store_full(reason, source="watermark")
+            elif self.overload is not None:
+                self.overload.set_store_full(True, reason=reason,
+                                             retry_after=1.0)
+        elif self._store_full and self._store_full_src == "watermark":
+            self._exit_store_full()
+        return state
+
+    def store_health(self, force=False):
+        """The ``/snapshot``·``/metrics`` storage block: disk state,
+        shed latch, quarantine count, last GC."""
+        with self._lock:
+            state = self._check_store(force=force)
+            out = {
+                "store_full": self._store_full,
+                "quarantined": len(self._quarantined),
+            }
+            if state is not None:
+                out.update({k: state[k] for k in
+                            ("free_bytes", "used_frac", "low")})
+            if self.last_gc is not None:
+                out["gc"] = self.last_gc
+            return out
 
     # -- cohort packing ----------------------------------------------------
 
@@ -1338,6 +1512,9 @@ class StudyScheduler:
             return
         wave_faults = 0
         served_any = False
+        # disk-watermark poll (ISSUE 15): cheap (statvfs cached ~1s);
+        # entering low-space compacts + GCs before any shed is armed
+        self._check_store()
         self.evict_idle()
         while reqs:
             this_round, leftover, seen = [], [], set()
@@ -1395,6 +1572,12 @@ class StudyScheduler:
         if self.journal is not None:
             try:
                 self.journal.sync()
+                if (self._store_full
+                        and self._store_full_src == "enospc"):
+                    # the probe wave's durable write SUCCEEDED: space
+                    # is back (only a real write can prove that — see
+                    # _check_store on EDQUOT)
+                    self._exit_store_full()
             except JournalError as e:
                 # docs already landed; failing the responses now would
                 # desync clients from served state.  Count loudly — a
@@ -1403,6 +1586,10 @@ class StudyScheduler:
                 logging.getLogger(__name__).warning(
                     "service: WAL sync failed after wave: %s", e)
                 self.metrics.counter("service.wal.sync_errors").inc()
+                if isinstance(e, StoreFullError):
+                    # arm the store-full shed so the NEXT wave's asks
+                    # are refused up front instead of served un-durably
+                    self._enter_store_full(f"wave WAL sync: {e}")
         if self.degrade is not None and served_any and not wave_faults:
             self.degrade.record_clean_wave()
         dt = time.perf_counter() - t_wave
@@ -1437,8 +1624,14 @@ class StudyScheduler:
             deadline.check("ask")
         with self._cond:
             st = self._get(study_id)
-            res = self._prepare_ask(st, n, deadline=deadline,
-                                    req_id=req_id)
+            try:
+                res = self._prepare_ask(st, n, deadline=deadline,
+                                        req_id=req_id)
+            except StoreFullError as e:
+                # the startup-path WAL append hit ENOSPC: typed 507 to
+                # this client, shed armed for the ones behind it
+                self._enter_store_full(f"ask WAL append: {e}")
+                raise
             if not isinstance(res, _AskReq):  # startup random search
                 self.metrics.histogram("service.ask_sec").observe(
                     time.perf_counter() - t0)
@@ -1482,6 +1675,9 @@ class StudyScheduler:
                 # the window before the void record lands, making
                 # replay draw the failed seed twice
                 req.study.n_asked -= len(req.new_ids)
+                if isinstance(req.error, StoreFullError):
+                    self._enter_store_full(
+                        f"wave WAL append: {req.error}")
                 if not req.journaled and not isinstance(
                         req.error, StaleOwnershipError):
                     # the void note names a deadline shed explicitly —
@@ -1589,9 +1785,24 @@ class StudyScheduler:
                     f"{study_id}: trial {tid} was already told")
             trace = reqtrace.current_trace_id()
             if self.journal is not None:
-                self.journal.append(StudyJournal.tell_rec(
-                    study_id, tid, loss, status, trace=trace))
-                self.journal.sync()
+                try:
+                    self.journal.append(StudyJournal.tell_rec(
+                        study_id, tid, loss, status, trace=trace))
+                    self.journal.sync()
+                except StoreFullError as e:
+                    # the tell was NOT applied (write-ahead ordering):
+                    # typed 507, retryable — tells shed LAST, so only a
+                    # genuinely failing append refuses one
+                    self._enter_store_full(f"tell WAL append: {e}")
+                    raise
+                else:
+                    if (self._store_full
+                            and self._store_full_src == "enospc"):
+                        # a durable write succeeded: the full-disk
+                        # latch clears (a WATERMARK latch does not —
+                        # writes still succeeding is exactly what
+                        # low-but-not-full looks like)
+                        self._exit_store_full()
             st.note("tell", tid=tid, trace=trace)
             self._apply_tell(st, doc, loss, status)
             if st.state == "done":
@@ -1669,20 +1880,109 @@ class StudyScheduler:
         t0 = time.perf_counter()
         stats = {"studies": 0, "asks": 0, "regenerated": 0, "tells": 0,
                  "duplicate_tells": 0, "skipped": 0, "errors": 0,
-                 "seed_mismatches": 0}
+                 "seed_mismatches": 0, "verified": 0, "unchecked": 0,
+                 "torn": 0, "corrupt_records": 0,
+                 "corrupt_unattributed": 0, "quarantined": 0,
+                 "quarantine_skipped": 0, "snapshot_corrupt_recovered": 0,
+                 "reconciled_tells": 0}
         # replay-scoped context: which (sid, tid) tells this replay has
         # accounted (store-ahead vs genuine duplicate), and the highest
         # VOID tid per study (ids a failed ask retired — the tid
         # allocator must stay past them, exactly as the live run's did)
         self._replay_ctx = {"told": set(), "void_max": {}}
+        # corruption quarantine (ISSUE 15): a corrupt record is a
+        # PER-STUDY fault.  The sid is taken from the parsed record
+        # (bad checksum, intact framing) or salvaged by regex from the
+        # broken line; from the first corrupt record on, every later
+        # record for that study is skipped (its state chain is broken)
+        # and the study quarantines at the end of the pass.  Without a
+        # store the healthy records are kept verbatim so the live WAL
+        # can be rewritten after the corrupt segment is renamed aside.
+        corrupt = {}
+        keep_raw = source is None and self.store_root is None
+        healthy = [] if keep_raw else None
         with self._lock:
-            for rec in journal.records():
+            for chk in journal.checked_records():
+                if chk.status == integrity.TORN:
+                    stats["torn"] += 1
+                    continue
+                if chk.status == integrity.CORRUPT:
+                    stats["corrupt_records"] += 1
+                    rec = chk.rec or {}
+                    sid = rec.get("sid") or integrity.salvage_sid(chk.raw)
+                    if sid is None:
+                        stats["corrupt_unattributed"] += 1
+                        logging.getLogger(__name__).warning(
+                            "service: %s:%d: corrupt WAL record with no "
+                            "salvageable study id; record lost (scrub "
+                            "will still report it)",
+                            journal.path, chk.lineno)
+                        continue
+                    if (rec.get("kind") == "snapshot"
+                            and sid in self._studies
+                            and sid not in corrupt):
+                        # a corrupt SNAPSHOT whose study the earlier
+                        # chain already rebuilt: full-chain replay
+                        # recovered it — no quarantine needed (the
+                        # healthy replay would have skipped this
+                        # duplicate admit anyway)
+                        stats["snapshot_corrupt_recovered"] += 1
+                        continue
+                    corrupt.setdefault(
+                        sid, f"corrupt record at {journal.path}:"
+                             f"{chk.lineno}")
+                    continue
+                if chk.status == integrity.OK:
+                    stats["verified"] += 1
+                else:
+                    stats["unchecked"] += 1
+                rec = chk.rec
+                sid = rec.get("sid")
+                if sid is not None and (sid in corrupt
+                                        or sid in self._quarantined):
+                    stats["quarantine_skipped"] += 1
+                    continue
                 try:
                     self._replay_record(rec, stats)
                 except Exception as e:  # noqa: BLE001 - per-record isolation
                     stats["errors"] += 1
                     logging.getLogger(__name__).warning(
                         "service: WAL replay failed for %r: %s", rec, e)
+                    continue
+                if healthy is not None:
+                    healthy.append(rec)
+            for sid, reason in corrupt.items():
+                self._quarantine_study(sid, reason)
+                stats["quarantined"] += 1
+            if corrupt:
+                self._quarantine_wal_segment(journal, corrupt, healthy)
+            # store-ahead reconciliation (ISSUE 15): a DONE doc whose
+            # tell record the journal lost can only mean the medium
+            # destroyed a DURABLE line (the tell fsyncs before the doc
+            # settles, so a genuine crash-torn tail never leaves a
+            # DONE doc behind).  The store holds the acknowledged
+            # result — realign the counter to it instead of reporting
+            # a phantom pending ask forever.  Tells never draw from
+            # the RNG stream, so reconciliation cannot perturb the
+            # bitwise-resume pin.
+            for st in self._studies.values():
+                if getattr(st.trials, "store", None) is None \
+                        or st.study_id in self._quarantined:
+                    continue
+                done = sum(1 for d in st.trials._dynamic_trials
+                           if d["state"] == JOB_STATE_DONE)
+                if done > st.n_told:
+                    stats["reconciled_tells"] += done - st.n_told
+                    logging.getLogger(__name__).warning(
+                        "service: %s: %d acknowledged tell(s) missing "
+                        "from the journal (torn/corrupt tail?) — "
+                        "reconciled from the store's DONE docs",
+                        st.study_id, done - st.n_told)
+                    st.n_told = done
+                    if (st.max_trials is not None
+                            and st.n_trials >= st.max_trials
+                            and st.n_pending == 0):
+                        st.state = "done"
             for st in self._studies.values():
                 # the crash-resume boundary on every resumed timeline:
                 # everything before this marker was replayed from the
@@ -1714,6 +2014,21 @@ class StudyScheduler:
             if stats[key]:
                 self.metrics.counter(f"service.wal.replay_{key}").inc(
                     stats[key])
+        for key, name in (("verified", "service.integrity.verified"),
+                          ("unchecked", "service.integrity.unchecked"),
+                          ("torn", "service.integrity.torn"),
+                          ("corrupt_records",
+                           "service.integrity.corrupt_records"),
+                          ("corrupt_unattributed",
+                           "service.integrity.corrupt_unattributed"),
+                          ("quarantine_skipped",
+                           "service.integrity.quarantine_skipped"),
+                          ("snapshot_corrupt_recovered",
+                           "service.integrity.snapshot_recovered"),
+                          ("reconciled_tells",
+                           "service.integrity.reconciled_tells")):
+            if stats[key]:
+                self.metrics.counter(name).inc(stats[key])
         self.metrics.gauge("service.wal.replay_sec").set(
             stats["replay_sec"])
         self.last_resume = stats
@@ -1727,9 +2042,41 @@ class StudyScheduler:
                 stats["skipped"], stats["errors"], stats["replay_sec"])
         return stats
 
+    def _quarantine_wal_segment(self, journal, corrupt, healthy):
+        """Preserve the corrupt journal file as evidence and leave a
+        clean live WAL behind (ISSUE 15).  The segment renames to
+        ``*.quarantined`` with a sealed reason record; the live path is
+        then rebuilt — from store-backed snapshots via the normal
+        compaction when a store exists (``resume`` calls
+        ``_maybe_compact`` right after), or by rewriting the verified
+        healthy records directly when the WAL is the only copy."""
+        reasons = "; ".join(f"{sid}: {r}" for sid, r in
+                            sorted(corrupt.items()))
+        journal.quarantine_segment(reasons)
+        if journal is not self.journal or self.journal is None:
+            return  # a source segment (fleet epoch chain): our own WAL
+            # gains the quarantine records through compaction
+        if self.store_root is None and healthy is not None:
+            recs = list(healthy) + [
+                StudyJournal.quarantine_rec(sid, info.get("reason", ""))
+                for sid, info in sorted(self._quarantined.items())]
+            try:
+                self.journal.rewrite(recs, verify_old=False)
+            except JournalError as e:
+                logging.getLogger(__name__).warning(
+                    "service: could not rewrite WAL after quarantine: "
+                    "%s (healthy studies stay live in-memory; the "
+                    "quarantined segment holds the records)", e)
+
     def _replay_record(self, rec, stats):
         kind = rec.get("kind")
         sid = rec.get("sid")
+        if kind == "quarantine":
+            # the durable per-study quarantine marker: re-mark and move
+            # on — resume-twice with a quarantined segment present is
+            # idempotent through this record
+            self._quarantine_study(sid, rec.get("reason", "journaled"))
+            return
         if kind in ("admit", "snapshot"):
             if sid in self._studies:
                 return  # duplicate admit (compaction raced a crash)
@@ -1853,6 +2200,11 @@ class StudyScheduler:
             return False
         recs = [StudyJournal.snapshot_rec(s)
                 for s in self._studies.values() if s.state == "active"]
+        # quarantine markers survive every compaction: a restart must
+        # keep answering 410 for a corrupt study until an operator
+        # repairs the store, not resurrect it as unknown (404)
+        recs += [StudyJournal.quarantine_rec(sid, info.get("reason", ""))
+                 for sid, info in sorted(self._quarantined.items())]
         try:
             self.journal.rewrite(recs)
         except JournalError as e:
@@ -1906,6 +2258,13 @@ class StudyScheduler:
         every tell, shed/void, evict/re-admit, resume boundary).  The
         WAL holds the durable copy; ``obs.report --study`` joins both."""
         with self._lock:
+            # quarantined studies stay INSPECTABLE: the timeline (with
+            # its quarantine event) is exactly what the operator needs
+            # before deciding to scrub --repair — only ask/tell/close
+            # answer 410
+            st = self._studies.get(study_id)
+            if st is not None:
+                return st.timeline_dict()
             return self._get(study_id).timeline_dict()
 
     def studies_status(self):
@@ -1919,16 +2278,32 @@ class StudyScheduler:
                 "n_live": c.n_live,
                 "ticks": c.ticks,
             } for key, c in self._cohorts.items()]
+            studies = [s.status_dict() for s in self._studies.values()]
+            for sid, info in sorted(self._quarantined.items()):
+                if sid not in self._studies:
+                    # quarantined before its admit record could replay:
+                    # listed anyway — a study the operator must know
+                    # about is not allowed to vanish from /studies
+                    studies.append({"study_id": sid,
+                                    "state": "quarantined",
+                                    "quarantine_reason":
+                                        info.get("reason")})
             out = {
                 "ts": time.time(),
                 "n_studies": len(self._studies),
                 "slot_utilization": self.slot_utilization(),
                 "cohort_cache": tpe.cohort_cache_stats(),
                 "cohorts": cohorts,
-                "studies": [s.status_dict()
-                            for s in self._studies.values()],
+                "studies": studies,
                 "draining": self._draining,
             }
+            if self._quarantined:
+                out["quarantined"] = {
+                    sid: info.get("reason")
+                    for sid, info in sorted(self._quarantined.items())}
+            store = self.store_health()
+            if store is not None:
+                out["store"] = store
             if self.degrade is not None:
                 out["degrade"] = self.degrade.status()
             if self.compile_plane is not None:

@@ -84,11 +84,14 @@ import time
 from ..obs import reqtrace
 from ..obs.serve import prometheus_text, split_hostport
 from ..obs.trace import JsonlSink, Tracer
+from ..exceptions import StoreFullError
 from .fleet import ShardNotOwned, ShardUnavailable
-from .overload import AdmissionGuard, Deadline, OverloadError
+from .overload import (AdmissionGuard, Deadline, OverloadError,
+                       StoreFullShed)
 from .scheduler import (DrainingError, DuplicateTellError,
-                        StaleOwnershipError, StudyQuotaError,
-                        StudyScheduler, UnknownStudyError)
+                        QuarantinedStudyError, StaleOwnershipError,
+                        StudyQuotaError, StudyScheduler,
+                        UnknownStudyError)
 from .spacespec import SpaceSpecError, space_from_spec
 
 __all__ = ["ServiceHTTPServer", "main"]
@@ -394,7 +397,12 @@ class ServiceHTTPServer:
                           "appends": sched.journal.appends,
                           "syncs": sched.journal.syncs,
                           "compactions": sched.journal.compactions}
-        out["ok"] = not sched._draining
+        store = sched.store_health()
+        if store is not None:
+            out["store"] = store
+            if store.get("store_full"):
+                out["ok"] = False
+        out["ok"] = out["ok"] and not sched._draining
         return out
 
     def _studies_status(self):
@@ -521,6 +529,24 @@ class ServiceHTTPServer:
             # table (and its 307) once the new owner publishes
             return 503, {"ok": False, "error": str(e),
                          "retry_after": 0.25}
+        except QuarantinedStudyError as e:
+            # 410 Gone (ISSUE 15): the study's journal state was found
+            # corrupt — permanent until an operator repairs the store
+            # (scrub --repair); retrying is pointless, unlike 429/503
+            return 410, {"ok": False, "error": str(e),
+                         "quarantined": True}
+        except StoreFullShed as e:
+            # 507 Insufficient Storage (ISSUE 15): the ask shed at the
+            # admission guard because the store is out of space;
+            # retryable — the degrade rung is compacting/GCing and the
+            # latch re-probes the disk automatically
+            return 507, {"ok": False, "error": str(e),
+                         "retry_after": e.retry_after}
+        except StoreFullError as e:
+            # the WAL/store write itself hit ENOSPC at the durability
+            # point: nothing was acknowledged; same retryable 507
+            return 507, {"ok": False, "error": str(e),
+                         "retry_after": 1.0}
         except UnknownStudyError as e:
             return 404, {"ok": False, "error": str(e)}
         except DuplicateTellError as e:
@@ -632,7 +658,25 @@ class ServiceHTTPServer:
             out["compile"] = status["compile"]
         if "wal" in status:
             out["wal"] = status["wal"]
+        if "store" in status:
+            out["store"] = status["store"]
+        if "quarantined" in status:
+            out["quarantined"] = status["quarantined"]
         return out
+
+    def _refresh_store_gauges(self):
+        """Scrape-time disk-watermark poll (ISSUE 15): publish
+        ``store.free_bytes`` / ``store.used_frac`` and run the
+        enter/exit-low logic even when no wave is ticking — a quiet
+        service on a filling disk must still see (and shed) it."""
+        try:
+            if self.fleet is not None:
+                for sched in list(self.fleet.schedulers.values()):
+                    sched.store_health(force=True)
+            elif self.scheduler is not None:
+                self.scheduler.store_health(force=True)
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            pass
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -725,7 +769,7 @@ def _make_handler(server):
                 # JSON body carries it too (service/client.py reads the
                 # payload; standard HTTP clients follow the header)
                 self.send_header("Location", str(payload["location"]))
-            if (status in (429, 503) and isinstance(payload, dict)
+            if (status in (429, 503, 507) and isinstance(payload, dict)
                     and payload.get("retry_after") is not None):
                 # RFC 7231 delta-seconds is an INTEGER — a fractional
                 # header is discarded by standard clients/proxies.  The
@@ -754,6 +798,7 @@ def _make_handler(server):
                             server.compile_plane.publish()
                     except Exception:  # noqa: BLE001 - fail-open scrape
                         pass
+                    server._refresh_store_gauges()
                     server._count_response(method, path, 200)
                     self._answer(
                         200, prometheus_text().encode(),

@@ -178,22 +178,46 @@ class FileWorker:
             logger.error("job %s: heartbeat thread stuck; leaving claim for "
                          "stale reclaim", doc["tid"])
             return False
-        try:
-            if error is not None:
-                logger.error("job %s failed: %s", doc["tid"], error)
-                self.store.finish(doc, error=error)
+        from .exceptions import StoreFullError
+
+        attempt = 0
+        while True:
+            try:
+                if error is not None:
+                    logger.error("job %s failed: %s", doc["tid"], error)
+                    self.store.finish(doc, error=error)
+                    return False
+                self.store.finish(doc, result=result)
+                return True
+            except StoreFullError as e:
+                # a full disk is transient (ISSUE 15: the serving side
+                # is compacting/GCing): back off and retry the terminal
+                # write instead of dropping a finished result on the
+                # floor — the evaluation is the expensive part
+                if not self.retry.retries_left(attempt + 1):
+                    logger.warning(
+                        "store full finishing job %s after %d retries: "
+                        "%s (claim left for stale/orphan recovery)",
+                        doc["tid"], attempt, e)
+                    return False
+                delay = self.retry.delay(
+                    attempt, key=f"enospc:{self.owner}:{doc['tid']}")
+                self.store.metrics.counter("store.enospc_retries").inc()
+                logger.warning("store full finishing job %s; retrying "
+                               "in %.2fs (%s)", doc["tid"], delay, e)
+                time.sleep(delay)
+                attempt += 1
+                continue
+            except OSError as e:
+                # the terminal write failed (NFS blip, chaos-injected):
+                # the claim (running doc or orphaned *.finish.* rename)
+                # is exactly what the stale-reclaim/orphan-sweep
+                # machinery recovers — surviving here beats taking the
+                # worker down with the store
+                logger.warning("store I/O error finishing job %s: %s "
+                               "(claim left for stale/orphan recovery)",
+                               doc["tid"], e)
                 return False
-            self.store.finish(doc, result=result)
-            return True
-        except OSError as e:
-            # the terminal write failed (NFS blip, chaos-injected): the
-            # claim (running doc or orphaned *.finish.* rename) is exactly
-            # what the stale-reclaim/orphan-sweep machinery recovers —
-            # surviving here beats taking the worker down with the store
-            logger.warning("store I/O error finishing job %s: %s "
-                           "(claim left for stale/orphan recovery)",
-                           doc["tid"], e)
-            return False
 
 
 def main(argv=None):

@@ -58,6 +58,15 @@
 # (warming rand floor, flagged), promotes them once the background
 # queue drains, and a restart on the same store pre-warms the census
 # kernel bank so the same spaces' first TPE asks are served on-device.
+# Opt-in store gate: STORE_GATE=1 additionally re-runs the storage-
+# integrity suites (checksummed WAL classification table, quarantine
+# semantics, ENOSPC backpressure) and then scripts/store_chaos_smoke.py
+# — a real subprocess server under concurrent clients with seeded WAL
+# bit-flips and injected ENOSPC: corrupt studies quarantine (410)
+# instead of crashing the boot, healthy studies lose zero acknowledged
+# tells and propose bitwise vs an undisturbed reference, 507 sheds
+# carry Retry-After and recover when space frees, and scrub detects
+# 100% of the injected corruptions with --repair booting clean.
 # Opt-in SLO gate: SLO_GATE=1 additionally re-runs the request-trace /
 # SLO / timeline suites and then scripts/slo_smoke.py — a real
 # subprocess server with tracing + SLO + access log armed serves one
@@ -128,6 +137,12 @@ if [ "${COMPILE_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_compile_plane.py tests/test_service.py \
         -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/coldstart_smoke.py || exit 1
+fi
+if [ "${STORE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_integrity.py tests/test_journal.py \
+        tests/test_filestore.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/store_chaos_smoke.py || exit 1
 fi
 if [ "${SLO_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -1,0 +1,596 @@
+"""ISSUE 15: the storage-integrity survival plane — CRC32C sealed
+records, the ok/torn/corrupt classification table, per-study corruption
+quarantine (410, never a boot failure), pre-ISSUE-15 back-compat pinned
+bitwise, ENOSPC backpressure, store GC, scrub & repair."""
+
+import errno
+import json
+import os
+import re
+
+import pytest
+
+from hyperopt_tpu import chaos, hp
+from hyperopt_tpu.exceptions import StoreFullError
+from hyperopt_tpu.service import (QuarantinedStudyError, StudyJournal,
+                                  StudyScheduler)
+from hyperopt_tpu.service import integrity
+from hyperopt_tpu.service.journal import (JournalCorruptError,
+                                          JournalError, JournalFullError)
+from hyperopt_tpu.service.overload import AdmissionGuard, StoreFullShed
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPEC = {"space": {"x": {"dist": "uniform", "args": [-5, 5]}}}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    chaos.configure(None)
+    yield
+    chaos.reset()
+
+
+def _flip_digit(line):
+    """Deterministically corrupt one line: bump its first digit (keeps
+    the JSON framing intact — the checksum must catch it)."""
+    return re.sub(r"\d", lambda m: str((int(m.group(0)) + 1) % 10),
+                  line, count=1)
+
+
+def _drive(sched, sid, n):
+    seq = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+        seq.append((a["tid"], repr(a["params"]["x"])))
+    return seq
+
+
+def _reference(seed, n, n_startup=2):
+    ref = StudyScheduler(wal=False)
+    sid = ref.create_study(SPACE, seed=seed, n_startup_jobs=n_startup)
+    return _drive(ref, sid, n)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_crc32c_check_value():
+    """The RFC 3720 CRC32C check value — pins the polynomial forever
+    (a different poly would silently orphan every sealed record)."""
+    assert integrity.crc32c(b"123456789") == 0xE3069283
+    assert integrity.crc32c(b"") == 0
+
+
+def test_seal_verify_round_trip():
+    rec = {"kind": "ask", "sid": "s1", "tids": [0, 1], "seed": 123,
+           "loss": 0.125, "ts": 1722800000.25}
+    line = integrity.seal(rec)
+    parsed = json.loads(line)
+    assert integrity.verify_obj(parsed) == integrity.OK
+    assert parsed == rec  # the checksum field was popped
+
+
+def test_seal_refuses_double_seal():
+    with pytest.raises(ValueError):
+        integrity.seal({"kind": "x", "c": "deadbeef"})
+
+
+def test_classification_table(tmp_path):
+    """The satellite's table: bit-flip, truncated mid-file line,
+    truncated final record, duplicate line, empty file, pre-ISSUE-15
+    unchecksummed file."""
+    recs = [{"kind": "admit", "sid": f"s{i}", "seed": i}
+            for i in range(6)]
+    sealed = [integrity.seal(r) for r in recs]
+
+    # bit-flip mid-file -> corrupt; duplicate line -> ok twice;
+    # truncated mid-file line -> corrupt; truncated final record -> torn
+    path = str(tmp_path / "table.jsonl")
+    with open(path, "w") as f:
+        f.write(sealed[0] + "\n")
+        f.write(_flip_digit(sealed[1]) + "\n")
+        f.write(sealed[2] + "\n")
+        f.write(sealed[2] + "\n")          # duplicate line
+        f.write(sealed[3][:25] + "\n")     # truncated mid-file
+        f.write(sealed[4] + "\n")
+        f.write(sealed[5][:-9])            # truncated record boundary
+    got = [(c.status, c.lineno) for c in integrity.iter_checked_jsonl(path)]
+    assert got == [(integrity.OK, 1), (integrity.CORRUPT, 2),
+                   (integrity.OK, 3), (integrity.OK, 4),
+                   (integrity.CORRUPT, 5), (integrity.OK, 6),
+                   (integrity.TORN, 7)]
+
+    # empty file
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert list(integrity.iter_checked_jsonl(empty)) == []
+
+    # pre-ISSUE-15 unchecksummed file: every line classifies unchecked
+    # and parses to the record verbatim
+    old = str(tmp_path / "old.jsonl")
+    with open(old, "w") as f:
+        for r in recs[:3]:
+            f.write(json.dumps(r, sort_keys=True,
+                               separators=(",", ":")) + "\n")
+    got = list(integrity.iter_checked_jsonl(old))
+    assert [c.status for c in got] == [integrity.UNCHECKED] * 3
+    assert [c.rec for c in got] == recs[:3]
+
+
+def test_salvage_sid():
+    line = integrity.seal({"kind": "tell", "sid": "study-abc", "tid": 3})
+    assert integrity.salvage_sid(line[: len(line) // 1]) == "study-abc"
+    assert integrity.salvage_sid('{"kind":"tell","ti') is None
+
+
+def test_is_enospc():
+    assert integrity.is_enospc(OSError(errno.ENOSPC, "full"))
+    assert not integrity.is_enospc(OSError(errno.EIO, "io"))
+    assert not integrity.is_enospc(ValueError("x"))
+
+
+def test_disk_watermark_thresholds():
+    class _SV:
+        f_frsize = 4096
+        f_blocks = 1000
+        f_bavail = 10  # 1% free, 40960 bytes
+
+    wm = integrity.DiskWatermark("/", threshold=0.02, poll_sec=0.0,
+                                 statvfs=lambda _p: _SV())
+    s = wm.sample(force=True)
+    assert s["low"] and s["free_bytes"] == 40960
+    wm_bytes = integrity.DiskWatermark("/", threshold=50000, poll_sec=0.0,
+                                       statvfs=lambda _p: _SV())
+    assert wm_bytes.sample(force=True)["low"]
+    wm_off = integrity.DiskWatermark("/", threshold=None, poll_sec=0.0,
+                                     statvfs=lambda _p: _SV())
+    assert not wm_off.sample(force=True)["low"]
+
+
+# ---------------------------------------------------------------------------
+# journal: typed ENOSPC, verified compaction
+# ---------------------------------------------------------------------------
+
+
+def test_journal_enospc_is_typed_and_retryable(tmp_path):
+    j = StudyJournal(str(tmp_path / "wal.jsonl"))
+    chaos.configure("7:enospc@wal:1.0")
+    with pytest.raises(JournalFullError) as ei:
+        j.append({"kind": "ask", "sid": "s1"})
+    assert isinstance(ei.value, StoreFullError)
+    assert isinstance(ei.value, JournalError)
+    chaos.configure(None)
+    j.append({"kind": "ask", "sid": "s1"})  # recovers
+    j.sync()
+
+
+def test_rewrite_refuses_to_launder_corruption(tmp_path):
+    """Compaction aborts (keeping the old chain) when the records it
+    would discard fail verification — the satellite's laundering
+    window."""
+    path = str(tmp_path / "wal.jsonl")
+    j = StudyJournal(path)
+    for i in range(4):
+        j.append({"kind": "ask", "sid": "s1", "seed": i})
+    j.close()
+    lines = open(path).read().splitlines()
+    lines[1] = _flip_digit(lines[1])
+    open(path, "w").write("\n".join(lines) + "\n")
+    before = open(path).read()
+    with pytest.raises(JournalCorruptError):
+        j.rewrite([{"kind": "snapshot", "sid": "s1"}])
+    assert open(path).read() == before  # old chain intact
+
+
+def test_atomic_write_enospc_typed(tmp_path, monkeypatch):
+    from hyperopt_tpu import filestore
+
+    def bomb(_path, _payload):
+        raise OSError(errno.ENOSPC, "disk full")
+
+    monkeypatch.setattr(os, "replace",
+                        lambda *a: (_ for _ in ()).throw(
+                            OSError(errno.ENOSPC, "full")))
+    with pytest.raises(StoreFullError):
+        filestore._atomic_write(str(tmp_path / "f"), b"x")
+    _ = bomb
+
+
+# ---------------------------------------------------------------------------
+# quarantine: per-study fault, never a process fault
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_record_quarantines_study_not_process(tmp_path):
+    """The tentpole pin: one corrupt mid-file record quarantines ITS
+    study (410), every untouched study resumes bit-identically, the
+    segment is renamed aside with a reason record, and a second resume
+    is idempotent."""
+    ref = _reference(7, 9)
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    sa = s1.create_study(SPACE, seed=7, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-a")
+    sb = s1.create_study(SPACE, seed=11, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-b")
+    first = _drive(s1, sa, 5)
+    _drive(s1, sb, 5)
+    del s1
+
+    lines = open(wal).read().splitlines()
+    idx = max(i for i, l in enumerate(lines)
+              if '"sid":"study-b"' in l and i < len(lines) - 2)
+    lines[idx] = _flip_digit(lines[idx])
+    open(wal, "w").write("\n".join(lines) + "\n")
+
+    s2 = StudyScheduler(wal=wal)
+    assert s2.last_resume["corrupt_records"] == 1
+    assert s2.last_resume["quarantined"] == 1
+    assert s2.last_resume["errors"] == 0
+    with pytest.raises(QuarantinedStudyError):
+        s2.ask("study-b")
+    with pytest.raises(QuarantinedStudyError):
+        s2.tell("study-b", 0, 0.5)
+    # the evidence segment, with its sealed reason record
+    qpath = wal + ".quarantined"
+    assert os.path.exists(qpath)
+    tail = list(integrity.iter_checked_jsonl(qpath))[-1]
+    assert tail.rec["kind"] == "quarantine_reason"
+    assert tail.status == integrity.OK
+    # untouched study: bitwise continuation
+    assert first + _drive(s2, sa, 4) == ref
+    # /studies lists the quarantined study
+    table = s2.studies_status()
+    states = {s["study_id"]: s["state"] for s in table["studies"]}
+    assert states["study-b"] == "quarantined"
+    assert "study-b" in table["quarantined"]
+    # timeline carries the quarantine event
+    tl = s2.study_timeline("study-b")
+    assert any(ev["event"] == "quarantine" for ev in tl["events"])
+    # resume twice with the quarantined segment present: idempotent
+    del s2
+    s3 = StudyScheduler(wal=wal)
+    assert "study-b" in s3._quarantined
+    with pytest.raises(QuarantinedStudyError):
+        s3.ask("study-b")
+    states = {s["study_id"]: s["state"]
+              for s in s3.studies_status()["studies"]}
+    assert states == {"study-a": "active", "study-b": "quarantined"}
+
+
+def test_quarantined_http_semantics(tmp_path):
+    """410 + quarantined flag over the REAL handler path, /studies
+    flag, timeline event — the satellite's HTTP table."""
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    sid = s1.create_study(SPACE, seed=3, n_startup_jobs=2,
+                          space_spec=SPEC, study_id="study-q")
+    _drive(s1, sid, 4)
+    del s1
+    wal = os.path.join(root, "service.wal.jsonl")
+    lines = open(wal).read().splitlines()
+    idx = max(i for i, l in enumerate(lines) if '"sid":"study-q"' in l
+              and i < len(lines) - 1)
+    lines[idx] = _flip_digit(lines[idx])
+    open(wal, "w").write("\n".join(lines) + "\n")
+
+    server = ServiceHTTPServer(0, scheduler=StudyScheduler(
+        store_root=root))
+    code, payload = server.handle("POST", "/ask", {"study_id": "study-q"})
+    assert code == 410 and payload["quarantined"] is True
+    code, payload = server.handle("POST", "/tell",
+                                  {"study_id": "study-q", "tid": 0,
+                                   "loss": 0.1})
+    assert code == 410
+    code, table = server.handle("GET", "/studies", {})
+    assert code == 200
+    entry = next(s for s in table["studies"]
+                 if s["study_id"] == "study-q")
+    assert entry["state"] == "quarantined"
+    code, tl = server.handle("GET", "/study/study-q/timeline", {})
+    assert code == 200
+    assert any(ev["event"] == "quarantine" for ev in tl["events"])
+
+
+def test_corrupt_tail_tell_reconciles_from_store(tmp_path):
+    """A bit-flip on the FINAL WAL line (an acknowledged tell) is
+    indistinguishable from a torn tail — but the doc already settled
+    DONE in the store, so resume reconciles the counter instead of
+    reporting a phantom pending ask; the study stays healthy and its
+    stream bitwise (smoke-found regression)."""
+    ref = _reference(17, 8)
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    sid = s1.create_study(SPACE, seed=17, n_startup_jobs=2,
+                          space_spec=SPEC, study_id="study-t")
+    first = _drive(s1, sid, 5)
+    del s1
+    wal = os.path.join(root, "service.wal.jsonl")
+    lines = open(wal).read().splitlines()
+    assert '"kind":"tell"' in lines[-1]
+    lines[-1] = lines[-1][:-10]  # destroy the final (tell) record
+    open(wal, "w").write("\n".join(lines) + "\n")
+    s2 = StudyScheduler(store_root=root)
+    assert s2.last_resume["reconciled_tells"] == 1
+    assert s2.last_resume["quarantined"] == 0
+    st = s2.study_status(sid)
+    assert st["state"] == "active" and st["n_pending"] == 0
+    assert first + _drive(s2, sid, 3) == ref
+
+
+def test_pre_issue15_wal_resumes_bitwise(tmp_path):
+    """Back-compat acceptance pin: an UNCHECKSUMMED (pre-ISSUE-15) WAL
+    resumes bit-identically on the new code path."""
+    ref = _reference(21, 10)
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    s1.journal.checksum = False  # write the old format
+    sid = s1.create_study(SPACE, seed=21, n_startup_jobs=2,
+                          space_spec=SPEC, study_id="study-old")
+    first = _drive(s1, sid, 6)
+    del s1
+    # no record carries the checksum field
+    for rec in list(StudyJournal(wal).records()):
+        assert "c" not in rec
+    s2 = StudyScheduler(wal=wal)  # new code path, checksums armed
+    assert s2.last_resume["unchecked"] > 0
+    assert s2.last_resume["verified"] == 0
+    assert s2.last_resume["corrupt_records"] == 0
+    assert first + _drive(s2, sid, 4) == ref
+
+
+def test_fleet_adoption_corrupt_middle_epoch(tmp_path):
+    """The satellite's chain case: adoption of an epoch chain whose
+    MIDDLE epoch holds a corrupt record quarantines that study and
+    adopts every other bit-identically (a per-study fault — the shard
+    still serves)."""
+    from hyperopt_tpu.service.fleet import FleetReplica
+
+    root = str(tmp_path)
+    wal_dir = os.path.join(root, "fleet", "wal", "shard0000")
+    os.makedirs(wal_dir)
+    e1 = os.path.join(wal_dir, "e00001.seed.jsonl")
+    e2 = os.path.join(wal_dir, "e00002.seed.jsonl")
+
+    ref = _reference(31, 8)
+    s1 = StudyScheduler(store_root=root, wal=e1)
+    sa = s1.create_study(SPACE, seed=31, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-a")
+    sb = s1.create_study(SPACE, seed=37, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-b")
+    first = _drive(s1, sa, 3)
+    _drive(s1, sb, 3)
+    del s1
+    s2 = StudyScheduler(store_root=root, wal=e2, auto_resume=False)
+    s2.resume(StudyJournal(e1))
+    first += _drive(s2, sa, 2)
+    _drive(s2, sb, 2)
+    del s2
+    # corrupt one study-b record in the MIDDLE epoch (e2 is the newest
+    # of the seed chain; the adopter's own epoch comes after it)
+    lines = open(e2).read().splitlines()
+    idx = max(i for i, l in enumerate(lines) if '"sid":"study-b"' in l
+              and i < len(lines) - 1)
+    lines[idx] = _flip_digit(lines[idx])
+    open(e2, "w").write("\n".join(lines) + "\n")
+
+    replica = FleetReplica(root, n_shards=1, replica_id="r1",
+                           lease_ttl=30.0,
+                           scheduler_kwargs={"max_studies": 64})
+    assert replica.adopt(0) is True
+    sched = replica.schedulers[0]
+    assert "study-b" in sched._quarantined
+    with pytest.raises(QuarantinedStudyError):
+        sched.ask("study-b")
+    # the corrupt epoch file was preserved as evidence
+    assert any(f.startswith("e00002") and ".quarantined" in f
+               for f in os.listdir(wal_dir))
+    # the healthy study adopted bit-identically and keeps proposing
+    assert first + _drive(sched, sa, 3) == ref
+    # quarantine survives the adopter's own compacted epoch
+    kinds = {r["kind"] for r in sched.journal.records()}
+    assert "quarantine" in kinds
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC backpressure + store hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_store_full_latch_sheds_and_reprobes():
+    t = [0.0]
+    guard = AdmissionGuard(max_queue=4, clock=lambda: t[0])
+    guard.set_store_full(True, reason="disk full", retry_after=1.0)
+    with pytest.raises(StoreFullShed) as ei:
+        guard.admit_ask()
+    assert ei.value.retry_after == 1.0
+    # tells are NOT shed by the store-full latch (shed last)
+    assert guard.admit_tell() == "tell"
+    guard.release("tell")
+    # latch expires -> the next ask is the probe
+    t[0] = 2.1
+    assert guard.admit_ask() == "ask"
+    guard.release("ask")
+
+
+def test_enospc_latch_survives_healthy_watermark(tmp_path):
+    """Review pin: an ENOSPC-armed latch must NOT clear just because
+    statvfs shows free blocks (EDQUOT, failing controller) — only a
+    successful durable write clears it; and a WATERMARK-armed latch
+    keeps the guard re-armed while space stays low (the guard window
+    would otherwise expire after ~2s of shedding)."""
+    root = str(tmp_path)
+    sched = StudyScheduler(store_root=root)
+    guard = AdmissionGuard(max_queue=4, metrics=sched.metrics)
+    sched.overload = guard
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2,
+                             space_spec=SPEC)
+    a = sched.ask(sid)[0]
+    chaos.configure("7:enospc@wal:1.0")
+    with pytest.raises(StoreFullError):
+        sched.tell(sid, a["tid"], 0.5)
+    assert sched._store_full and sched._store_full_src == "enospc"
+    # a watermark poll showing plenty of space must NOT clear it
+    sched._check_store(force=True)
+    assert sched._store_full
+    # ...but a successful durable write must
+    chaos.configure(None)
+    sched.tell(sid, a["tid"], 0.5)
+    assert not sched._store_full
+
+    # watermark-armed: the guard latch re-arms on every low poll
+    t = [0.0]
+    guard2 = AdmissionGuard(max_queue=4, clock=lambda: t[0])
+    sched.overload = guard2
+    sched.watermark = integrity.DiskWatermark(
+        root, threshold=0.999999, poll_sec=0.0)  # everything is "low"
+    sched._check_store(force=True)
+    assert sched._store_full_src == "watermark"
+    t[0] = 10.0  # past the guard window: would have expired...
+    sched._check_store(force=True)  # ...but the low poll re-arms it
+    with pytest.raises(StoreFullShed):
+        guard2.admit_ask()
+    # space returns: the watermark latch clears on the poll
+    sched.watermark = integrity.DiskWatermark(root, threshold=None,
+                                              poll_sec=0.0)
+    sched._check_store(force=True)
+    assert not sched._store_full
+
+
+def test_enospc_on_tell_is_507_typed_and_recovers(tmp_path):
+    """ENOSPC at the tell's durability point: typed StoreFullError out
+    (507), nothing applied, and the SAME tell lands once space frees —
+    tells shed last, never lost."""
+    root = str(tmp_path)
+    sched = StudyScheduler(store_root=root)
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2,
+                             space_spec=SPEC)
+    a = sched.ask(sid)[0]
+    chaos.configure("7:enospc@wal:1.0")
+    with pytest.raises(StoreFullError):
+        sched.tell(sid, a["tid"], 0.5)
+    st = sched.study_status(sid)
+    assert st["n_told"] == 0  # write-ahead: nothing applied
+    chaos.configure(None)
+    sched.tell(sid, a["tid"], 0.5)  # the retry lands
+    assert sched.study_status(sid)["n_told"] == 1
+
+
+def test_filestore_gc_reclaims_garbage(tmp_path):
+    import pickle
+    import time as _time
+
+    from hyperopt_tpu.filestore import FileStore
+
+    store = FileStore(str(tmp_path / "st"))
+    doc = {"tid": 1, "state": 2, "result": {"loss": 0.5},
+           "misc": {}, "owner": None, "book_time": None,
+           "refresh_time": None}
+    store.write_doc(doc)  # done/1.pkl
+    # superseded new/ copy beside the terminal doc
+    with open(os.path.join(store.root, "new", "1.pkl"), "wb") as f:
+        f.write(pickle.dumps(dict(doc, state=0)))
+    # stale tmp + expired flight dump + fresh tmp (must survive)
+    old = _time.time() - 3600
+    stale = os.path.join(store.root, "done", "1.pkl.tmp.9.9")
+    open(stale, "wb").write(b"\0" * 64)
+    os.utime(stale, (old, old))
+    fresh = os.path.join(store.root, "done", "2.pkl.tmp.8.8")
+    open(fresh, "wb").write(b"\0" * 64)
+    dump = store.flight_dump_path("host:1")
+    open(dump, "w").write('{"kind":"x"}\n')
+    os.utime(dump, (old - 8 * 86400, old - 8 * 86400))
+    q = os.path.join(store.root, "done", "9.pkl.quarantined")
+    open(q, "wb").write(b"evidence")
+
+    stats = store.gc(tmp_max_age=60.0, flight_max_age=7 * 86400.0)
+    assert stats["removed"] == 3
+    assert stats["reclaimed_bytes"] > 0
+    assert not os.path.exists(os.path.join(store.root, "new", "1.pkl"))
+    assert not os.path.exists(stale)
+    assert not os.path.exists(dump)
+    assert os.path.exists(fresh)      # live writer's tmp untouched
+    assert os.path.exists(q)          # evidence never collected
+    assert os.path.exists(store._path(2, 1))  # the real doc stays
+
+
+def test_gc_store_root_removes_compacted_ancestor_epochs(tmp_path):
+    root = str(tmp_path)
+    d = os.path.join(root, "fleet", "wal", "shard0000")
+    os.makedirs(d)
+    j1 = StudyJournal(os.path.join(d, "e00001.r0.jsonl"))
+    j1.append({"kind": "admit", "sid": "s1", "seed": 1})
+    j1.close()
+    j2 = StudyJournal(os.path.join(d, "e00002.r1.jsonl"))
+    j2.append({"kind": "snapshot", "sid": "s1", "seed": 1})
+    j2.close()
+    stats = integrity.gc_store_root(root)
+    assert stats["removed"] == 1
+    assert not os.path.exists(j1.path)   # ancestor redundant: removed
+    assert os.path.exists(j2.path)       # snapshot-led head stays
+
+
+def test_census_write_failure_under_disk_full(monkeypatch, caplog):
+    """The satellite: census appends degrade to warn-once on ENOSPC —
+    never an exception, never a second warning."""
+    import logging
+
+    from hyperopt_tpu.service.compile_plane import SignatureCensus
+
+    census = SignatureCensus("/tmp/does-not-matter-census.jsonl")
+    real_open = os.open
+
+    def full_open(path, flags, mode=0o777):
+        if "census" in str(path):
+            raise OSError(errno.ENOSPC, "disk full")
+        return real_open(path, flags, mode)
+
+    monkeypatch.setattr(os, "open", full_open)
+    spec = {"space": {"x": {"dist": "uniform", "args": [0, 1]}}}
+    with caplog.at_level(logging.WARNING):
+        for _ in range(9):  # crosses the 1 and 8 milestones
+            census.note(spec, {"gamma": 0.25}, 16, 1, 1)
+    warnings = [r for r in caplog.records
+                if "census" in r.getMessage()]
+    assert len(warnings) == 1  # warn-once
+    assert census._counts  # counting continues in-process
+
+
+def test_scrub_detects_and_repairs(tmp_path):
+    from hyperopt_tpu.service import scrub
+
+    root = str(tmp_path)
+    s1 = StudyScheduler(store_root=root)
+    sa = s1.create_study(SPACE, seed=41, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-a")
+    sb = s1.create_study(SPACE, seed=43, n_startup_jobs=2,
+                         space_spec=SPEC, study_id="study-b")
+    _drive(s1, sa, 3)
+    _drive(s1, sb, 3)
+    del s1
+    wal = os.path.join(root, "service.wal.jsonl")
+    lines = open(wal).read().splitlines()
+    idx = max(i for i, l in enumerate(lines) if '"sid":"study-b"' in l
+              and i < len(lines) - 1)
+    lines[idx] = _flip_digit(lines[idx])
+    open(wal, "w").write("\n".join(lines) + "\n")
+
+    report = scrub.scan_store(root)
+    assert not report["clean"]
+    assert any(f["kind"] == "wal_corrupt" and f["sid"] == "study-b"
+               for f in report["faults"])
+    actions = scrub.repair_store(root, report)
+    assert any(a["action"] == "quarantine_segment" for a in actions)
+    post = scrub.scan_store(root)
+    assert post["clean"]
+    # the repaired store boots: healthy active, corrupt quarantined
+    s2 = StudyScheduler(store_root=root)
+    states = {s["study_id"]: s["state"]
+              for s in s2.studies_status()["studies"]}
+    assert states["study-a"] == "active"
+    assert states["study-b"] == "quarantined"
