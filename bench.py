@@ -523,6 +523,132 @@ def bench_trace_overhead(n_asks=40, repeats=3, seed=0):
     return out
 
 
+def bench_search_quality(n_studies=10, seed0=0):
+    """The standing per-algo search-quality table (ISSUE 16): the zoo
+    study mix run to budget under each algorithm (tpe / rand / anneal /
+    mix / atpe), summarized per algo as ``trials_to_target_<algo>``
+    (mean 1-based trial index of the first target-clearing loss; budget
+    when unsolved), ``final_regret_<algo>`` (mean simple regret vs the
+    known optimum at budget exhaustion) and ``solved_frac_<algo>``.
+
+    These keys are the megakernel's quality bars: ROADMAP item 1's
+    int8/fp8 history + fused Pallas scoring loop cannot be bit-exact-
+    pinned against the f32 reference, so those PRs land against the
+    windowed directional gates on THIS table instead (direction
+    metadata in ``trajectory.KEY_DIRECTIONS``)."""
+    from functools import partial
+
+    from hyperopt_tpu import Trials, fmin
+    from hyperopt_tpu.algos import anneal, atpe, mix, rand, tpe
+    from hyperopt_tpu.obs.quality import summarize_run
+    from hyperopt_tpu.zoo import make_study_mix
+
+    items = make_study_mix(n_studies, seed0)
+    # every item's tpe serving matches its mix-declared startup count,
+    # so the table measures the posterior, not a startup-budget skew
+    tpe5 = partial(tpe.suggest, n_startup_jobs=5)
+    algos = {
+        "tpe": tpe5,
+        "rand": rand.suggest,
+        "anneal": anneal.suggest,
+        "mix": partial(mix.suggest,
+                       p_suggest=[(0.25, rand.suggest), (0.75, tpe5)]),
+        "atpe": atpe.suggest,
+    }
+    out = {"n_studies": len(items), "seed0": seed0,
+           "bar": "tpe beats rand on trials-to-target over the zoo mix"}
+    table = {}
+    for name, algo in algos.items():
+        t2t, regrets, solved = [], [], 0
+        for m in items:
+            t = Trials()
+            fmin(m.domain.objective, m.domain.space, algo=algo,
+                 max_evals=m.budget, trials=t,
+                 rstate=np.random.default_rng(m.seed),
+                 show_progressbar=False)
+            s = summarize_run(t.losses(), m.budget,
+                              loss_target=m.domain.loss_target,
+                              optimum=m.domain.optimum)
+            t2t.append(s["trials_to_target"])
+            solved += 1 if s["solved"] else 0
+            if s["final_regret"] is not None:
+                regrets.append(s["final_regret"])
+        table[name] = {
+            "trials_to_target": float(np.mean(t2t)),
+            "final_regret": (float(np.mean(regrets))
+                             if regrets else None),
+            "solved_frac": solved / len(items),
+        }
+        out[f"trials_to_target_{name}"] = table[name]["trials_to_target"]
+        if table[name]["final_regret"] is not None:
+            out[f"final_regret_{name}"] = table[name]["final_regret"]
+        out[f"solved_frac_{name}"] = table[name]["solved_frac"]
+    out["per_algo"] = table
+    # the standing table also lands as a kind="quality" record so the
+    # trajectory store carries search quality alongside the perf rows
+    # (trajectory.load filters kind=="bench"; the gate is untouched)
+    try:
+        from hyperopt_tpu.obs import trajectory
+        from hyperopt_tpu.obs.quality import quality_record
+
+        trajectory.append(quality_record(
+            "bench.search_quality", table,
+            config={"n_studies": len(items), "seed0": seed0}))
+    except Exception as e:  # noqa: BLE001 - the record is best-effort
+        out["trajectory_error"] = str(e)
+    return out
+
+
+def bench_quality_overhead(n_tells=150, repeats=5, seed=0):
+    """Quality-plane acceptance bar (ISSUE 16): the per-tell convergence
+    tracker (incremental best, EWMA, plateau detector, timeline events)
+    must cost ~nothing on the serving path.  Drives the REAL handler
+    path (``ServiceHTTPServer.handle`` ask+tell rounds) with the quality
+    plane armed vs disarmed, same seed, all-rand asks (startup count >
+    round count, so no TPE compile pollutes the min-of-reps), and
+    reports the fractional delta as ``quality_overhead_frac`` — gated
+    ABSOLUTE at ≤5% (the ``checksum_overhead_frac`` pattern)."""
+    from hyperopt_tpu.obs.quality import QualityPlane
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    space_spec = {"x": {"dist": "uniform", "args": [-5, 10]},
+                  "y": {"dist": "uniform", "args": [0, 15]}}
+
+    def once(armed):
+        sched = StudyScheduler(
+            wal=False, quality=QualityPlane() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, trace=False,
+                                slo=False)
+        code, r = srv.handle("POST", "/study", {
+            "space": space_spec, "seed": seed,
+            "n_startup_jobs": n_tells + 1})
+        assert code == 200, r
+        sid = r["study_id"]
+        t0 = time.perf_counter()
+        for i in range(n_tells):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid})
+            assert code == 200, a
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": a["trials"][0]["tid"],
+                "loss": float(i % 7)})
+            assert code == 200
+        return time.perf_counter() - t0
+
+    once(False)  # warm the route/admission path for both sides
+    out = {"n_tells": n_tells, "repeats": repeats,
+           "bar": "quality plane <=5% per ask+tell round (absolute)"}
+    out["quality_off_sec"] = min(once(False) for _ in range(repeats))
+    out["quality_on_sec"] = min(once(True) for _ in range(repeats))
+    out["quality_overhead_frac"] = (
+        (out["quality_on_sec"] - out["quality_off_sec"])
+        / max(out["quality_off_sec"], 1e-9))
+    out["quality_overhead_us_per_tell"] = (
+        (out["quality_on_sec"] - out["quality_off_sec"])
+        / n_tells * 1e6)
+    return out
+
+
 def bench_fleet_recovery(reps=5, lease_ttl=0.25, poll=0.01):
     """Elastic-fleet recovery latency (ISSUE 8): wall seconds from a
     controller dying mid-shard (claimed lease, heartbeats stop) to a
@@ -1936,6 +2062,13 @@ _JAX_STAGES = (
     # real serving path (gated ≤5% absolute), planted-garbage GC
     # reclaim, offline scrub throughput
     ("store_integrity", bench_store_integrity),
+    # ISSUE 16: the standing per-algo search-quality table — the zoo mix
+    # to budget under tpe/rand/anneal/mix/atpe (the megakernel's quality
+    # bars: trials_to_target_*, final_regret_*, solved_frac_*)
+    ("search_quality", bench_search_quality),
+    # ISSUE 16: quality-plane overhead bar — armed vs disarmed per-tell
+    # delta through the real handler path (gated ≤5% absolute)
+    ("quality_overhead", bench_quality_overhead),
 )
 
 _PROBE_SNIPPET = (
@@ -2207,6 +2340,25 @@ def main():
             for k in ("checksum_overhead_frac", "gc_reclaimed_bytes",
                       "scrub_records_per_sec",
                       "study_round_p99_ms_checksum")}
+    # the per-algo search-quality table (ISSUE 16) rides along: the
+    # megakernel's quality bars, visible round over round
+    rec = stages.get("search_quality")
+    if rec and rec.get("ok"):
+        r = rec["result"]
+        obs_summary["search_quality"] = {
+            a: {k: (r.get("per_algo") or {}).get(a, {}).get(k)
+                for k in ("trials_to_target", "final_regret",
+                          "solved_frac")}
+            for a in ("tpe", "rand", "anneal", "mix", "atpe")}
+    # the quality-plane overhead bar (ISSUE 16) rides along: armed vs
+    # disarmed per-tell delta, gated absolute (quality_overhead_frac)
+    rec = stages.get("quality_overhead")
+    if rec and rec.get("ok"):
+        obs_summary["quality_overhead"] = {
+            k: rec["result"].get(k)
+            for k in ("quality_off_sec", "quality_on_sec",
+                      "quality_overhead_frac",
+                      "quality_overhead_us_per_tell")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact
@@ -2281,6 +2433,13 @@ def main():
                                              "gc_reclaimed_bytes"),
             "scrub_records_per_sec": _stage_val(
                 "store_integrity", "scrub_records_per_sec"),
+            # the standing per-algo quality table + the plane's cost
+            **{f"{k}_{a}": _stage_val("search_quality", f"{k}_{a}")
+               for k in ("trials_to_target", "final_regret",
+                         "solved_frac")
+               for a in ("tpe", "rand", "anneal", "mix", "atpe")},
+            "quality_overhead_frac": _stage_val(
+                "quality_overhead", "quality_overhead_frac"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

@@ -467,6 +467,71 @@ def parse_service_slo(env=None):
     return targets
 
 
+# -- search-quality observability knobs (ISSUE 16) --------------------------
+# Same warn-and-disable convention: a bad value must never take down the
+# service it would have observed.
+
+
+def parse_quality(env=None):
+    """``HYPEROPT_TPU_QUALITY`` → whether the search-quality telemetry
+    plane (``obs/quality.py``) is armed on the scheduler.  Default ON —
+    quality tracking is pure tell-time metadata (no threads, never
+    touches proposals, O(1) per tell), and a serving fleet that cannot
+    tell "optimizing" from "plateaued" is flying blind.  ``0``/``off``
+    disarms everything: no trackers, no gauges, no timeline events (the
+    bench ``quality_overhead`` stage measures the armed-vs-disarmed
+    per-tell delta)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_QUALITY", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def parse_quality_slo(env=None):
+    """``HYPEROPT_TPU_QUALITY_SLO`` → the stagnant-fraction objective the
+    quality plane feeds into the server's SLO burn-rate plane, or None
+    when disabled:
+
+    * unset / ``1`` / ``on`` → the default ``stagnation`` objective
+      (≥90% of live tells land on non-stagnant studies);
+    * ``0`` / ``off`` → None — quality telemetry still runs, it just
+      does not burn an error budget;
+    * ``stagnant=N`` → allow N percent of live tells on stagnant
+      studies before burning budget.  Malformed tokens warn once and
+      keep the default.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_QUALITY_SLO", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        from .obs.slo import QUALITY_TARGETS
+
+        return {k: dict(v) for k, v in QUALITY_TARGETS.items()}
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    from .obs.slo import QUALITY_TARGETS
+
+    targets = {k: dict(v) for k, v in QUALITY_TARGETS.items()}
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        key, _, val = token.partition("=")
+        key = key.strip().lower()
+        try:
+            v = float(val)
+        except ValueError:
+            _warn_once("HYPEROPT_TPU_QUALITY_SLO", token,
+                       "a key=number token")
+            continue
+        if key in ("stagnant", "stagnation") and 0 <= v < 100:
+            # stagnant=0 means "any stagnant tell burns budget" — clamp
+            # under 1.0 so the objective stays a valid (0,1) target
+            targets["stagnation"]["target"] = min(0.9999, 1.0 - v / 100.0)
+        else:
+            _warn_once("HYPEROPT_TPU_QUALITY_SLO", token,
+                       "stagnant=<percent>")
+    return targets
+
+
 # -- cold-start compile plane knobs (ISSUE 14) ------------------------------
 # Same warn-and-disable convention: a bad value must never take down the
 # serving plane it would have warmed.

@@ -132,6 +132,21 @@ def to_trace_events(records, pid=0, name=None):
             instant(tids.get(r.get("thread", "MainThread")),
                     r.get("name", "event"), ts, "event",
                     r.get("attrs") or None)
+            # quality improvements (ISSUE 16) also plot as a best-loss
+            # counter track per study: the convergence curve rendered
+            # right under the serving spans that produced it
+            if r.get("name") == "quality.improvement":
+                attrs = r.get("attrs") or {}
+                best = attrs.get("best")
+                sid = attrs.get("study")
+                if best is not None and sid is not None:
+                    used_tracks.add(_TID_COUNTERS)
+                    events.append({
+                        "name": f"best_loss.{sid}", "ph": "C",
+                        "ts": _us(ts), "pid": pid,
+                        "tid": _TID_COUNTERS, "cat": "quality",
+                        "args": {"best_loss": float(best)},
+                    })
         elif kind == "trial_event":
             instant(_TID_TRIALS,
                     f"{r.get('event', '?')} tid={r.get('tid')}", ts, "trial",

@@ -1,0 +1,454 @@
+"""Search-quality observability plane (ISSUE 16).
+
+Every other gated surface in the repo measures *throughput* — asks/sec,
+p99s, HBM bytes.  This module measures whether the optimizer is actually
+*optimizing*, which is the gate the ROADMAP's megakernel arc needs:
+int8/fp8 history and a fused Pallas scoring loop cannot be bit-exact-
+pinned against the f32 reference, so they must instead clear directional
+search-quality bars.  Two halves:
+
+**Online convergence telemetry** (:class:`QualityPlane`, owned by the
+:class:`~hyperopt_tpu.service.scheduler.StudyScheduler`): per-study
+incremental tracking at tell time — zero device work, O(1) per tell —
+of the best-so-far curve, simple regret against the zoo entry's known
+``optimum``/``loss_target`` (resolved from the study's ``{"zoo": name}``
+space spec), an improvement-rate EWMA, trials-since-improvement, and a
+streaming plateau detector generalizing
+:func:`hyperopt_tpu.early_stop.no_progress_loss` to the serving side:
+the same ``new_loss < best - |best| * pct/100`` improvement test, but
+edge-triggered per episode instead of stopping the loop.  Emissions:
+
+* ``improvement`` / ``stagnation`` events on the study's audit timeline
+  and (via the scheduler's sink-less tracer) the flight ring;
+* ``quality.*`` gauges per ``(algo, space-signature)`` cohort key,
+  refreshed pull-style at scrape/snapshot time (zero threads);
+* a stagnant-fraction objective riding the :mod:`~hyperopt_tpu.obs.slo`
+  burn-rate plane (``slo.stagnation.*`` gauges) when the server has one.
+
+Armed telemetry NEVER changes proposals: observation reads the settled
+loss and the study's bookkeeping only — never the RNG stream, never the
+trial docs (the PR 2/11 pattern, pinned bit-identical by
+``tests/test_quality.py`` including over HTTP).  Disarmed
+(``HYPEROPT_TPU_QUALITY=off``) means ``scheduler.quality is None``: no
+tracker objects, zero threads, zero per-tell allocations beyond one
+``is None`` check (the bench ``quality_overhead`` stage gates the armed
+delta at ≤5% absolute).
+
+**The standing per-algo quality table**: :func:`summarize_run` and
+:func:`quality_record` define the ``kind="quality"`` JSONL record shape
+shared by ``bench.py``'s ``search_quality`` stage (tpe / rand / anneal /
+mix / atpe over ``zoo.make_study_mix``) and
+``scripts/compare_atpe.py``.  The bench stage's per-algo scalars
+(``trials_to_target_<algo>``, ``final_regret_<algo>``,
+``solved_frac_<algo>``) land in ``.obs/trajectory.jsonl`` with
+directions registered in :data:`~hyperopt_tpu.obs.trajectory
+.KEY_DIRECTIONS` — the quality bars ``scripts/bench_gate.py`` holds the
+megakernel PRs to.  ``trajectory.load`` filters ``kind == "bench"``, so
+``kind="quality"`` rows share the store without perturbing the gate's
+windowed medians.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+__all__ = [
+    "DEFAULT_PLATEAU_WINDOW",
+    "DEFAULT_PLATEAU_PCT",
+    "DEFAULT_EWMA_ALPHA",
+    "QUALITY_ALGOS",
+    "StudyQuality",
+    "QualityPlane",
+    "merge_status",
+    "summarize_run",
+    "quality_record",
+]
+
+#: tells without an improvement before the plateau detector fires —
+#: mirrors ``early_stop.no_progress_loss``'s ``iteration_stop_count``
+DEFAULT_PLATEAU_WINDOW = 20
+
+#: required relative improvement in percent (``no_progress_loss``'s
+#: ``percent_increase``): 0.0 = any strictly-better loss resets the clock
+DEFAULT_PLATEAU_PCT = 0.0
+
+#: improvement-rate EWMA weight: ~the last dozen tells dominate
+DEFAULT_EWMA_ALPHA = 0.3
+
+#: cap on the stored best-so-far change-point curve per study (the curve
+#: only grows on improvements, so this bounds pathological streams only)
+_CURVE_CAP = 128
+
+#: the algorithms the standing quality table covers (bench.py
+#: ``search_quality`` stage; one ``trials_to_target_<algo>`` /
+#: ``final_regret_<algo>`` / ``solved_frac_<algo>`` triple each)
+QUALITY_ALGOS = ("tpe", "rand", "anneal", "mix", "atpe")
+
+
+def _sanitize(label):
+    """Metric-name-safe cohort label (the gauges surface as
+    ``hyperopt_tpu_quality_*`` families and must pass exposition lint)."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in str(label))
+
+
+class StudyQuality:
+    """One study's incremental convergence state, folded at tell time.
+
+    ``observe`` is the only mutator: O(1), no I/O, no RNG.  The
+    improvement test is exactly ``no_progress_loss``'s —
+    ``loss < best - |best| * (pct / 100)`` — and the stagnation flag is
+    its streaming, edge-triggered form: it fires ONCE when
+    ``trials_since_improvement`` crosses ``window`` and clears on the
+    next improvement, so a long plateau is one timeline event, not one
+    per tell."""
+
+    __slots__ = ("study_id", "cohort", "optimum", "loss_target", "window",
+                 "pct", "alpha", "best", "n_told", "since_improvement",
+                 "stagnant", "improvements", "stagnations", "ewma",
+                 "trials_to_target", "solved", "curve")
+
+    def __init__(self, study_id, cohort, optimum=None, loss_target=None,
+                 window=DEFAULT_PLATEAU_WINDOW, pct=DEFAULT_PLATEAU_PCT,
+                 alpha=DEFAULT_EWMA_ALPHA):
+        self.study_id = study_id
+        self.cohort = cohort
+        self.optimum = None if optimum is None else float(optimum)
+        self.loss_target = (None if loss_target is None
+                            else float(loss_target))
+        self.window = int(window)
+        self.pct = float(pct)
+        self.alpha = float(alpha)
+        self.best = None
+        self.n_told = 0
+        self.since_improvement = 0
+        self.stagnant = False
+        self.improvements = 0
+        self.stagnations = 0
+        self.ewma = None  # improvement-rate EWMA (loss units per tell)
+        self.trials_to_target = None
+        self.solved = False
+        self.curve = []  # best-so-far change points: (n_told, best)
+
+    def observe(self, loss):
+        """Fold one told result (``loss`` is the ok loss, None for a
+        failed trial).  Returns ``"improvement"``, ``"stagnation"`` or
+        None — the edge events worth a timeline entry."""
+        self.n_told += 1
+        prev = self.best
+        if loss is not None:
+            loss = float(loss)
+            if prev is None or loss < prev:
+                self.best = loss
+        improved = loss is not None and (
+            prev is None or loss < prev - abs(prev) * (self.pct / 100.0))
+        if improved:
+            delta = 0.0 if prev is None else max(prev - loss, 0.0)
+            self.ewma = (delta if self.ewma is None
+                         else self.alpha * delta
+                         + (1.0 - self.alpha) * self.ewma)
+            self.since_improvement = 0
+            self.stagnant = False
+            self.improvements += 1
+            if len(self.curve) < _CURVE_CAP:
+                self.curve.append((self.n_told, self.best))
+            if (not self.solved and self.loss_target is not None
+                    and self.best <= self.loss_target):
+                self.solved = True
+                self.trials_to_target = self.n_told
+            return "improvement"
+        if self.ewma is not None:
+            # a non-improving tell decays the rate toward zero — the
+            # EWMA answers "is this study still moving", not "how big
+            # was the last win"
+            self.ewma *= (1.0 - self.alpha)
+        self.since_improvement += 1
+        if not self.stagnant and self.since_improvement >= self.window:
+            self.stagnant = True
+            self.stagnations += 1
+            return "stagnation"
+        return None
+
+    @property
+    def regret(self):
+        """Simple regret vs the known optimum, or None when either side
+        is unknown.  Clamped at 0 — a surrogate domain whose sampled
+        best beats the recorded optimum is a zoo calibration artifact,
+        not negative regret."""
+        if self.best is None or self.optimum is None:
+            return None
+        return max(self.best - self.optimum, 0.0)
+
+    def status_dict(self):
+        """The per-study quality section (``GET /studies``)."""
+        out = {
+            "cohort": self.cohort,
+            "n_told": self.n_told,
+            "best_loss": self.best,
+            "stagnant": self.stagnant,
+            "trials_since_improvement": self.since_improvement,
+            "improvement_ewma": self.ewma,
+        }
+        if self.optimum is not None:
+            out["regret"] = self.regret
+        if self.loss_target is not None:
+            out["solved"] = self.solved
+            out["trials_to_target"] = self.trials_to_target
+        return out
+
+
+class QualityPlane:
+    """Per-study convergence telemetry for a scheduler (zero threads).
+
+    ``metrics`` is the service registry the ``quality.*`` gauges publish
+    into (pull-based: :meth:`publish` refreshes at scrape/snapshot
+    time); ``tracer`` feeds improvement/stagnation events to the flight
+    ring (and any armed sink); ``slo`` is an
+    :class:`~hyperopt_tpu.obs.slo.SLOPlane` carrying a ``stagnation``
+    objective (installed by the server via
+    ``parse_quality_slo``), fed one good/bad observation per live tell.
+    Lock discipline: every mutation arrives under the scheduler's
+    RLock (live tell and replay both), so the per-tell path is
+    lock-free; the plane's own lock guards only tracker admission.
+    Scrape-side reads are deliberately unlocked — a scrape racing a
+    tell sees the study one tell early or late, both true snapshots."""
+
+    def __init__(self, metrics=None, tracer=None, slo=None,
+                 window=DEFAULT_PLATEAU_WINDOW, pct=DEFAULT_PLATEAU_PCT,
+                 alpha=DEFAULT_EWMA_ALPHA):
+        self.metrics = metrics
+        self.tracer = tracer
+        self.slo = slo
+        self.window = int(window)
+        self.pct = float(pct)
+        self.alpha = float(alpha)
+        self._studies = {}
+        self._lock = threading.Lock()
+
+    # -- study registry ----------------------------------------------------
+
+    def _admit(self, st):
+        """Build the tracker for one study: the cohort key is
+        ``(serving algo, space signature)`` — the zoo name when the
+        study came over the wire with a ``{"zoo": ...}`` spec (which
+        also supplies the optimum/target for regret), a short signature
+        hash otherwise."""
+        optimum = target = None
+        label = None
+        spec = getattr(st, "space_spec", None)
+        if isinstance(spec, dict) and "zoo" in spec:
+            from ..zoo import ZOO
+
+            zrec = ZOO.get(str(spec["zoo"]))
+            if zrec is not None:
+                label = zrec.name
+                optimum = zrec.optimum
+                target = zrec.loss_target
+        if label is None:
+            try:
+                sig = repr(st.domain.cs.signature())
+            except Exception:  # noqa: BLE001 - cohort label is best-effort
+                sig = repr(getattr(st, "study_id", "?"))
+            label = "sig_" + hashlib.sha1(
+                sig.encode()).hexdigest()[:10]
+        # service-side studies are TPE-served (rand only at the startup/
+        # degrade/warming floors) — the cohort's algo axis is "tpe"
+        cohort = _sanitize(f"tpe.{label}")
+        q = StudyQuality(st.study_id, cohort, optimum=optimum,
+                         loss_target=target, window=self.window,
+                         pct=self.pct, alpha=self.alpha)
+        self._studies[st.study_id] = q
+        return q
+
+    def forget(self, study_id):
+        with self._lock:
+            self._studies.pop(study_id, None)
+
+    def study_status(self, study_id):
+        """Quality section for one study, or None if never told.
+        Lock-free read: a scrape racing a tell sees the study one tell
+        early or late — both are true snapshots."""
+        q = self._studies.get(study_id)
+        return None if q is None else q.status_dict()
+
+    # -- the per-tell hook -------------------------------------------------
+
+    def observe_tell(self, st, loss, replay=False):
+        """Fold one settled tell (``loss`` = the ok loss, None for a
+        failed trial).  Called by the scheduler's ``_apply_tell`` (live
+        AND replay) and the store-ahead replay branch — observation
+        happens exactly once per told trial either way.  Emits the edge
+        events; never touches proposals.
+
+        Lock-free on the hot path: callers already hold the scheduler
+        RLock (live tell and replay both), so per-study mutation is
+        serialized upstream — only tracker admission (the registry
+        insert) takes the plane lock, and that happens once per study."""
+        q = self._studies.get(st.study_id)
+        if q is None:
+            with self._lock:
+                q = self._studies.get(st.study_id)
+                if q is None:
+                    q = self._admit(st)
+        event = q.observe(loss)
+        if event is not None:
+            st.note(event, best=q.best, regret=q.regret,
+                    n_told=q.n_told,
+                    since=(q.since_improvement
+                           if event == "stagnation" else None),
+                    replay=True if replay else None)
+            if self.metrics is not None:
+                self.metrics.counter(f"quality.{event}s").inc()
+            if self.tracer is not None:
+                self.tracer.event(
+                    f"quality.{event}", study=st.study_id,
+                    cohort=q.cohort, best=q.best, regret=q.regret,
+                    n_told=q.n_told)
+        if self.slo is not None and not replay:
+            # replayed history must not re-burn the live error budget
+            try:
+                self.slo.record_quality(q.stagnant)
+            except Exception:  # noqa: BLE001 - observability never fails a tell
+                pass
+        return event
+
+    # -- pull-based publication --------------------------------------------
+
+    def status(self):
+        """The quality roll-up (``/snapshot`` section): global counts
+        plus the per-cohort table.  Lock-free snapshot of the registry
+        (see :meth:`study_status`)."""
+        qs = list(self._studies.values())
+        cohorts = {}
+        for q in qs:
+            c = cohorts.setdefault(q.cohort, {
+                "studies": 0, "stagnant": 0, "solved": 0,
+                "best_loss": None, "best_regret": None})
+            c["studies"] += 1
+            c["stagnant"] += 1 if q.stagnant else 0
+            c["solved"] += 1 if q.solved else 0
+            if q.best is not None and (c["best_loss"] is None
+                                       or q.best < c["best_loss"]):
+                c["best_loss"] = q.best
+            r = q.regret
+            if r is not None and (c["best_regret"] is None
+                                  or r < c["best_regret"]):
+                c["best_regret"] = r
+        n = len(qs)
+        stagnant = sum(1 for q in qs if q.stagnant)
+        return {
+            "studies": n,
+            "stagnant": stagnant,
+            "stagnant_frac": (stagnant / n) if n else 0.0,
+            "solved": sum(1 for q in qs if q.solved),
+            "improvements": sum(q.improvements for q in qs),
+            "stagnations": sum(q.stagnations for q in qs),
+            "cohorts": cohorts,
+        }
+
+    def publish(self):
+        """Refresh the ``quality.*`` gauges and return :meth:`status`
+        (the scrape/snapshot hook — the compile/store gauge pattern)."""
+        st = self.status()
+        if self.metrics is not None:
+            g = self.metrics.gauge
+            g("quality.studies").set(st["studies"])
+            g("quality.stagnant").set(st["stagnant"])
+            g("quality.stagnant_frac").set(st["stagnant_frac"])
+            g("quality.solved").set(st["solved"])
+            for key, c in st["cohorts"].items():
+                base = f"quality.cohort.{key}"
+                g(f"{base}.studies").set(c["studies"])
+                g(f"{base}.stagnant").set(c["stagnant"])
+                g(f"{base}.solved").set(c["solved"])
+                if c["best_regret"] is not None:
+                    g(f"{base}.best_regret").set(c["best_regret"])
+        return st
+
+
+def merge_status(statuses):
+    """Merge per-scheduler :meth:`QualityPlane.status` dicts (the fleet
+    server's ``/snapshot`` runs one plane per adopted shard)."""
+    statuses = [s for s in statuses if s]
+    if not statuses:
+        return None
+    if len(statuses) == 1:
+        return statuses[0]
+    out = {"studies": 0, "stagnant": 0, "solved": 0,
+           "improvements": 0, "stagnations": 0, "cohorts": {}}
+    for s in statuses:
+        for k in ("studies", "stagnant", "solved", "improvements",
+                  "stagnations"):
+            out[k] += int(s.get(k) or 0)
+        for key, c in (s.get("cohorts") or {}).items():
+            m = out["cohorts"].setdefault(key, {
+                "studies": 0, "stagnant": 0, "solved": 0,
+                "best_loss": None, "best_regret": None})
+            m["studies"] += c.get("studies", 0)
+            m["stagnant"] += c.get("stagnant", 0)
+            m["solved"] += c.get("solved", 0)
+            for fld in ("best_loss", "best_regret"):
+                v = c.get(fld)
+                if v is not None and (m[fld] is None or v < m[fld]):
+                    m[fld] = v
+    out["stagnant_frac"] = (out["stagnant"] / out["studies"]
+                            if out["studies"] else 0.0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the standing quality table: offline run summaries + the JSONL record
+# ---------------------------------------------------------------------------
+
+
+def summarize_run(losses, budget, loss_target=None, optimum=None):
+    """Summarize one finished optimization run for the quality table.
+
+    ``losses`` is the per-trial loss sequence in tell order (None for
+    failed trials).  Returns ``best``, ``solved`` (best ≤ target),
+    ``trials_to_target`` (1-based trial index of the first
+    target-clearing loss; ``budget`` when unsolved, so aggregation
+    penalizes failure instead of dropping it) and ``final_regret``
+    (vs the known optimum; None when the optimum is unknown)."""
+    best = None
+    t2t = None
+    for i, loss in enumerate(losses):
+        if loss is None:
+            continue
+        loss = float(loss)
+        if best is None or loss < best:
+            best = loss
+            if (t2t is None and loss_target is not None
+                    and best <= float(loss_target)):
+                t2t = i + 1
+    solved = t2t is not None
+    return {
+        "best": best,
+        "solved": solved,
+        "trials_to_target": t2t if solved else int(budget),
+        "final_regret": (max(best - float(optimum), 0.0)
+                         if best is not None and optimum is not None
+                         else None),
+        "budget": int(budget),
+    }
+
+
+def quality_record(source, algos, config=None, root=None):
+    """One ``kind="quality"`` trajectory-store record: the search-quality
+    sibling of the ``kind="bench"`` rows (``trajectory.load`` filters by
+    kind, so both share ``.obs/trajectory.jsonl`` without perturbing the
+    perf gate).  ``algos`` maps algo name → summary dict — at minimum
+    the three table scalars (``trials_to_target``, ``final_regret``,
+    ``solved_frac``), plus whatever per-domain detail the producer has
+    (``scripts/compare_atpe.py`` stores its full row table)."""
+    from . import trajectory
+
+    return {
+        "kind": "quality",
+        "ts": time.time(),
+        "source": str(source),
+        "git_rev": trajectory.git_rev(root),
+        "config": dict(config or {}),
+        "algos": {str(k): dict(v) for k, v in (algos or {}).items()},
+    }

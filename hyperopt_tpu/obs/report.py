@@ -460,6 +460,68 @@ def _service_section(metrics, out):
     _slo_lines(metrics, out)
 
 
+def _quality_section(metrics, events, out):
+    """Search-quality roll-up (ISSUE 16): the ``quality.*`` gauges per
+    (algo, space-signature) cohort — studies/stagnant/solved counts and
+    best regret — plus a best-so-far sparkline per cohort mined from the
+    streamed ``quality.improvement`` events.  Rendered only when the
+    stream recorded the quality plane (a disarmed run keeps its report
+    unchanged)."""
+    qual = {k: v for k, v in metrics.items() if k.startswith("quality.")}
+    imps = [e for e in events
+            if e.get("name") == "quality.improvement"
+            and (e.get("attrs") or {}).get("best") is not None]
+    if not qual and not imps:
+        return
+    out.append("")
+    out.append("== search quality " + "=" * 46)
+    n = int(qual.get("quality.studies", 0))
+    if n or qual:
+        line = (f"  studies  {n}"
+                f"  stagnant {int(qual.get('quality.stagnant', 0))}"
+                f" ({float(qual.get('quality.stagnant_frac', 0.0)):.0%})"
+                f"  solved {int(qual.get('quality.solved', 0))}")
+        imp_n = qual.get("quality.improvements")
+        stag_n = qual.get("quality.stagnations")
+        if imp_n is not None or stag_n is not None:
+            line += (f"  improvements {int(imp_n or 0)}"
+                     f"  stagnations {int(stag_n or 0)}")
+        out.append(line)
+    # per-cohort table from the quality.cohort.<key>.* gauges
+    cohorts = sorted({k.split(".")[2] for k in qual
+                      if k.startswith("quality.cohort.")
+                      and k.count(".") >= 3})
+    # best-so-far trajectory per cohort: each improvement event carries
+    # the new best — in stream order that IS the convergence curve
+    curves = {}
+    for e in imps:
+        a = e.get("attrs") or {}
+        curves.setdefault(a.get("cohort") or "?", []).append(
+            float(a["best"]))
+    for c in cohorts:
+        base = f"quality.cohort.{c}"
+        line = (f"  cohort   {c:<28}"
+                f" studies {int(qual.get(f'{base}.studies', 0))}"
+                f"  stagnant {int(qual.get(f'{base}.stagnant', 0))}"
+                f"  solved {int(qual.get(f'{base}.solved', 0))}")
+        regret = qual.get(f"{base}.best_regret")
+        if regret is not None:
+            line += f"  regret {float(regret):.4g}"
+        spark = _spark(curves.get(c, []))
+        if spark:
+            line += f"  best {spark}"
+        out.append(line)
+    # cohorts seen only in the event stream (gauges not snapshotted)
+    for c in sorted(set(curves) - set(cohorts)):
+        out.append(f"  cohort   {c:<28} best {_spark(curves[c])}"
+                   f" -> {min(curves[c]):.4g}")
+    if qual.get("quality.stagnant_frac", 0.0) and n and (
+            float(qual.get("quality.stagnant_frac", 0.0)) >= 0.5):
+        out.append("  STAGNATION: over half the live studies have "
+                   "plateaued — check budgets/targets (quality.* gauges, "
+                   "per-study timelines)")
+
+
 def _storage_section(metrics, out):
     """Storage integrity (ISSUE 15): checksum verification traffic,
     quarantines with reasons, disk watermarks, GC reclaim and the
@@ -877,6 +939,7 @@ def render(records, top=5):
     _pipeline_section(spans, _last_snapshot_metrics(records), out)
     _resilience_section(_last_snapshot_metrics(records), out)
     _service_section(_last_snapshot_metrics(records), out)
+    _quality_section(_last_snapshot_metrics(records), events, out)
     _storage_section(_last_snapshot_metrics(records), out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)

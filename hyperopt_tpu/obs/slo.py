@@ -62,8 +62,8 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["SLOPlane", "Objective", "DEFAULT_TARGETS", "WINDOWS",
-           "FAST_BURN", "SLOW_BURN"]
+__all__ = ["SLOPlane", "Objective", "DEFAULT_TARGETS", "QUALITY_TARGETS",
+           "WINDOWS", "FAST_BURN", "SLOW_BURN"]
 
 logger = logging.getLogger(__name__)
 
@@ -94,6 +94,16 @@ DEFAULT_TARGETS = {
     "availability": {"target": 0.999},
     "ask_latency": {"target": 0.99, "threshold_ms": 500.0},
     "shed_rate": {"target": 0.95},
+}
+
+#: the search-quality objective (ISSUE 16, ``HYPEROPT_TPU_QUALITY_SLO``):
+#: one event per LIVE tell, good = the told study is not stagnant after
+#: folding the result.  Target 90% — a fleet where >10% of recent tells
+#: land on plateaued studies is burning trial budget, not optimizing.
+#: Kept out of DEFAULT_TARGETS: it only makes sense when the quality
+#: plane is armed, so the server installs it separately.
+QUALITY_TARGETS = {
+    "stagnation": {"target": 0.90},
 }
 
 
@@ -239,6 +249,29 @@ class SLOPlane:
                 sr = self.objectives.get("shed_rate")
                 if sr is not None:
                     sr.record(not shed, now)
+        self._maybe_evaluate(now)
+
+    def add_objective(self, name, spec):
+        """Install one more objective after construction (the server
+        adds the quality plane's ``stagnation`` objective this way when
+        both planes are armed).  Idempotent: an existing objective keeps
+        its ring."""
+        with self._lock:
+            if name not in self.objectives:
+                self.objectives[name] = Objective(
+                    name, spec["target"],
+                    threshold_ms=spec.get("threshold_ms"))
+
+    def record_quality(self, stagnant, now=None):
+        """Feed one live tell into the ``stagnation`` objective: good =
+        the study is NOT stagnant after folding the result.  No-op when
+        the objective was never installed (quality SLO disarmed)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            obj = self.objectives.get("stagnation")
+            if obj is None:
+                return
+            obj.record(not stagnant, now)
         self._maybe_evaluate(now)
 
     # -- evaluation --------------------------------------------------------

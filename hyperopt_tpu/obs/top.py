@@ -287,6 +287,27 @@ def _render_service_source(name, snap, out, w):
         if gc.get("reclaimed_bytes"):
             sline += f"  gc {gc['reclaimed_bytes'] / 1e6:.1f}M"
         out.append(sline)
+    # the QUALITY row (ISSUE 16): is the fleet actually optimizing —
+    # stagnant/solved study counts and the worst-off cohort, from
+    # /snapshot's quality section
+    qual = snap.get("quality")
+    if qual and qual.get("studies"):
+        qline = (f"  {'':<{w}}  QUALITY  studies {qual.get('studies', 0)}"
+                 f"  stagnant {qual.get('stagnant', 0)}"
+                 f" ({float(qual.get('stagnant_frac', 0.0)):.0%})"
+                 f"  solved {qual.get('solved', 0)}")
+        cohorts = qual.get("cohorts") or {}
+        worst = max(
+            ((c, v) for c, v in cohorts.items()
+             if v.get("best_regret") is not None),
+            key=lambda kv: kv[1]["best_regret"], default=None)
+        if worst is not None:
+            qline += (f"  worst {worst[0][:24]}"
+                      f" regret {float(worst[1]['best_regret']):.4g}")
+        if (float(qual.get("stagnant_frac", 0.0)) >= 0.5
+                and qual.get("studies", 0) > 1):
+            qline += "  STAGNANT"
+        out.append(qline)
     degrade = snap.get("degrade")
     if degrade and (degrade.get("level") or degrade.get("faults")):
         out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"
@@ -313,13 +334,19 @@ def _render_service_source(name, snap, out, w):
     top = sorted(studies, key=lambda s: -(s.get("last_active") or 0))[:6]
     for s in top:
         best = s.get("best_loss")
-        out.append(
+        line = (
             f"  {'':<{w}}    {str(s.get('study_id', '?'))[:24]:<24}"
             f"  {s.get('state', '?'):<7}"
             f"  trials {s.get('n_trials', 0):>4}"
             f"  pending {s.get('n_pending', 0):>3}"
             + (f"  best {best:.6g}" if isinstance(best, (int, float))
                else "  best -"))
+        sq = s.get("quality") or {}
+        if sq.get("regret") is not None:
+            line += f"  regret {float(sq['regret']):.4g}"
+        if sq.get("stagnant"):
+            line += "  STAGNANT"
+        out.append(line)
 
 
 def render_frame(sources, histories, now=None):

@@ -186,6 +186,45 @@ KEY_DIRECTIONS = {
     # Loose bar: the scan is pure-Python CRC; a collapse means the
     # verifier went accidentally quadratic.
     "scrub_records_per_sec": {"direction": "higher", "threshold": 0.50},
+    # -- the standing per-algo search-QUALITY table (bench.py
+    # search_quality stage, ISSUE 16): the zoo mix run to budget under
+    # each algorithm.  These are the megakernel's quality bars — the
+    # non-bit-exact scoring-loop rewrites (int8/fp8 history, fused
+    # Pallas EI) land against THESE instead of impossible bitwise pins.
+    # trials_to_target_*: mean 1-based trial index of the first
+    # target-clearing loss (budget when unsolved — failure is penalized,
+    # not dropped).  Stochastic across the fixed seed set, so the bars
+    # are loose; a real regression (a broken posterior, a mis-weighted
+    # EI) moves tpe toward rand's level, far past them.
+    "trials_to_target_tpe": {"direction": "lower", "threshold": 0.30},
+    "trials_to_target_rand": {"direction": "lower", "threshold": 0.30},
+    "trials_to_target_anneal": {"direction": "lower", "threshold": 0.30},
+    "trials_to_target_mix": {"direction": "lower", "threshold": 0.30},
+    "trials_to_target_atpe": {"direction": "lower", "threshold": 0.30},
+    # final_regret_*: mean simple regret vs the zoo optimum at budget
+    # exhaustion (optimum-known domains only).  Heavier-tailed than
+    # trials-to-target — one unlucky hartmann6 run dominates the mean —
+    # hence the looser bar.
+    "final_regret_tpe": {"direction": "lower", "threshold": 0.75},
+    "final_regret_rand": {"direction": "lower", "threshold": 0.75},
+    "final_regret_anneal": {"direction": "lower", "threshold": 0.75},
+    "final_regret_mix": {"direction": "lower", "threshold": 0.75},
+    "final_regret_atpe": {"direction": "lower", "threshold": 0.75},
+    # solved_frac_*: fraction of mix studies whose best cleared the zoo
+    # loss_target within budget.  Small denominator (the mix size), so
+    # one flipped study moves it by 1/n — the bar allows that, a
+    # posterior-breaking change zeroes it.
+    "solved_frac_tpe": {"direction": "higher", "threshold": 0.30},
+    "solved_frac_rand": {"direction": "higher", "threshold": 0.30},
+    "solved_frac_anneal": {"direction": "higher", "threshold": 0.30},
+    "solved_frac_mix": {"direction": "higher", "threshold": 0.30},
+    "solved_frac_atpe": {"direction": "higher", "threshold": 0.30},
+    # armed-vs-disarmed quality-plane per-tell delta through the real
+    # handle() path (bench.py quality_overhead stage).  Absolute fixed
+    # bar at the acceptance criterion, the checksum_overhead_frac
+    # pattern: within 5% or the tracker is too hot for the tell path.
+    "quality_overhead_frac": {"direction": "lower", "threshold": 0.05,
+                              "absolute": True},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -204,7 +243,17 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "cold_study_ask_p99_ms", "compile_queue_depth_max",
                 "bank_hit_frac",
                 "checksum_overhead_frac", "gc_reclaimed_bytes",
-                "scrub_records_per_sec")
+                "scrub_records_per_sec",
+                "trials_to_target_tpe", "trials_to_target_rand",
+                "trials_to_target_anneal", "trials_to_target_mix",
+                "trials_to_target_atpe",
+                "final_regret_tpe", "final_regret_rand",
+                "final_regret_anneal", "final_regret_mix",
+                "final_regret_atpe",
+                "solved_frac_tpe", "solved_frac_rand",
+                "solved_frac_anneal", "solved_frac_mix",
+                "solved_frac_atpe",
+                "quality_overhead_frac")
 
 
 def trajectory_path(root=None):

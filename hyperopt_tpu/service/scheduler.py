@@ -216,6 +216,14 @@ class Study:
         # rebuilt by WAL replay so the dedupe survives crashes AND
         # shard migrations.
         self.served_reqs = {}
+        # incremental best-loss (ISSUE 16): maintained at tell/replay
+        # time so /studies scrapes and the quality plane read O(1)
+        # instead of rescanning every result doc.  Dirty at construction
+        # — a FileTrials handed in here may already hold DONE docs
+        # (re-admission, store-ahead reconciliation), so the first read
+        # scans once and every tell after that is a min-update.
+        self._best = None
+        self._best_dirty = True
 
     def remember_req(self, req_id, tids):
         if not req_id:
@@ -266,13 +274,36 @@ class Study:
         return self.n_asked - self.n_told
 
     def best_loss(self):
-        best = None
-        for r in self.trials.results:
-            loss = r.get("loss")
-            if (r.get("status") == STATUS_OK and loss is not None
-                    and (best is None or loss < best)):
-                best = loss
-        return best
+        """Best ok loss so far — O(1) after the first read.  The full
+        rescan runs only while dirty (construction, or an external
+        refresh of the backing trials); every settled tell keeps the
+        cache current via :meth:`record_result`."""
+        if self._best_dirty:
+            best = None
+            for r in self.trials.results:
+                loss = r.get("loss")
+                if (r.get("status") == STATUS_OK and loss is not None
+                        and (best is None or loss < best)):
+                    best = loss
+            self._best = best
+            self._best_dirty = False
+        return self._best
+
+    def record_result(self, loss):
+        """Fold one settled ok loss (None = failed trial) into the
+        incremental best.  While dirty the next :meth:`best_loss` scan
+        will pick the doc up anyway, so this stays a pure min-update."""
+        if loss is None or self._best_dirty:
+            return
+        loss = float(loss)
+        if self._best is None or loss < self._best:
+            self._best = loss
+
+    def mark_best_dirty(self):
+        """Invalidate the cached best — required after any path that
+        mutates the backing trials without going through
+        ``_apply_tell`` (fleet-mode cross-shard refresh)."""
+        self._best_dirty = True
 
     def status_dict(self):
         return {
@@ -693,9 +724,9 @@ class StudyScheduler:
     def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
                  store_root=None, wave_window=0.0, wal=None, degrade=None,
                  overload=None, auto_resume=True, compile_plane=None,
-                 widen=None):
+                 widen=None, quality=None):
         from .._env import (parse_compile_plane, parse_compile_widen,
-                            parse_service_degrade,
+                            parse_quality, parse_service_degrade,
                             parse_service_idle_sec,
                             parse_service_max_pending,
                             parse_service_max_studies,
@@ -798,6 +829,24 @@ class StudyScheduler:
             self.watermark = integrity.DiskWatermark(
                 wm_root, threshold=parse_store_watermark(),
                 metrics=self.metrics)
+
+        # search-quality telemetry plane (ISSUE 16): None resolves
+        # HYPEROPT_TPU_QUALITY (default ON — pure tell-time metadata,
+        # zero threads, never feeds proposals), False disarms (the tell
+        # path pays one `is None` check and nothing else), an instance
+        # arms explicitly (tests inject fakes; the server wires the SLO
+        # hook in after construction).  Built BEFORE auto_resume so
+        # replayed tells rebuild the convergence state too.
+        if quality is None:
+            from ..obs.quality import QualityPlane
+
+            self.quality = (QualityPlane(metrics=self.metrics,
+                                         tracer=_tracer)
+                            if parse_quality() else None)
+        elif quality is False:
+            self.quality = None
+        else:
+            self.quality = quality
 
         self.last_resume = None  # stats dict of the latest WAL replay
         if auto_resume and self.journal is not None:
@@ -1775,6 +1824,8 @@ class StudyScheduler:
                 # hostile unknown-tid tell must not buy an O(files)
                 # unpickling rescan under the scheduler lock).
                 st.trials.refresh()
+                st.mark_best_dirty()  # the rescan may have pulled in
+                # docs settled by another shard owner
                 doc = next((d for d in st.trials._dynamic_trials
                             if d["tid"] == tid), None)
             if doc is None:
@@ -1808,7 +1859,7 @@ class StudyScheduler:
             if st.state == "done":
                 self._maybe_compact()
 
-    def _apply_tell(self, st, doc, loss, status):
+    def _apply_tell(self, st, doc, loss, status, replay=False):
         """Settle one told doc into the study (shared by the live path
         and WAL replay — replay must fold results identically)."""
         # a finite loss is REQUIRED for an ok record even when the
@@ -1830,7 +1881,15 @@ class StudyScheduler:
         Trials.refresh(st.trials)
         st.n_told += 1
         st.touch()
+        ok_loss = float(loss) if ok else None
+        st.record_result(ok_loss)
         self.metrics.counter("service.tells").inc()
+        if self.quality is not None:
+            try:
+                self.quality.observe_tell(st, ok_loss, replay=replay)
+            except Exception as e:  # noqa: BLE001 - never fail a tell
+                logging.getLogger(__name__).warning(
+                    "quality observe_tell failed: %s", e)
         if (st.max_trials is not None
                 and st.n_trials >= st.max_trials and st.n_pending == 0):
             st.state = "done"
@@ -2098,6 +2157,31 @@ class StudyScheduler:
                 st.state = rec.get("state", "active")
                 for rid, tids in (rec.get("served") or {}).items():
                     st.remember_req(rid, tids)
+                if self.quality is not None and st.n_told:
+                    # a compacted WAL carries no tell records for the
+                    # settled history, so the tracker state (best-so-far,
+                    # plateau clock, timeline events) is rebuilt from the
+                    # store-settled docs in tid order — deterministic, so
+                    # every further resume regenerates identical events.
+                    # Docs beyond the snapshot's n_told belong to later
+                    # tell records, which fold their own.
+                    try:
+                        folded = 0
+                        for d in st.trials._dynamic_trials:
+                            if folded >= st.n_told:
+                                break
+                            if d["state"] != JOB_STATE_DONE:
+                                continue
+                            res = d.get("result") or {}
+                            ok_loss = (res.get("loss")
+                                       if res.get("status") == STATUS_OK
+                                       else None)
+                            self.quality.observe_tell(st, ok_loss,
+                                                      replay=True)
+                            folded += 1
+                    except Exception as e:  # noqa: BLE001
+                        logging.getLogger(__name__).warning(
+                            "quality snapshot fold failed: %s", e)
             stats["studies"] += 1
             return
         st = self._studies.get(sid)
@@ -2170,16 +2254,33 @@ class StudyScheduler:
                 st.note("tell", tid=tid, replay=True,
                         trace=rec.get("trace"))
                 stats["tells"] += 1
+                # the result is already in the store, but the tell-time
+                # bookkeeping (incremental best, quality plane) must
+                # still fold it — observation is once per told trial on
+                # BOTH replay branches
+                res = doc.get("result") or {}
+                ok_loss = (res.get("loss")
+                           if res.get("status") == STATUS_OK else None)
+                st.record_result(ok_loss)
+                if self.quality is not None:
+                    try:
+                        self.quality.observe_tell(st, ok_loss,
+                                                  replay=True)
+                    except Exception as e:  # noqa: BLE001
+                        logging.getLogger(__name__).warning(
+                            "quality observe_tell failed: %s", e)
                 if (st.max_trials is not None
                         and st.n_trials >= st.max_trials
                         and st.n_pending == 0):
                     st.state = "done"
             else:
                 self._replay_ctx["told"].add(key)
-                self._apply_tell(st, doc, rec.get("loss"),
-                                 rec.get("status"))
+                # note BEFORE _apply_tell, matching the live tell path,
+                # so quality events interleave identically on replay
                 st.note("tell", tid=tid, replay=True,
                         trace=rec.get("trace"))
+                self._apply_tell(st, doc, rec.get("loss"),
+                                 rec.get("status"), replay=True)
                 stats["tells"] += 1
         elif kind == "close":
             st.state = "closed"
@@ -2279,6 +2380,11 @@ class StudyScheduler:
                 "ticks": c.ticks,
             } for key, c in self._cohorts.items()]
             studies = [s.status_dict() for s in self._studies.values()]
+            if self.quality is not None:
+                for s in studies:
+                    q = self.quality.study_status(s.get("study_id"))
+                    if q is not None:
+                        s["quality"] = q
             for sid, info in sorted(self._quarantined.items()):
                 if sid not in self._studies:
                     # quarantined before its admit record could replay:

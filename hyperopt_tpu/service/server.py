@@ -131,7 +131,8 @@ class ServiceHTTPServer:
     def __init__(self, port, scheduler=None, host=None, store_root=None,
                  guard=None, trace=None, slo=None, access_log=None,
                  fleet=None):
-        from .._env import (parse_reqtrace, parse_service_access_log,
+        from .._env import (parse_quality_slo, parse_reqtrace,
+                            parse_service_access_log,
                             parse_service_deadline_ms, parse_service_slo)
         from ..obs.metrics import get_metrics
 
@@ -192,6 +193,17 @@ class ServiceHTTPServer:
                 self.slo = SLOPlane(targets,
                                     metrics=self.metrics,
                                     escalation=self._slo_escalation)
+        # search-quality SLO (ISSUE 16): when BOTH the burn-rate plane
+        # and a scheduler-side quality plane are armed, install the
+        # stagnant-fraction objective and point the plane(s) at it —
+        # one good/bad event per live tell, replay excluded
+        if self.slo is not None:
+            q_targets = parse_quality_slo()
+            if q_targets is not None and self._quality_planes():
+                for name, spec in q_targets.items():
+                    self.slo.add_objective(name, spec)
+                for plane in self._quality_planes():
+                    plane.slo = self.slo
         # opt-in structured access log (JSONL; one record per request)
         log_path = (parse_service_access_log() if access_log is None
                     else (access_log or None))
@@ -615,6 +627,28 @@ class ServiceHTTPServer:
             **kwargs)
         return {"ok": True, "study_id": study_id}
 
+    def _quality_planes(self):
+        """Every armed quality plane this server fronts: one per adopted
+        shard scheduler in fleet mode, the scheduler's own otherwise."""
+        if self.fleet is not None:
+            return [s.quality for s in self.fleet.schedulers.values()
+                    if s.quality is not None]
+        if self.scheduler is not None and self.scheduler.quality is not None:
+            return [self.scheduler.quality]
+        return []
+
+    def _refresh_quality_gauges(self):
+        """Scrape/snapshot-time ``quality.*`` gauge refresh (the
+        compile/store pattern): returns the merged status section for
+        ``/snapshot``, or None when disarmed."""
+        from ..obs.quality import merge_status
+
+        try:
+            return merge_status([p.publish()
+                                 for p in self._quality_planes()])
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            return None
+
     def _refresh_compile_gauges(self):
         """Publish the compile-visibility gauges (ISSUE 14 satellite):
         the cohort-program LRU and the single-study jit LRU counters as
@@ -640,6 +674,9 @@ class ServiceHTTPServer:
                "service": True}
         if self.slo is not None:
             out["slo"] = self.slo.publish()  # refresh gauges on scrape
+        qual = self._refresh_quality_gauges()
+        if qual is not None:
+            out["quality"] = qual
         self._refresh_compile_gauges()
         out["sections"] = {
             "service": self.metrics.snapshot()["metrics"]}
@@ -798,6 +835,7 @@ def _make_handler(server):
                             server.compile_plane.publish()
                     except Exception:  # noqa: BLE001 - fail-open scrape
                         pass
+                    server._refresh_quality_gauges()
                     server._refresh_store_gauges()
                     server._count_response(method, path, 200)
                     self._answer(

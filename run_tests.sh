@@ -74,6 +74,14 @@
 # response, the on-disk WAL ask record, GET /study/<id>/timeline and
 # obs.report --study, /metrics must lint with the slo_* gauge families,
 # and the server must still drain cleanly on SIGTERM.
+# Opt-in quality gate: QUALITY_GATE=1 additionally re-runs the search-
+# quality suites and then scripts/quality_smoke.py — a real subprocess
+# server with the quality plane armed (the default) runs the zoo mix
+# under tpe AND rand; tpe must beat rand on summed trials-to-target by
+# the server's own telemetry, a budget-starved study must flag stagnant
+# on /studies with a stagnation event on its timeline, and /metrics
+# must lint with the quality_* gauge families — then bench_gate
+# --explain prints the windowed per-metric verdicts.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -149,5 +157,12 @@ if [ "${SLO_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_reqtrace.py tests/test_slo.py \
         tests/test_timeline.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/slo_smoke.py || exit 1
+fi
+if [ "${QUALITY_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_quality.py tests/test_timeline.py \
+        -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/quality_smoke.py || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_gate.py --explain || exit 1
 fi
 exit 0

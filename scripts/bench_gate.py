@@ -184,14 +184,17 @@ def compare(prev, new, thresholds):
     return regressions, notes
 
 
-def windowed_compare(history, new, directions, window=5, override=None):
+def windowed_compare(history, new, directions, window=5, override=None,
+                     explain=False):
     """Newest trajectory record vs the windowed median of its history.
 
     ``history``/``new`` are obs/trajectory.py record dicts (oldest-first
     history, excluding ``new``).  ``directions`` is the
     ``KEY_DIRECTIONS`` table: ``{key: {direction, threshold[, absolute]}}``
-    — an unknown key is recorded in the notes but never gates.  Returns
-    ``(regressions, notes)``.
+    — an unknown key is recorded in the notes but never gates.
+    ``explain`` adds one note line per gated key showing exactly which
+    window values fed the median and the bound that was applied.
+    Returns ``(regressions, notes)``.
     """
     regressions, notes = [], []
     hist = history[-window:]
@@ -203,6 +206,12 @@ def windowed_compare(history, new, directions, window=5, override=None):
             return
         thr = override if override is not None else meta["threshold"]
         direction = meta.get("direction", "higher")
+        if explain:
+            win = ", ".join(f"{v:.6g}" for v in values) or "(none)"
+            notes.append(f"{label}: window[{len(values)}] = [{win}]  "
+                         f"threshold {thr:.6g} "
+                         f"({'absolute' if meta.get('absolute') else 'relative'}, "
+                         f"{direction}=better)")
         if meta.get("absolute"):
             # FIXED bar, not median-relative: an overhead fraction gated
             # vs its own history would ratchet (~thr per window shift)
@@ -287,7 +296,7 @@ def windowed_compare(history, new, directions, window=5, override=None):
     return regressions, notes
 
 
-def _windowed_main(store, window, override):
+def _windowed_main(store, window, override, explain=False):
     """Gate the store's newest record against its windowed history.
     Returns an exit code, or None to fall back to legacy mode."""
     from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS, load
@@ -306,7 +315,8 @@ def _windowed_main(store, window, override):
     history = [r for r in history if r.get("backend") == backend]
     skipped -= len(history)
     regressions, notes = windowed_compare(
-        history, new, KEY_DIRECTIONS, window=window, override=override)
+        history, new, KEY_DIRECTIONS, window=window, override=override,
+        explain=explain)
     n_win = min(window, len(history))
     print(f"bench gate (windowed): {new.get('source', '?')} "
           f"vs median of last {n_win} of {len(history)} "
@@ -347,13 +357,18 @@ def main(argv=None):
     p.add_argument("--legacy", action="store_true",
                    help="force the pairwise newest-vs-previous "
                         "BENCH_r*.json compare")
+    p.add_argument("--explain", action="store_true",
+                   help="windowed mode: print, per gated key, the exact "
+                        "window values, median and bound it compared "
+                        "against")
     args = p.parse_args(argv)
 
     if not args.legacy:
         store = args.store or os.path.join(args.dir, ".obs",
                                            "trajectory.jsonl")
         if os.path.exists(store):
-            rc = _windowed_main(store, args.window, args.threshold)
+            rc = _windowed_main(store, args.window, args.threshold,
+                                explain=args.explain)
             if rc is not None:
                 return rc
             print("bench gate: trajectory store has <2 records; falling "

@@ -6,7 +6,10 @@ Run on forced CPU:
         python scripts/compare_atpe.py [--domains d1,d2] [--seeds N] [--evals N]
 
 Prints one line per domain with mean best loss for each algo and a final
-summary JSON.
+summary JSON, and appends the full table to the trajectory store as a
+``kind="quality"`` record (ISSUE 16 — ``obs/quality.quality_record``;
+invisible to the perf gate, which filters ``kind == "bench"``).  Disable
+the append with ``--no-trajectory``.
 """
 
 import argparse
@@ -35,6 +38,9 @@ def main():
     ap.add_argument("--domains", default=",".join(DOMAINS))
     ap.add_argument("--seeds", type=int, default=5)
     ap.add_argument("--evals", type=int, default=75)
+    ap.add_argument("--no-trajectory", action="store_true",
+                    help="print only; skip the kind=\"quality\" "
+                         "trajectory-store append")
     args = ap.parse_args()
 
     rows = {}
@@ -54,6 +60,30 @@ def main():
     wins = sum(r["atpe_wins"] for r in rows.values())
     print(json.dumps({"wins": wins, "total": len(rows), "rows": rows},
                      indent=1), file=sys.stderr)
+    if not args.no_trajectory:
+        # land the table in the trajectory store (fail-open: a store
+        # problem must never fail the comparison that just ran)
+        try:
+            from hyperopt_tpu.obs import trajectory
+            from hyperopt_tpu.obs.quality import quality_record
+
+            algos = {
+                "tpe": {"mean_best_by_domain":
+                        {n: r["tpe"] for n, r in rows.items()}},
+                "atpe": {"mean_best_by_domain":
+                         {n: r["atpe"] for n, r in rows.items()},
+                         "wins": wins, "total": len(rows),
+                         "rows": rows},
+            }
+            path = trajectory.append(quality_record(
+                "scripts/compare_atpe.py", algos,
+                config={"domains": sorted(rows), "seeds": args.seeds,
+                        "evals": args.evals}))
+            print(f"compare_atpe: appended quality record to {path}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"compare_atpe: trajectory append failed (non-fatal): "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

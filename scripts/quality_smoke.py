@@ -1,0 +1,187 @@
+"""QUALITY_GATE end-to-end smoke (ISSUE 16): the search-quality
+observability plane against a REAL subprocess server.
+
+What it pins (the cross-process slice no in-process test can):
+
+* a real ``python -m hyperopt_tpu.service.server`` subprocess with WAL
+  store and the quality plane armed (the default) serves a small zoo
+  mix under BOTH algorithms the wire offers — tpe (the serving
+  default) and rand (startup floor ≥ budget) — with the objective
+  evaluated client-side from the same ``zoo.ZOO`` entry the server
+  resolved the study's target from;
+* the server's OWN telemetry ranks them: summed trials-to-target over
+  the mix (unsolved arms count the full budget), read from the quality
+  section ``GET /studies`` carries, must be no worse for tpe than for
+  rand — the smoke-scale version of the bench ``search_quality`` bars;
+* a deliberately budget-starved study (constant losses past the plateau
+  window) raises its ``stagnant`` flag on ``/studies`` AND lands a
+  ``stagnation`` event on ``GET /study/<id>/timeline``;
+* ``GET /metrics`` passes the Prometheus exposition lint and carries
+  the ``hyperopt_tpu_quality_*`` gauge families (plus the stagnation
+  SLO objective riding the burn-rate plane);
+* the server still drains cleanly on SIGTERM (exit 0).
+
+Opt in via ``QUALITY_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: mix size 4 keeps the smoke to the cheap analytic domains
+#: (quadratic1, branin, hartmann6, rosenbrock4 — all budget 20)
+_MIX_N = 4
+
+
+def fail(msg):
+    print(f"quality_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def _drive_study(client, zoo_rec, sid, budget):
+    """Ask/tell ``sid`` to budget, evaluating the zoo objective
+    client-side (the server never sees a loss it didn't get told)."""
+    for _ in range(budget):
+        t = client.ask(sid)[0]
+        loss = float(zoo_rec.objective(t["params"]))
+        client.tell(sid, t["tid"], loss=loss)
+
+
+def main():
+    from validate_scrape import validate_metrics_text
+
+    from hyperopt_tpu.obs.quality import DEFAULT_PLATEAU_WINDOW
+    from hyperopt_tpu.service.client import ServiceClient
+    from hyperopt_tpu.zoo import ZOO, make_study_mix
+
+    tmp = tempfile.mkdtemp(prefix="quality_smoke_")
+    store = os.path.join(tmp, "store")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HYPEROPT_TPU_QUALITY", None)       # default ON is the pin
+    env["HYPEROPT_TPU_SERVICE_SLO"] = "on"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--port", "0", "--announce", "--store", store],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("SERVICE_URL "):
+                url = line.split(None, 1)[1].strip()
+                break
+            if proc.poll() is not None:
+                break
+        if url is None:
+            print((proc.stderr.read() or "")[-2000:], file=sys.stderr)
+            return fail("server never announced")
+        print(f"quality_smoke: server up at {url} (pid {proc.pid})")
+
+        client = ServiceClient(url)
+        import urllib.request
+
+        # -- the zoo mix under tpe AND rand --------------------------------
+        items = make_study_mix(_MIX_N, 0)
+        arms = {}  # (algo, item name) -> sid
+        for m in items:
+            # tpe arm: the mix's startup count; rand arm: startup floor
+            # past the budget, so every ask is served by rand
+            arms["tpe", m.name] = client.create_study(
+                zoo=m.domain.name, seed=m.seed,
+                n_startup_jobs=m.n_startup_jobs)
+            arms["rand", m.name] = client.create_study(
+                zoo=m.domain.name, seed=m.seed,
+                n_startup_jobs=m.budget + 1)
+        for m in items:
+            for algo in ("tpe", "rand"):
+                _drive_study(client, ZOO[m.domain.name],
+                             arms[algo, m.name], m.budget)
+        with urllib.request.urlopen(url + "/studies", timeout=30) as r:
+            studies = {s["study_id"]: s
+                       for s in json.loads(r.read())["studies"]}
+        t2t = {"tpe": 0, "rand": 0}
+        for m in items:
+            for algo in ("tpe", "rand"):
+                s = studies.get(arms[algo, m.name]) or {}
+                q = s.get("quality")
+                if not q:
+                    return fail(f"study {arms[algo, m.name]} ({algo} "
+                                f"{m.name}) has no quality section: {s}")
+                if q.get("best_loss") is None or q.get("n_told") != m.budget:
+                    return fail(f"quality bookkeeping off for {algo} "
+                                f"{m.name}: {q}")
+                t2t[algo] += (q["trials_to_target"] if q.get("solved")
+                              else m.budget)
+        print(f"quality_smoke: mix of {len(items)} driven under both "
+              f"algos — trials-to-target tpe {t2t['tpe']} vs rand "
+              f"{t2t['rand']}")
+        if t2t["tpe"] > t2t["rand"]:
+            return fail(f"tpe ({t2t['tpe']}) worse than rand "
+                        f"({t2t['rand']}) on summed trials-to-target")
+
+        # -- stagnation fires on a budget-starved study --------------------
+        sid = client.create_study(
+            space={"x": {"dist": "uniform", "args": [-5, 5]}}, seed=3,
+            n_startup_jobs=1)
+        for _ in range(DEFAULT_PLATEAU_WINDOW + 2):
+            t = client.ask(sid)[0]
+            client.tell(sid, t["tid"], loss=1.0)  # never improves
+        with urllib.request.urlopen(url + "/studies", timeout=30) as r:
+            studies = {s["study_id"]: s
+                       for s in json.loads(r.read())["studies"]}
+        q = (studies.get(sid) or {}).get("quality") or {}
+        if not q.get("stagnant"):
+            return fail(f"budget-starved study never flagged stagnant: {q}")
+        with urllib.request.urlopen(f"{url}/study/{sid}/timeline",
+                                    timeout=30) as r:
+            tl = json.loads(r.read())
+        ev = [e["event"] for e in tl.get("events", [])]
+        if "stagnation" not in ev or "improvement" not in ev:
+            return fail(f"timeline missing quality events: {ev}")
+        print("quality_smoke: stagnation flagged on /studies and the "
+              "timeline")
+
+        # -- /metrics: exposition lint + quality_* families ----------------
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        errs = validate_metrics_text(text)
+        if errs:
+            return fail("exposition lint: " + "; ".join(errs[:5]))
+        for fam in ("hyperopt_tpu_quality_studies",
+                    "hyperopt_tpu_quality_stagnant_frac",
+                    "hyperopt_tpu_slo_stagnation_budget_remaining_frac"):
+            if fam not in text:
+                return fail(f"/metrics missing quality family {fam}")
+        print("quality_smoke: /metrics lints clean with quality_* gauges "
+              "and the stagnation SLO objective")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 0:
+            return fail(f"server exited {rc} on SIGTERM")
+        print("quality_smoke: OK — tpe beat rand on the mix by the "
+              "server's own telemetry; stagnation detected end-to-end; "
+              "quality_* gauges lint clean; clean drain")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

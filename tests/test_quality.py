@@ -1,0 +1,434 @@
+"""ISSUE 16: the search-quality observability plane.
+
+The acceptance pins:
+
+* the streaming plateau detector mirrors ``early_stop.no_progress_loss``
+  math exactly (same improvement test, edge-triggered per episode);
+* ``Study.best_loss`` is O(1) after the first read — no per-call rescan
+  of the result docs — and stays consistent across WAL replay;
+* armed telemetry NEVER changes proposals: armed == disarmed
+  bit-identical, directly and over HTTP;
+* improvement/stagnation timeline events survive crash-resume
+  (replay-flagged, resume-twice idempotent) and an armed scheduler
+  replays pre-ISSUE-16 WALs bitwise;
+* the per-algo quality keys really GATE: an injected regression on
+  ``trials_to_target_tpe`` / ``final_regret_tpe`` / ``solved_frac_tpe``
+  fails ``scripts/bench_gate.py``'s windowed compare, and
+  ``quality_overhead_frac`` gates against its fixed absolute bar from
+  the very first record.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu._env import parse_quality, parse_quality_slo
+from hyperopt_tpu.obs.quality import (
+    DEFAULT_PLATEAU_WINDOW,
+    QualityPlane,
+    StudyQuality,
+    merge_status,
+    quality_record,
+    summarize_run,
+)
+from hyperopt_tpu.obs.slo import QUALITY_TARGETS, SLOPlane
+from hyperopt_tpu.service.journal import StudyJournal, wal_path_for
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+# ---------------------------------------------------------------------------
+# the streaming detector: no_progress_loss math, edge-triggered
+# ---------------------------------------------------------------------------
+
+
+def test_detector_mirrors_no_progress_loss_math():
+    # pct=10: an improvement needs loss < best - |best| * 0.10, where
+    # best is the pure running min — exactly ``no_progress_loss``'s
+    # ``best_loss`` reference
+    q = StudyQuality("s", "c", window=5, pct=10.0)
+    assert q.observe(10.0) == "improvement"   # first ok loss always
+    assert q.observe(9.5) is None             # < 10 but not by 10%
+    assert q.best == 9.5                      # best still tracks the min
+    assert q.observe(8.9) is None             # needs < 9.5 - 0.95 = 8.55
+    assert q.observe(7.9) == "improvement"    # < 8.9 - 0.89 = 8.01
+    assert q.since_improvement == 0
+
+
+def test_stagnation_is_edge_triggered_and_clears():
+    q = StudyQuality("s", "c", window=3)
+    assert q.observe(1.0) == "improvement"
+    assert q.observe(1.0) is None
+    assert q.observe(1.0) is None
+    assert q.observe(1.0) == "stagnation"     # crossing the window fires
+    assert q.stagnant
+    # the plateau keeps going: ONE event, not one per tell
+    assert q.observe(1.0) is None
+    assert q.observe(1.0) is None
+    assert q.observe(0.5) == "improvement"    # improvement clears the flag
+    assert not q.stagnant
+    assert q.observe(0.5) is None
+    assert q.observe(0.5) is None
+    assert q.observe(0.5) == "stagnation"     # and the detector re-arms
+    assert q.stagnations == 2
+
+
+def test_failed_trials_count_toward_stagnation_not_best():
+    q = StudyQuality("s", "c", window=2)
+    q.observe(3.0)
+    assert q.observe(None) is None
+    assert q.observe(None) == "stagnation"
+    assert q.best == 3.0 and q.n_told == 3
+
+
+def test_regret_solved_and_curve():
+    q = StudyQuality("s", "c", optimum=1.0, loss_target=1.5, window=5)
+    q.observe(4.0)
+    assert q.regret == 3.0 and not q.solved
+    q.observe(1.2)
+    assert q.solved and q.trials_to_target == 2
+    assert q.regret == pytest.approx(0.2)
+    q.observe(0.5)  # beats the recorded optimum: clamped, not negative
+    assert q.regret == 0.0
+    assert q.curve == [(1, 4.0), (2, 1.2), (3, 0.5)]
+    d = q.status_dict()
+    assert d["solved"] and d["trials_to_target"] == 2
+    assert d["best_loss"] == 0.5 and d["regret"] == 0.0
+
+
+def test_ewma_rises_on_wins_decays_on_plateau():
+    q = StudyQuality("s", "c", alpha=0.5)
+    q.observe(10.0)
+    q.observe(6.0)                 # delta 4
+    rate = q.ewma
+    assert rate > 0
+    q.observe(7.0)                 # non-improving: decay toward zero
+    assert q.ewma < rate
+
+
+def test_summarize_run():
+    s = summarize_run([5.0, None, 2.0, 1.0, 3.0], budget=5,
+                      loss_target=2.0, optimum=0.5)
+    assert s["best"] == 1.0 and s["solved"]
+    assert s["trials_to_target"] == 3          # 1-based, first clearing
+    assert s["final_regret"] == pytest.approx(0.5)
+    # unsolved runs charge the full budget — aggregation must penalize
+    s = summarize_run([5.0, 4.0], budget=20, loss_target=1.0)
+    assert not s["solved"] and s["trials_to_target"] == 20
+    assert summarize_run([], budget=3)["best"] is None
+
+
+def test_merge_status_across_planes():
+    a = {"studies": 2, "stagnant": 1, "solved": 1, "improvements": 5,
+         "stagnations": 1, "stagnant_frac": 0.5,
+         "cohorts": {"tpe_branin": {"studies": 2, "stagnant": 1,
+                                    "solved": 1, "best_loss": 0.5,
+                                    "best_regret": 0.1}}}
+    b = {"studies": 1, "stagnant": 0, "solved": 0, "improvements": 2,
+         "stagnations": 0, "stagnant_frac": 0.0,
+         "cohorts": {"tpe_branin": {"studies": 1, "stagnant": 0,
+                                    "solved": 0, "best_loss": 0.4,
+                                    "best_regret": None}}}
+    m = merge_status([a, b])
+    assert m["studies"] == 3 and m["stagnant"] == 1
+    assert m["stagnant_frac"] == pytest.approx(1 / 3)
+    c = m["cohorts"]["tpe_branin"]
+    assert c["studies"] == 3 and c["best_loss"] == 0.4
+    assert c["best_regret"] == 0.1             # None never wins the min
+    assert merge_status([]) is None
+    assert merge_status([a, None]) is a        # single plane passes through
+
+
+def test_quality_record_shape():
+    rec = quality_record("test", {"tpe": {"trials_to_target": 3}},
+                         config={"n": 1})
+    assert rec["kind"] == "quality" and rec["source"] == "test"
+    assert rec["algos"]["tpe"]["trials_to_target"] == 3
+    json.dumps(rec)  # store rows must be JSON-serializable
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_QUALITY", raising=False)
+    assert parse_quality()                      # default ON for serving
+    for off in ("0", "off", "false", "no"):
+        assert not parse_quality({"HYPEROPT_TPU_QUALITY": off})
+    assert parse_quality({"HYPEROPT_TPU_QUALITY": "1"})
+    # the SLO rider: default on, explicit off, and the token grammar
+    assert parse_quality_slo({}) == QUALITY_TARGETS
+    assert parse_quality_slo({"HYPEROPT_TPU_QUALITY_SLO": "off"}) is None
+    t = parse_quality_slo({"HYPEROPT_TPU_QUALITY_SLO": "stagnant=25"})
+    assert t["stagnation"]["target"] == pytest.approx(0.75)
+    # malformed tokens warn once and fall back to the defaults
+    assert parse_quality_slo(
+        {"HYPEROPT_TPU_QUALITY_SLO": "stagnant=banana"}) == QUALITY_TARGETS
+
+
+def test_slo_stagnation_objective_records():
+    slo = SLOPlane(metrics=None, clock=lambda: 1000.0)
+    slo.add_objective("stagnation", QUALITY_TARGETS["stagnation"])
+    slo.add_objective("stagnation", {"target": 0.5})  # idempotent
+    assert slo.objectives["stagnation"].target == 0.90
+    for _ in range(9):
+        slo.record_quality(False, now=1000.0)
+    slo.record_quality(True, now=1000.0)
+    st = slo.status(now=1000.0)["stagnation"]
+    assert st["budget_remaining_frac"] < 1.0
+    # disarmed plane: record_quality is a no-op, not a KeyError
+    SLOPlane(metrics=None).record_quality(True)
+
+
+# ---------------------------------------------------------------------------
+# Study.best_loss: O(1) after first read, consistent across replay
+# ---------------------------------------------------------------------------
+
+
+def test_best_loss_is_cached_not_rescanned():
+    sched = StudyScheduler(wal=False)
+    sid = sched.create_study(SPACE, seed=7, n_startup_jobs=10)
+    losses = [0.9, 0.4, 0.7]
+    for loss in losses:
+        a = sched.ask(sid)[0]
+        sched.tell(sid, a["tid"], loss)
+    st = sched._studies[sid]
+    assert st.best_loss() == 0.4
+    # tamper a settled doc's loss bypassing the scheduler: a cached best
+    # must NOT see it (pre-PR the O(n) rescan on every status read would)
+    for r in st.trials.results:
+        if r.get("loss") == 0.4:
+            r["loss"] = -99.0
+    assert st.best_loss() == 0.4               # O(1) cached read
+    st.mark_best_dirty()
+    assert st.best_loss() == -99.0             # the rescan path still works
+
+
+def test_best_loss_ignores_failed_trials():
+    sched = StudyScheduler(wal=False)
+    sid = sched.create_study(SPACE, seed=3, n_startup_jobs=10)
+    a = sched.ask(sid)[0]
+    sched.tell(sid, a["tid"], 0.8)
+    b = sched.ask(sid)[0]
+    sched.tell(sid, b["tid"], None, status="fail")
+    assert sched._studies[sid].best_loss() == 0.8
+
+
+def test_best_loss_consistent_across_wal_replay(tmp_path):
+    store = str(tmp_path / "store")
+    s1 = StudyScheduler(store_root=store)
+    sid = s1.create_study(SPACE, seed=11, n_startup_jobs=10,
+                          space_spec={"space": SPACE_SPEC})
+    for loss in (0.9, 0.2, 0.5):
+        a = s1.ask(sid)[0]
+        s1.tell(sid, a["tid"], loss)
+    assert s1._studies[sid].best_loss() == 0.2
+    s2 = StudyScheduler(store_root=store)
+    st = s2._studies[sid]
+    assert st.best_loss() == 0.2
+    # and the replayed cache is LIVE, not stale: a better tell updates it
+    a = s2.ask(sid)[0]
+    s2.tell(sid, a["tid"], 0.1)
+    assert st.best_loss() == 0.1
+
+
+# ---------------------------------------------------------------------------
+# armed == disarmed: telemetry never changes proposals
+# ---------------------------------------------------------------------------
+
+
+def _drive(sched, sid, n):
+    out = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        out.append((a["tid"], repr(a["params"]["x"])))
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+    return out
+
+
+def test_armed_equals_disarmed_bit_identical():
+    on = StudyScheduler(wal=False, quality=QualityPlane())
+    off = StudyScheduler(wal=False, quality=False)
+    assert on.quality is not None and off.quality is None
+    sid_on = on.create_study(SPACE, seed=21, n_startup_jobs=2)
+    sid_off = off.create_study(SPACE, seed=21, n_startup_jobs=2)
+    assert _drive(on, sid_on, 8) == _drive(off, sid_off, 8)
+    # the armed run really observed: telemetry exists, proposals match
+    q = on.quality.study_status(sid_on)
+    assert q is not None and q["n_told"] == 8
+
+
+def test_armed_equals_disarmed_over_http():
+    def drive(srv, sid, n):
+        seq = []
+        for _ in range(n):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid})
+            assert code == 200
+            t = a["trials"][0]
+            seq.append((t["tid"], repr(t["params"]["x"])))
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": t["tid"],
+                "loss": float((t["params"]["x"] - 1.0) ** 2)})
+            assert code == 200
+        return seq
+
+    seqs = {}
+    for armed in (True, False):
+        sched = StudyScheduler(
+            wal=False, quality=QualityPlane() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, slo=armed, trace=False)
+        code, r = srv.handle("POST", "/study", {
+            "space": SPACE_SPEC, "seed": 33, "n_startup_jobs": 2})
+        seqs[armed] = drive(srv, r["study_id"], 8)
+        if armed:
+            # the armed server's surfaces carry the quality sections
+            snap = srv.snapshot_dict()
+            assert snap["quality"]["studies"] == 1
+            assert snap["studies"][0]["quality"]["n_told"] == 8
+    assert seqs[True] == seqs[False]
+
+
+# ---------------------------------------------------------------------------
+# crash-resume: events replay-flagged, idempotent, back-compat bitwise
+# ---------------------------------------------------------------------------
+
+
+def _quality_events(sched, sid):
+    return [e for e in sched.study_timeline(sid)["events"]
+            if e["event"] in ("improvement", "stagnation")]
+
+
+def test_quality_events_replay_flagged_and_idempotent(tmp_path):
+    store = str(tmp_path / "store")
+    s1 = StudyScheduler(store_root=store)
+    sid = s1.create_study(SPACE, seed=5, n_startup_jobs=1,
+                          space_spec={"space": SPACE_SPEC})
+    # one improvement, then a full plateau window => one stagnation
+    a = s1.ask(sid)[0]
+    s1.tell(sid, a["tid"], 1.0)
+    for _ in range(DEFAULT_PLATEAU_WINDOW):
+        a = s1.ask(sid)[0]
+        s1.tell(sid, a["tid"], 2.0)            # never improves
+    live = _quality_events(s1, sid)
+    assert [e["event"] for e in live] == ["improvement", "stagnation"]
+    assert not any(e.get("replay") for e in live)
+    assert s1.quality.study_status(sid)["stagnant"]
+
+    # crash-resume: same events, now replay-flagged, tracker state rebuilt
+    s2 = StudyScheduler(store_root=store)
+    ev2 = _quality_events(s2, sid)
+    assert [e["event"] for e in ev2] == ["improvement", "stagnation"]
+    assert all(e.get("replay") for e in ev2)
+    assert s2.quality.study_status(sid)["stagnant"]
+    assert (s2.quality.study_status(sid)["n_told"]
+            == s1.quality.study_status(sid)["n_told"])
+
+    # resume-twice: replay is idempotent, no duplicated events
+    s3 = StudyScheduler(store_root=store)
+    assert ([e["event"] for e in _quality_events(s3, sid)]
+            == ["improvement", "stagnation"])
+
+
+def test_pre_issue16_wal_replays_bitwise_on_armed_scheduler(tmp_path):
+    """A WAL written before this PR carries no quality-derived records
+    at all (the plane writes none — events live in memory, telemetry in
+    gauges), so the pre-ISSUE-16 format IS the current format.  The pin:
+    an armed scheduler replays it to bit-identical proposals."""
+    ref = StudyScheduler(wal=False, quality=False)
+    ref_sid = ref.create_study(SPACE, seed=42, n_startup_jobs=2)
+    ref_seq = _drive(ref, ref_sid, 6)
+
+    store = str(tmp_path / "store")
+    s1 = StudyScheduler(store_root=store, quality=False)  # pre-PR writer
+    sid = s1.create_study(SPACE, seed=42, n_startup_jobs=2,
+                          space_spec={"space": SPACE_SPEC})
+    seq1 = _drive(s1, sid, 3)
+    # the WAL holds nothing quality-specific for the armed reader to see
+    kinds = {r["kind"] for r in
+             StudyJournal(wal_path_for(store)).records()}
+    assert kinds <= {"admit", "ask", "tell", "close", "snapshot"}
+
+    s2 = StudyScheduler(store_root=store)   # armed (the default)
+    assert s2.quality is not None
+    assert s2.last_resume["errors"] == 0
+    seq2 = _drive(s2, sid, 3)
+    assert seq1 + seq2 == ref_seq
+    # and the armed reader rebuilt telemetry from the replayed tells
+    assert s2.quality.study_status(sid)["n_told"] == 6
+
+
+def test_quality_fault_never_fails_a_tell():
+    sched = StudyScheduler(wal=False, quality=QualityPlane())
+
+    def boom(st, loss, replay=False):
+        raise RuntimeError("tracker exploded")
+
+    sched.quality.observe_tell = boom
+    sid = sched.create_study(SPACE, seed=2, n_startup_jobs=1)
+    a = sched.ask(sid)[0]
+    sched.tell(sid, a["tid"], 0.5)             # must not raise
+    assert sched._studies[sid].best_loss() == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the quality keys really gate: injected regression fails bench_gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_rec(ts, **keys):
+    return {"kind": "bench", "ts": ts, "backend": "cpu",
+            "source": "test", "keys": keys}
+
+
+_GOOD = dict(trials_to_target_tpe=20.0, final_regret_tpe=0.5,
+             solved_frac_tpe=0.8, quality_overhead_frac=0.01)
+
+
+def test_injected_quality_regression_fails_the_gate(tmp_path):
+    import bench_gate  # scripts/ (path injected above)
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS
+
+    history = [_bench_rec(float(i), **_GOOD) for i in range(3)]
+    # a healthy new round passes
+    regs, _ = bench_gate.windowed_compare(
+        history, _bench_rec(3.0, **_GOOD), KEY_DIRECTIONS)
+    assert regs == []
+    # degrade each quality axis past its threshold: the gate must fail
+    for key, bad in (("trials_to_target_tpe", 30.0),   # +50% > 30% bar
+                     ("final_regret_tpe", 1.5),        # +200% > 75% bar
+                     ("solved_frac_tpe", 0.4)):        # -50% > 30% bar
+        new = _bench_rec(3.0, **{**_GOOD, key: bad})
+        regs, _ = bench_gate.windowed_compare(history, new, KEY_DIRECTIONS)
+        assert any(key in r for r in regs), (key, regs)
+    # end-to-end through the store path (the QUALITY_GATE surface)
+    store = str(tmp_path / "trajectory.jsonl")
+    with open(store, "w") as f:
+        for rec in history + [_bench_rec(3.0, **{**_GOOD,
+                                                 "final_regret_tpe": 9.0})]:
+            f.write(json.dumps(rec) + "\n")
+    assert bench_gate._windowed_main(store, 5, None, explain=True) == 1
+    with open(store, "a") as f:
+        f.write(json.dumps(_bench_rec(4.0, **_GOOD)) + "\n")
+    assert bench_gate._windowed_main(store, 5, None) == 0
+
+
+def test_quality_overhead_gates_absolute_from_first_run(tmp_path):
+    """``quality_overhead_frac`` uses the fixed absolute bar (the
+    profiler/checksum overhead pattern): it gates with NO history at
+    all — the very first recorded round already enforces ≤5%."""
+    import bench_gate
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS
+
+    old = _bench_rec(0.0, trials_per_sec=100.0)  # no quality keys at all
+    over = _bench_rec(1.0, quality_overhead_frac=0.09)
+    regs, _ = bench_gate.windowed_compare([old], over, KEY_DIRECTIONS)
+    assert any("quality_overhead_frac" in r for r in regs)
+    ok = _bench_rec(1.0, quality_overhead_frac=0.04)
+    regs, _ = bench_gate.windowed_compare([old], ok, KEY_DIRECTIONS)
+    assert regs == []
