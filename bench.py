@@ -649,6 +649,81 @@ def bench_quality_overhead(n_tells=150, repeats=5, seed=0):
     return out
 
 
+def bench_load_attribution(n_tells=150, repeats=5, seed=0):
+    """Cost-attribution acceptance bar (ISSUE 17): the per-wave cost
+    ledger (per-study device-time shares, busy EWMA, heat totals) must
+    cost ~nothing on the serving path.  Two halves:
+
+    1. armed-vs-disarmed ask+tell rounds through the REAL handler path
+       (the ``bench_quality_overhead`` harness with the load ledger as
+       the armed plane) → ``attribution_overhead_frac``, gated ABSOLUTE
+       at ≤5%.
+    2. a deliberately skewed placement — 4 bound ledgers, waves split
+       10:1:1:1 with a fixed per-wave device time — merged exactly the
+       way ``/fleet/load`` merges them → ``shard_heat_skew``.  Synthetic
+       device time on purpose: the gate wants a deterministic pin on the
+       share/merge/skew math, not compile-pollution noise.
+    """
+    from hyperopt_tpu.obs.load import CostLedger, heat_skew, merge_status
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    space_spec = {"x": {"dist": "uniform", "args": [-5, 10]},
+                  "y": {"dist": "uniform", "args": [0, 15]}}
+
+    def once(armed):
+        sched = StudyScheduler(
+            wal=False, quality=False,
+            load=CostLedger() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, trace=False,
+                                slo=False)
+        code, r = srv.handle("POST", "/study", {
+            "space": space_spec, "seed": seed,
+            "n_startup_jobs": n_tells + 1})
+        assert code == 200, r
+        sid = r["study_id"]
+        t0 = time.perf_counter()
+        for i in range(n_tells):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid})
+            assert code == 200, a
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": a["trials"][0]["tid"],
+                "loss": float(i % 7)})
+            assert code == 200
+        return time.perf_counter() - t0
+
+    once(False)  # warm the route/admission path for both sides
+    out = {"n_tells": n_tells, "repeats": repeats,
+           "bar": "cost attribution <=5% per ask+tell round (absolute)"}
+    out["load_off_sec"] = min(once(False) for _ in range(repeats))
+    out["load_on_sec"] = min(once(True) for _ in range(repeats))
+    out["attribution_overhead_frac"] = (
+        (out["load_on_sec"] - out["load_off_sec"])
+        / max(out["load_off_sec"], 1e-9))
+    out["attribution_overhead_us_per_tell"] = (
+        (out["load_on_sec"] - out["load_off_sec"])
+        / n_tells * 1e6)
+
+    # half 2: the skewed placement, through the same merge the
+    # /fleet/load endpoint uses
+    waves_per_shard = {0: 10, 1: 1, 2: 1, 3: 1}
+    statuses = []
+    for shard, n_waves in waves_per_shard.items():
+        led = CostLedger()
+        led.bind(shard=shard, replica="bench")
+        for w in range(n_waves):
+            led.observe_tick([(f"s{shard}", 4)], device_sec=1e-3,
+                             cand=96.0, hbm_bytes=1024.0, cohort="cap16")
+        statuses.append(led.publish())
+    merged = merge_status(statuses)
+    out["shard_heat_skew"] = merged["heat_skew"]
+    out["skew_check"] = abs(heat_skew(
+        [s["heat_ms"] for s in statuses]) - merged["heat_skew"]) < 1e-3
+    out["waves_per_shard"] = {str(k): v for k, v in
+                              waves_per_shard.items()}
+    return out
+
+
 def bench_fleet_recovery(reps=5, lease_ttl=0.25, poll=0.01):
     """Elastic-fleet recovery latency (ISSUE 8): wall seconds from a
     controller dying mid-shard (claimed lease, heartbeats stop) to a
@@ -2069,6 +2144,10 @@ _JAX_STAGES = (
     # ISSUE 16: quality-plane overhead bar — armed vs disarmed per-tell
     # delta through the real handler path (gated ≤5% absolute)
     ("quality_overhead", bench_quality_overhead),
+    # ISSUE 17: cost-attribution overhead bar (armed vs disarmed per-tell
+    # delta, gated ≤5% absolute) + the deterministic skewed-placement
+    # shard_heat_skew pin
+    ("load_attribution", bench_load_attribution),
 )
 
 _PROBE_SNIPPET = (
@@ -2359,6 +2438,16 @@ def main():
             for k in ("quality_off_sec", "quality_on_sec",
                       "quality_overhead_frac",
                       "quality_overhead_us_per_tell")}
+    # the cost-attribution bar (ISSUE 17) rides the same way: armed vs
+    # disarmed delta + the skewed-placement heat-skew pin
+    rec = stages.get("load_attribution")
+    if rec and rec.get("ok"):
+        obs_summary["load_attribution"] = {
+            k: rec["result"].get(k)
+            for k in ("load_off_sec", "load_on_sec",
+                      "attribution_overhead_frac",
+                      "attribution_overhead_us_per_tell",
+                      "shard_heat_skew")}
     # the headline stage IS the TPE candidate-proposal path: surface its
     # achieved-FLOP/s + busy fraction on the metric line itself, so the
     # hardware-efficiency claim is answerable from the one-line artifact
@@ -2440,6 +2529,10 @@ def main():
                for a in ("tpe", "rand", "anneal", "mix", "atpe")},
             "quality_overhead_frac": _stage_val(
                 "quality_overhead", "quality_overhead_frac"),
+            "attribution_overhead_frac": _stage_val(
+                "load_attribution", "attribution_overhead_frac"),
+            "shard_heat_skew": _stage_val("load_attribution",
+                                          "shard_heat_skew"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

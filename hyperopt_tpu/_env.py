@@ -38,6 +38,8 @@ __all__ = [
     "parse_service_slo",
     "parse_store_watermark",
     "parse_store_gc",
+    "parse_load",
+    "parse_load_slo",
 ]
 
 logger = logging.getLogger(__name__)
@@ -529,6 +531,69 @@ def parse_quality_slo(env=None):
         else:
             _warn_once("HYPEROPT_TPU_QUALITY_SLO", token,
                        "stagnant=<percent>")
+    return targets
+
+
+def parse_load(env=None):
+    """``HYPEROPT_TPU_LOAD`` → whether the load & cost attribution
+    ledger (``obs/load.py``) is armed on the scheduler.  Default ON —
+    attribution is pure wave-time arithmetic (no threads, never touches
+    proposals, O(1) per cohort tick), and a fleet that cannot say which
+    studies and shards are spending its device time cannot be balanced
+    (ROADMAP 5b/5c).  ``0``/``off`` disarms everything: no rows, no
+    gauges, no heat-ledger appends (the bench ``load_attribution``
+    stage measures the armed-vs-disarmed per-wave delta)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_LOAD", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def parse_load_slo(env=None):
+    """``HYPEROPT_TPU_LOAD_SLO`` → the fleet-imbalance objective the
+    load ledger feeds into the server's SLO burn-rate plane, or None
+    when disabled:
+
+    * unset / ``1`` / ``on`` → the default ``imbalance`` objective
+      (≥90% of load observations see heat skew ≤ the skew bound);
+    * ``0`` / ``off`` → None — load attribution still runs, it just
+      does not burn an error budget;
+    * ``skew=N`` → the heat-skew bound (max/mean shard heat) an
+      observation must stay under to count balanced (default 3.0;
+      must exceed 1.0 — a perfectly balanced fleet sits at 1.0);
+    * ``balanced=N`` → allow N percent of observations over the bound
+      before burning budget.  Malformed tokens warn once and keep the
+      defaults.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_LOAD_SLO", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        from .obs.slo import LOAD_TARGETS
+
+        return {k: dict(v) for k, v in LOAD_TARGETS.items()}
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    from .obs.slo import LOAD_TARGETS
+
+    targets = {k: dict(v) for k, v in LOAD_TARGETS.items()}
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        key, _, val = token.partition("=")
+        key = key.strip().lower()
+        try:
+            v = float(val)
+        except ValueError:
+            _warn_once("HYPEROPT_TPU_LOAD_SLO", token,
+                       "a key=number token")
+            continue
+        if key == "skew" and v > 1.0:
+            targets["imbalance"]["skew_max"] = v
+        elif key == "balanced" and 0 <= v < 100:
+            targets["imbalance"]["target"] = min(0.9999, 1.0 - v / 100.0)
+        else:
+            _warn_once("HYPEROPT_TPU_LOAD_SLO", token,
+                       "skew=<ratio>1> or balanced=<percent>")
     return targets
 
 

@@ -199,6 +199,33 @@ def to_trace_events(records, pid=0, name=None):
                     "pid": pid, "tid": _TID_COUNTERS, "cat": "roofline",
                     "args": {"gflops": flops_per_sec / 1e9},
                 })
+            # per-shard heat counter tracks (ISSUE 17): cumulative
+            # shard heat plotted under the serving spans — from the
+            # service.load.shard.* gauges (flat metric snapshots and
+            # /snapshot-shaped `sections.service` embeds) or the
+            # snapshot's own `load.shards` table
+            snap = r.get("snapshot") or {}
+            heats = {}
+            for src in (snap.get("metrics") or {},
+                        (snap.get("sections") or {}).get("service") or {}):
+                for mname, v in src.items():
+                    if (mname.startswith("service.load.shard.")
+                            and mname.endswith(".heat_ms")
+                            and isinstance(v, (int, float))):
+                        shard = mname[len("service.load.shard."):
+                                      -len(".heat_ms")]
+                        heats[shard] = float(v)
+            shards_tbl = (snap.get("load") or {}).get("shards") or {}
+            for shard, row in shards_tbl.items():
+                if isinstance(row, dict) and row.get("heat_ms") is not None:
+                    heats.setdefault(str(shard), float(row["heat_ms"]))
+            for shard, v in sorted(heats.items()):
+                used_tracks.add(_TID_COUNTERS)
+                events.append({
+                    "name": f"heat.shard{shard}", "ph": "C",
+                    "ts": _us(ts), "pid": pid, "tid": _TID_COUNTERS,
+                    "cat": "load", "args": {"heat_ms": v},
+                })
 
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": name or f"stream-{pid}"}}]

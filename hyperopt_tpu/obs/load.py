@@ -1,0 +1,512 @@
+"""Load & cost attribution observatory (ISSUE 17).
+
+ROADMAP item 5's load-aware serving arc needs a measurement the repo
+never had: the fleet steward balances shards by *count*
+(``ceil(M / live)``), and nothing attributed wave/device time to the
+studies, cohorts or shards that consumed it — "who is spending the
+fused suggest tick" was unanswerable.  Two halves:
+
+**The cost ledger** (:class:`CostLedger`, owned by the
+:class:`~hyperopt_tpu.service.scheduler.StudyScheduler`): fed at the
+wave chokepoint with each cohort tick's MEASURED dispatch+readback
+seconds, candidate count and history bytes, attributed across the
+tick's studies by their K-row share (a study asking 3 of the tick's 4
+rows is charged 3/4 of the tick).  Accumulation is O(1) per study —
+``{device_ms, asks, tells, waves, cand, hbm_bytes}`` rows plus an
+activity EWMA — with a per-scheduler roll-up (shard heat, busy-fraction
+duty EWMA) the fleet surfaces read.  The standing obs invariant holds:
+armed attribution NEVER feeds the RNG or perturbs proposals (armed ==
+disarmed bit-identical, pinned directly and over HTTP by
+``tests/test_load.py``), and disarmed (``HYPEROPT_TPU_LOAD=off``) means
+``scheduler.load is None`` — zero threads, zero allocations, one
+``is None`` check on the wave path.
+
+**The durable heat ledger**: fleet replicas roll their per-shard heat
+up to ``fleet/heat/<replica>.jsonl`` under the shared store root —
+O_APPEND single-line records sealed with the ISSUE-15 CRC32C idiom
+(:func:`~hyperopt_tpu.service.integrity.seal`), torn-line tolerant on
+read, warn-once on ENOSPC like the signature census.  Records are
+cumulative snapshots (each includes inherited baseline heat), so the
+merged per-shard heat is the MAX across all replicas' records: heat
+survives restarts, and migration adoption inherits the shard's
+accumulated heat via :func:`inherited_heat` — a shard doesn't cool off
+by moving.  ``GET /fleet/load``, the ``service.load.*`` gauge family
+(per-shard heat, per-replica busy fraction, the fleet **heat-skew**
+gauge = max/mean shard heat), ``obs.report --fleet`` and ``obs/top.py``
+all read these two surfaces; the steward's heat-aware handoff orders
+its volunteer release by them.  This is the measured-load signal
+ROADMAP items 5(b) tenant fairness and 5(c) load-aware rebalancing
+will consume.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+__all__ = [
+    "DEFAULT_BUSY_ALPHA",
+    "StudyCost",
+    "CostLedger",
+    "HeatLedger",
+    "merge_status",
+    "heat_skew",
+    "heat_dir_for",
+    "heat_path_for",
+    "read_heat",
+    "inherited_heat",
+]
+
+logger = logging.getLogger(__name__)
+
+#: activity-EWMA weight (per-study attributed ms per tick, and the
+#: scheduler-level busy-fraction duty cycle): ~the last dozen ticks
+#: dominate, matching the quality plane's improvement EWMA
+DEFAULT_BUSY_ALPHA = 0.3
+
+#: heat-ledger directory under a store root (next to fleet/wal etc.)
+HEAT_DIR = os.path.join("fleet", "heat")
+
+
+def _sanitize(label):
+    """Metric-name-safe label (the gauges surface as
+    ``hyperopt_tpu_service_load_*`` families and must lint)."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in str(label))
+
+
+class StudyCost:
+    """One study's accumulated attributed cost.  ``charge`` is the only
+    wave-side mutator: O(1), no I/O, no RNG — pure arithmetic on the
+    measured tick."""
+
+    __slots__ = ("study_id", "cohort", "device_ms", "asks", "tells",
+                 "waves", "cand", "hbm_bytes", "ewma_ms")
+
+    def __init__(self, study_id, cohort=None):
+        self.study_id = study_id
+        self.cohort = cohort
+        self.device_ms = 0.0
+        self.asks = 0
+        self.tells = 0
+        self.waves = 0
+        self.cand = 0.0
+        self.hbm_bytes = 0.0
+        self.ewma_ms = 0.0
+
+    def charge(self, share_ms, k, cand, hbm_bytes, alpha):
+        """Fold this study's K-row share of one cohort tick."""
+        self.device_ms += share_ms
+        self.asks += k
+        self.waves += 1
+        self.cand += cand
+        self.hbm_bytes += hbm_bytes
+        self.ewma_ms = alpha * share_ms + (1.0 - alpha) * self.ewma_ms
+
+    def status_dict(self):
+        """The per-study cost section (``GET /studies``)."""
+        return {
+            "cohort": self.cohort,
+            "device_ms": round(self.device_ms, 3),
+            "asks": self.asks,
+            "tells": self.tells,
+            "waves": self.waves,
+            "cand": round(self.cand, 1),
+            "hbm_bytes": round(self.hbm_bytes, 1),
+            "ewma_ms": round(self.ewma_ms, 3),
+        }
+
+
+class CostLedger:
+    """Per-scheduler device-time attribution (zero threads).
+
+    ``metrics`` is the service registry the ``service.load.*`` gauges
+    publish into (pull-based: :meth:`publish` refreshes at
+    scrape/snapshot time).  Lock discipline mirrors the quality plane:
+    every wave/tell mutation arrives under the scheduler's RLock, so
+    the hot path is lock-free — the ledger's own lock guards only row
+    admission.  Scrape-side reads are deliberately unlocked (a scrape
+    racing a wave sees the tick one charge early or late, both true).
+
+    Fleet identity (:meth:`bind`) and inherited baseline heat
+    (:meth:`inherit`) are set by :class:`~hyperopt_tpu.service.fleet
+    .FleetReplica` at adoption — ``heat_ms`` then reports the shard's
+    CUMULATIVE lifetime heat, not just this owner's share."""
+
+    def __init__(self, metrics=None, alpha=DEFAULT_BUSY_ALPHA):
+        self.metrics = metrics
+        self.alpha = float(alpha)
+        self.shard = None
+        self.replica = None
+        self._studies = {}
+        self._lock = threading.Lock()
+        # scheduler-level totals (attributed, so they sum to the
+        # measured tick times exactly)
+        self.device_ms = 0.0
+        self.inherited_ms = 0.0  # baseline adopted from the heat ledger
+        self.asks = 0
+        self.tells = 0
+        self.waves = 0
+        self.cand = 0.0
+        self.hbm_bytes = 0.0
+        self.busy = 0.0          # duty-cycle EWMA (device sec / wall sec)
+        self._last_tick = None   # monotonic ts of the previous tick
+
+    # -- fleet identity ----------------------------------------------------
+
+    def bind(self, shard=None, replica=None):
+        """Attach the (shard, replica) identity the fleet rows carry."""
+        self.shard = None if shard is None else int(shard)
+        self.replica = None if replica is None else str(replica)
+
+    def inherit(self, heat_ms):
+        """Adopt a baseline heat (the shard's accumulated heat under
+        previous owners, read from the ledger).  Idempotent via max —
+        re-adoption never doubles heat."""
+        self.inherited_ms = max(self.inherited_ms, float(heat_ms or 0.0))
+
+    @property
+    def heat_ms(self):
+        """The shard's cumulative heat: inherited baseline + everything
+        this scheduler attributed itself."""
+        return self.inherited_ms + self.device_ms
+
+    # -- the wave-chokepoint hook ------------------------------------------
+
+    def observe_tick(self, entries, device_sec, cand=0.0, hbm_bytes=0.0,
+                     cohort=None):
+        """Attribute one measured cohort tick.  ``entries`` is
+        ``[(study_id, k_rows), ...]`` — the tick's asks and their K-row
+        counts; each study is charged ``k_i / sum(k)`` of the tick's
+        ``device_sec``, ``cand`` and ``hbm_bytes``.  Called under the
+        scheduler RLock (see class docstring); never touches proposals."""
+        total_k = 0
+        for _, k in entries:
+            total_k += k
+        if total_k <= 0:
+            return
+        ms = float(device_sec) * 1e3
+        inv = 1.0 / total_k
+        for study_id, k in entries:
+            row = self._studies.get(study_id)
+            if row is None:
+                with self._lock:
+                    row = self._studies.get(study_id)
+                    if row is None:
+                        row = StudyCost(study_id, cohort=cohort)
+                        self._studies[study_id] = row
+            if row.cohort is None and cohort is not None:
+                row.cohort = cohort  # first device tick names the cohort
+            share = k * inv
+            row.charge(ms * share, k, cand * share, hbm_bytes * share,
+                       self.alpha)
+        self.device_ms += ms
+        self.asks += total_k
+        self.waves += 1
+        self.cand += float(cand)
+        self.hbm_bytes += float(hbm_bytes)
+        # busy-fraction duty EWMA: device seconds over the wall seconds
+        # since the previous tick (clamped — a tick can't be busier
+        # than 100% of its own interval)
+        now = time.monotonic()
+        if self._last_tick is not None:
+            wall = now - self._last_tick
+            duty = float(device_sec) / max(wall, float(device_sec), 1e-9)
+            self.busy = self.alpha * duty + (1.0 - self.alpha) * self.busy
+        self._last_tick = now
+
+    def observe_tell(self, study_id):
+        """Count one LIVE settled tell (replay excluded by the caller —
+        adopted heat arrives through :meth:`inherit`, never recounted)."""
+        self.tells += 1
+        row = self._studies.get(study_id)
+        if row is None:
+            with self._lock:
+                row = self._studies.get(study_id)
+                if row is None:
+                    row = StudyCost(study_id)
+                    self._studies[study_id] = row
+        row.tells += 1
+
+    def forget(self, study_id):
+        with self._lock:
+            self._studies.pop(study_id, None)
+
+    def study_status(self, study_id):
+        """Cost section for one study, or None if never charged.
+        Lock-free read (see class docstring)."""
+        row = self._studies.get(study_id)
+        return None if row is None else row.status_dict()
+
+    # -- pull-based publication --------------------------------------------
+
+    def status(self):
+        """The load roll-up (``/snapshot`` + ``/fleet/load`` section):
+        scheduler totals plus the per-cohort table."""
+        rows = list(self._studies.values())
+        cohorts = {}
+        for row in rows:
+            key = row.cohort or "unticked"
+            c = cohorts.setdefault(key, {
+                "studies": 0, "device_ms": 0.0, "asks": 0, "tells": 0,
+                "waves": 0})
+            c["studies"] += 1
+            c["device_ms"] += row.device_ms
+            c["asks"] += row.asks
+            c["tells"] += row.tells
+            c["waves"] += row.waves
+        for c in cohorts.values():
+            c["device_ms"] = round(c["device_ms"], 3)
+        return {
+            "shard": self.shard,
+            "replica": self.replica,
+            "studies": len(rows),
+            "device_ms": round(self.device_ms, 3),
+            "inherited_ms": round(self.inherited_ms, 3),
+            "heat_ms": round(self.heat_ms, 3),
+            "busy_frac": round(self.busy, 4),
+            "asks": self.asks,
+            "tells": self.tells,
+            "waves": self.waves,
+            "cand": round(self.cand, 1),
+            "hbm_bytes": round(self.hbm_bytes, 1),
+            "cohorts": cohorts,
+        }
+
+    def publish(self):
+        """Refresh the per-shard ``service.load.shard.*`` gauges (bound
+        fleet schedulers only) and return :meth:`status` — the
+        scrape/snapshot hook.  Fleet-level merged gauges (heat skew,
+        totals) are set by the server from :func:`merge_status`."""
+        st = self.status()
+        if self.metrics is not None and self.shard is not None:
+            base = f"service.load.shard.{self.shard}"
+            g = self.metrics.gauge
+            g(f"{base}.heat_ms").set(st["heat_ms"])
+            g(f"{base}.busy_frac").set(st["busy_frac"])
+            g(f"{base}.device_ms").set(st["device_ms"])
+            g(f"{base}.waves").set(st["waves"])
+        return st
+
+    def heat_record(self):
+        """One cumulative heat-ledger snapshot for this scheduler (the
+        roll-up the replica appends).  Monotone per owner: every record
+        includes the inherited baseline, so the merged MAX across all
+        replicas' records is the shard's lifetime heat."""
+        return {
+            "kind": "heat",
+            "replica": self.replica,
+            "shard": self.shard,
+            "heat_ms": round(self.heat_ms, 3),
+            "device_ms": round(self.device_ms, 3),
+            "busy_frac": round(self.busy, 4),
+            "studies": len(self._studies),
+            "asks": self.asks,
+            "tells": self.tells,
+            "waves": self.waves,
+            "cand": round(self.cand, 1),
+            "hbm_bytes": round(self.hbm_bytes, 1),
+            "ts": time.time(),
+        }
+
+
+def heat_skew(values):
+    """The fleet imbalance scalar: max/mean over per-shard heats — 1.0
+    is perfectly balanced, N means the hottest shard carries N× the
+    average.  1.0 when there is nothing to compare (≤1 shard, or no
+    heat anywhere: an idle fleet is not imbalanced)."""
+    vals = [float(v) for v in values if v is not None]
+    if len(vals) < 2:
+        return 1.0
+    mean = sum(vals) / len(vals)
+    if mean <= 0.0:
+        return 1.0
+    return max(vals) / mean
+
+
+def merge_status(statuses):
+    """Merge per-scheduler :meth:`CostLedger.status` dicts (the fleet
+    server runs one ledger per adopted shard) into the replica-level
+    view: summed totals, the per-shard table, and the heat-skew scalar
+    over the shards this replica can see."""
+    statuses = [s for s in statuses if s]
+    if not statuses:
+        return None
+    out = {"studies": 0, "device_ms": 0.0, "heat_ms": 0.0,
+           "asks": 0, "tells": 0, "waves": 0, "cand": 0.0,
+           "hbm_bytes": 0.0, "busy_frac": 0.0, "shards": {}}
+    for s in statuses:
+        for k in ("studies", "asks", "tells", "waves"):
+            out[k] += int(s.get(k) or 0)
+        for k in ("device_ms", "heat_ms", "cand", "hbm_bytes"):
+            out[k] += float(s.get(k) or 0.0)
+        # shards tick sequentially within one process wave loop, so the
+        # replica's duty cycle is the sum of its schedulers' duties
+        out["busy_frac"] += float(s.get("busy_frac") or 0.0)
+        if s.get("shard") is not None:
+            out["shards"][str(s["shard"])] = {
+                "heat_ms": s.get("heat_ms"),
+                "busy_frac": s.get("busy_frac"),
+                "device_ms": s.get("device_ms"),
+                "studies": s.get("studies"),
+                "asks": s.get("asks"),
+                "tells": s.get("tells"),
+                "waves": s.get("waves"),
+            }
+    for k in ("device_ms", "heat_ms", "cand", "hbm_bytes"):
+        out[k] = round(out[k], 3)
+    out["busy_frac"] = round(out["busy_frac"], 4)
+    out["heat_skew"] = round(heat_skew(
+        [v["heat_ms"] for v in out["shards"].values()]), 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the durable heat ledger: fleet/heat/<replica>.jsonl under the store root
+# ---------------------------------------------------------------------------
+
+
+def heat_dir_for(store_root):
+    return os.path.join(str(store_root), HEAT_DIR)
+
+
+def heat_path_for(store_root, replica_id):
+    """One append-only ledger file per replica — replicas never share a
+    file, so no write interleaving; readers merge the directory."""
+    return os.path.join(heat_dir_for(store_root), f"{replica_id}.jsonl")
+
+
+class HeatLedger:
+    """Append-only durable heat records for one replica (the signature
+    census's O_APPEND idiom): every line sealed with the ISSUE-15
+    CRC32C field, best-effort on ANY OSError — a full disk must cost
+    heat durability, never a request — with a warn-once latch."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._warned = False
+
+    def append(self, rec):
+        from ..service import integrity
+
+        line = (integrity.seal(rec) + "\n").encode()
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            fd = os.open(self.path,
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, line)
+            finally:
+                os.close(fd)
+        except OSError as e:
+            if not self._warned:
+                self._warned = True
+                logger.warning("heat ledger: cannot append to %s (%s); "
+                               "shard heat will not survive a restart",
+                               self.path, e)
+
+
+def _iter_heat_records(store_root):
+    """Every readable heat record under the store root, with corruption
+    counted instead of raised: CORRUPT lines are skipped loudly (a
+    bit-flip costs one snapshot, never the view), TORN final lines
+    silently (the normal crash artifact)."""
+    from ..service import integrity
+
+    d = heat_dir_for(store_root)
+    try:
+        names = sorted(os.listdir(d))
+    except (FileNotFoundError, NotADirectoryError):
+        return
+    for fname in names:
+        if not fname.endswith(".jsonl"):
+            continue
+        path = os.path.join(d, fname)
+        for chk in integrity.iter_checked_jsonl(path):
+            if chk.status == integrity.CORRUPT:
+                logger.warning("heat ledger: %s:%d corrupt record "
+                               "skipped", path, chk.lineno)
+                yield fname, None, chk.status
+                continue
+            if chk.rec is None:  # torn tail
+                yield fname, None, chk.status
+                continue
+            yield fname, chk.rec, chk.status
+
+
+def read_heat(store_root):
+    """The merged fleet-wide heat view from every replica's ledger
+    file: per-shard cumulative heat (MAX across records — each record
+    is a cumulative snapshot including inherited baseline, so the max
+    survives any ownership chain), per-replica latest snapshot (busy
+    fraction, held totals), and the fleet heat-skew scalar."""
+    shards = {}
+    replicas = {}
+    files = set()
+    corrupt = torn = 0
+    for fname, rec, status in _iter_heat_records(store_root):
+        files.add(fname)
+        if rec is None:
+            from ..service import integrity
+
+            if status == integrity.CORRUPT:
+                corrupt += 1
+            else:
+                torn += 1
+            continue
+        if rec.get("kind") != "heat":
+            continue
+        shard = rec.get("shard")
+        if shard is not None:
+            k = str(int(shard))
+            cur = shards.get(k)
+            if cur is None or float(rec.get("heat_ms") or 0.0) \
+                    > cur["heat_ms"]:
+                shards[k] = {
+                    "heat_ms": float(rec.get("heat_ms") or 0.0),
+                    "replica": rec.get("replica"),
+                    "waves": rec.get("waves"),
+                    "asks": rec.get("asks"),
+                    "tells": rec.get("tells"),
+                    "ts": rec.get("ts"),
+                }
+        rid = rec.get("replica")
+        if rid is not None:
+            cur = replicas.get(rid)
+            if cur is None or float(rec.get("ts") or 0.0) \
+                    >= float(cur.get("ts") or 0.0):
+                replicas[rid] = {
+                    "busy_frac": rec.get("busy_frac"),
+                    "shard": rec.get("shard"),
+                    "ts": rec.get("ts"),
+                }
+    return {
+        "shards": shards,
+        "replicas": replicas,
+        "heat_skew": round(heat_skew(
+            [v["heat_ms"] for v in shards.values()]), 4),
+        "files": len(files),
+        "corrupt": corrupt,
+        "torn": torn,
+    }
+
+
+def inherited_heat(store_root, shard):
+    """The cumulative heat an adopter of ``shard`` inherits: the MAX
+    ``heat_ms`` any replica ever recorded for it (records are
+    cumulative snapshots, so the max IS the lifetime total).  0.0 for
+    a never-heated shard or an unreadable ledger — adoption must never
+    fail on observability."""
+    best = 0.0
+    try:
+        k = int(shard)
+        for _, rec, _status in _iter_heat_records(store_root):
+            if rec is None or rec.get("kind") != "heat":
+                continue
+            if rec.get("shard") is not None and int(rec["shard"]) == k:
+                best = max(best, float(rec.get("heat_ms") or 0.0))
+    except Exception:  # noqa: BLE001 - fail-open read
+        logger.warning("heat ledger: inherited-heat read failed for "
+                       "shard %s (continuing cold)", shard, exc_info=True)
+    return best

@@ -808,6 +808,71 @@ def render_trend(records, width=24):
     return "\n".join(out) + "\n"
 
 
+def render_fleet_load(store_root, width=24):
+    """The fleet-wide heat view (ISSUE 17) from every replica's durable
+    heat ledger under ``<store_root>/fleet/heat/``: one row per shard —
+    cumulative heat (the MAX across all replicas' cumulative snapshots,
+    so restarts and ownership moves never reset it), the latest owner,
+    and a sparkline of the shard's heat history — plus the per-replica
+    busy fractions and a SKEW banner when max/mean shard heat exceeds
+    the default imbalance bound.  Corrupt ledger lines are counted, not
+    fatal (the census read discipline)."""
+    from .load import _iter_heat_records, read_heat
+    from .slo import LOAD_TARGETS
+
+    merged = read_heat(store_root)
+    out = []
+    out.append("== fleet load " + "=" * 50)
+    out.append(f"  store {store_root}   ledger files {merged['files']}"
+               + (f"   CORRUPT {merged['corrupt']}"
+                  if merged["corrupt"] else "")
+               + (f"   torn {merged['torn']}" if merged["torn"] else ""))
+    shards = merged["shards"]
+    if not shards:
+        out.append("  (no heat records yet — is the fleet serving with "
+                   "HYPEROPT_TPU_LOAD armed?)")
+        return "\n".join(out) + "\n"
+    # per-shard heat history for the sparklines: every record, oldest
+    # first (the ledger is append-only per replica; cross-replica order
+    # by ts is close enough for a trend line)
+    series = {}
+    for _fname, rec, _status in _iter_heat_records(store_root):
+        if rec is None or rec.get("kind") != "heat":
+            continue
+        if rec.get("shard") is None:
+            continue
+        series.setdefault(str(int(rec["shard"])), []).append(
+            (float(rec.get("ts") or 0.0), float(rec.get("heat_ms") or 0)))
+    heats = {k: v["heat_ms"] for k, v in shards.items()}
+    hot = max(heats.values()) or 1.0
+    w = max(len(k) for k in shards) + 5
+    out.append(f"  {'shard':<{w}} {'heat':>8}  {'share':<12}  "
+               f"{'owner':<20}  trend")
+    for k in sorted(shards, key=lambda s: -heats[s]):
+        s = shards[k]
+        hist = [h for _, h in sorted(series.get(k, []))]
+        out.append(
+            f"  shard{k:<{w - 5}} {heats[k] / 1e3:>7.1f}s  "
+            f"[{_bar(heats[k] / hot, 10)}]  "
+            f"{str(s.get('replica') or '?')[:20]:<20}  "
+            f"{_spark(hist, width=width)}")
+    skew = merged["heat_skew"]
+    bound = LOAD_TARGETS["imbalance"]["skew_max"]
+    line = f"  heat skew {skew:.2f}x (max/mean over {len(shards)} shards)"
+    if skew > bound:
+        line += f"  SKEW (over the {bound:.1f}x imbalance bound)"
+    out.append(line)
+    if merged["replicas"]:
+        out.append("")
+        out.append("  replica busy fractions (latest snapshot each):")
+        for rid in sorted(merged["replicas"]):
+            r = merged["replicas"][rid]
+            busy = float(r.get("busy_frac") or 0.0)
+            out.append(f"    {rid[:28]:<28} [{_bar(min(1.0, busy), 12)}] "
+                       f"{busy:.0%}")
+    return "\n".join(out) + "\n"
+
+
 def _profile_section(profile_recs, out):
     """On-demand / stall device captures recorded by obs/profiler.py: the
     pointers from this stream to its device-timeline artifacts."""
@@ -1440,12 +1505,37 @@ def main(argv=None):
                    help="render the bench trajectory store "
                         "(.obs/trajectory.jsonl) as per-key sparkline "
                         "history instead of a run report")
+    p.add_argument("--fleet", metavar="STORE_ROOT", default=None,
+                   help="render the fleet-wide load view from the durable "
+                        "heat ledgers under STORE_ROOT/fleet/heat/: merged "
+                        "per-shard heat with sparklines, replica busy "
+                        "fractions, and a SKEW banner on imbalance")
     p.add_argument("--study", metavar="ID", default=None,
                    help="render one study's audit timeline from the "
                         "service WAL (give the WAL file or the --store "
                         "root; extra obs/flight/access streams join the "
                         "request-correlation view)")
     args = p.parse_args(argv)
+    if args.fleet is not None:
+        if (args.merge or args.postmortem or args.export_trace
+                or args.trend or args.study):
+            print("error: --fleet is its own view; it does not combine "
+                  "with --merge/--postmortem/--export-trace/--trend/"
+                  "--study", file=sys.stderr)
+            return 2
+        if args.format == "json":
+            # erroring beats a scripted consumer silently getting text:
+            # the merged view is already served as JSON by /fleet/load
+            print("error: --fleet renders text only; for machine-"
+                  "readable heat GET /fleet/load or read the ledgers "
+                  "under fleet/heat/", file=sys.stderr)
+            return 2
+        if not os.path.isdir(args.fleet):
+            print(f"error: no store root at {args.fleet}",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_fleet_load(args.fleet))
+        return 0
     if args.study is not None:
         if args.merge or args.postmortem or args.export_trace or args.trend:
             print("error: --study is its own view; it does not combine "

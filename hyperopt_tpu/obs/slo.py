@@ -63,7 +63,7 @@ import time
 from collections import deque
 
 __all__ = ["SLOPlane", "Objective", "DEFAULT_TARGETS", "QUALITY_TARGETS",
-           "WINDOWS", "FAST_BURN", "SLOW_BURN"]
+           "LOAD_TARGETS", "WINDOWS", "FAST_BURN", "SLOW_BURN"]
 
 logger = logging.getLogger(__name__)
 
@@ -104,6 +104,15 @@ DEFAULT_TARGETS = {
 #: plane is armed, so the server installs it separately.
 QUALITY_TARGETS = {
     "stagnation": {"target": 0.90},
+}
+
+#: the fleet-imbalance objective (ISSUE 17): an observation is GOOD
+#: when the heat-skew scalar (max/mean shard heat) sits at or under
+#: ``skew_max``.  ``skew_max`` rides the spec dict — ``add_objective``
+#: only reads target/threshold_ms, so the server keeps the bound and
+#: feeds pre-judged booleans via ``record_load``.
+LOAD_TARGETS = {
+    "imbalance": {"target": 0.90, "skew_max": 3.0},
 }
 
 
@@ -272,6 +281,19 @@ class SLOPlane:
             if obj is None:
                 return
             obj.record(not stagnant, now)
+        self._maybe_evaluate(now)
+
+    def record_load(self, balanced, now=None):
+        """Feed one load observation into the ``imbalance`` objective:
+        good = the fleet's heat skew sat within its bound when the load
+        gauges refreshed.  No-op when the objective was never installed
+        (load SLO disarmed)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            obj = self.objectives.get("imbalance")
+            if obj is None:
+                return
+            obj.record(bool(balanced), now)
         self._maybe_evaluate(now)
 
     # -- evaluation --------------------------------------------------------

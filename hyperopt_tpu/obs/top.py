@@ -135,6 +135,37 @@ def snapshot_from_stream(path):
     return _StreamTail(path).snapshot()
 
 
+def discover_fleet(seed_url, timeout=5.0):
+    """Fleet discovery (ISSUE 17): one replica's ``/healthz`` advertises
+    every replica's address (``replica_addrs``, built from the published
+    ownership table), so the whole fleet dashboards from a single seed
+    URL instead of requiring every URL by hand.  Returns the replica
+    base URLs, seed first; a failed discovery degrades to just the
+    seed (a dead seed renders as one dead row, never a dead
+    dashboard)."""
+    import urllib.request
+
+    url = seed_url.rstrip("/")
+    out = [url]
+    try:
+        with urllib.request.urlopen(f"{url}/healthz",
+                                    timeout=timeout) as r:
+            h = json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 - degrade to the seed alone
+        print(f"fleet discovery failed on {url}/healthz: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return out
+    live = set(h.get("replicas") or [])
+    addrs = h.get("replica_addrs") or {}
+    for rid in sorted(addrs):
+        if live and rid not in live:
+            continue  # departed replica still in the ownership table
+        a = str(addrs[rid]).rstrip("/")
+        if a and a not in out:
+            out.append(a)
+    return out
+
+
 def _expand_sources(args_sources):
     """URLs pass through; a directory expands to its ``*.jsonl`` streams
     (flight dumps excluded)."""
@@ -253,6 +284,19 @@ def _render_service_source(name, snap, out, w):
             fline += f"  epochs {epochs[0]}" + (
                 f"-{epochs[-1]}" if len(epochs) > 1 else "")
         fline += f"  replicas {len(fleet.get('replicas') or [])}"
+        # held-shard heat summary (ISSUE 17): cumulative device heat
+        # across held shards + the replica's busy duty cycle, with the
+        # hottest held shard called out
+        fl_load = fleet.get("load") or {}
+        if fl_load.get("heat_ms") is not None:
+            fline += (f"  heat {float(fl_load['heat_ms']) / 1e3:.1f}s"
+                      f"  busy {float(fl_load.get('busy_frac') or 0):.0%}")
+            hot = max(((k, s) for k, s in shards.items()
+                       if s.get("heat_ms") is not None),
+                      key=lambda kv: kv[1]["heat_ms"], default=None)
+            if hot is not None:
+                fline += (f"  hot shard{hot[0]} "
+                          f"{float(hot[1]['heat_ms']) / 1e3:.1f}s")
         if fleet.get("adoptions") or fleet.get("handoffs"):
             fline += (f"  adopt {fleet.get('adoptions', 0)}"
                       f"  handoff {fleet.get('handoffs', 0)}")
@@ -452,9 +496,13 @@ def main(argv=None):
         prog="python -m hyperopt_tpu.obs.top",
         description="Live terminal dashboard over scrape server URLs or "
                     "recorded JSONL streams.")
-    p.add_argument("sources", nargs="+",
+    p.add_argument("sources", nargs="*",
                    help="scrape server URL(s) (http://host:port), JSONL "
                         "stream(s), or a run directory")
+    p.add_argument("--fleet", metavar="SEED_URL", default=None,
+                   help="discover every fleet replica's URL from this "
+                        "seed replica's /healthz (replica_addrs) and "
+                        "dashboard them all")
     p.add_argument("--interval", type=float, default=2.0,
                    help="refresh period in seconds (default 2)")
     p.add_argument("--once", action="store_true",
@@ -463,9 +511,14 @@ def main(argv=None):
                    help="exit after N frames (default: until Ctrl-C)")
     args = p.parse_args(argv)
 
-    sources = _expand_sources(args.sources)
+    srcs = list(args.sources)
+    if args.fleet:
+        srcs.extend(u for u in discover_fleet(args.fleet)
+                    if u not in srcs)
+    sources = _expand_sources(srcs)
     if not sources:
-        print("error: no sources (empty directory?)", file=sys.stderr)
+        print("error: no sources (empty directory, or no --fleet seed?)",
+              file=sys.stderr)
         return 2
     histories = {}
     tails = {src: _StreamTail(src) for kind, src in sources

@@ -225,6 +225,16 @@ KEY_DIRECTIONS = {
     # pattern: within 5% or the tracker is too hot for the tell path.
     "quality_overhead_frac": {"direction": "lower", "threshold": 0.05,
                               "absolute": True},
+    # armed-vs-disarmed cost-attribution per-wave delta through the
+    # real handle() path (bench.py load_attribution stage) — the same
+    # 5% absolute acceptance bar: attribution must be noise on the
+    # wave, not a tax
+    "attribution_overhead_frac": {"direction": "lower", "threshold": 0.05,
+                                  "absolute": True},
+    # heat skew (max/mean shard heat) of the bench stage's deliberately
+    # skewed placement — lower is better (1.0 = balanced); a regression
+    # means attribution stopped seeing the imbalance it exists to see
+    "shard_heat_skew": {"direction": "lower", "threshold": 0.30},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -253,7 +263,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "solved_frac_tpe", "solved_frac_rand",
                 "solved_frac_anneal", "solved_frac_mix",
                 "solved_frac_atpe",
-                "quality_overhead_frac")
+                "quality_overhead_frac",
+                "attribution_overhead_frac", "shard_heat_skew")
 
 
 def trajectory_path(root=None):

@@ -148,8 +148,18 @@ class FleetReplica:
         self.overload = None  # AdmissionGuard, wired by the HTTP server
 
         self._fleet = os.path.join(self.store_root, FLEET_DIR)
-        for d in ("owners", "replicas", "wal"):
+        for d in ("owners", "replicas", "wal", "heat"):
             os.makedirs(os.path.join(self._fleet, d), exist_ok=True)
+        # durable heat ledger (ISSUE 17): one append-only file per
+        # replica under the SHARED root, so shard heat survives
+        # restarts and adoption inherits it.  The ledger object is
+        # cheap and unconditional; appends only happen for schedulers
+        # whose cost ledger is armed.
+        from ..obs.load import HeatLedger, heat_path_for
+
+        self.heat = HeatLedger(heat_path_for(self.store_root,
+                                             self.replica_id))
+        self._heat_last = 0.0  # monotonic ts of the last periodic roll-up
         self.leases = EpochLeases(
             os.path.join(self._fleet, "shardleases"), owner=self.replica_id,
             lease_ttl=self.lease_ttl, metrics=self.metrics)
@@ -352,6 +362,21 @@ class FleetReplica:
                 except FileNotFoundError:
                     pass
             _fsync_dir(new_path)
+        if sched.load is not None:
+            # cost-attribution identity + inherited heat (ISSUE 17):
+            # the shard's cumulative heat under previous owners comes
+            # from the durable ledger (max over cumulative snapshots),
+            # NOT from replay — replayed tells are never recounted, so
+            # adoption stays bitwise and heat is never doubled.
+            # Fail-open: adoption must never fail on observability.
+            try:
+                from ..obs.load import inherited_heat
+
+                sched.load.bind(shard=shard, replica=self.replica_id)
+                sched.load.inherit(inherited_heat(self.store_root, shard))
+            except Exception:  # noqa: BLE001
+                logger.warning("fleet: heat inheritance for %s failed; "
+                               "adopting cold", name, exc_info=True)
         with self._lock:
             self.schedulers[shard] = sched
             self.epochs[shard] = epoch
@@ -381,6 +406,15 @@ class FleetReplica:
         except Exception:  # noqa: BLE001 - the lease must still be freed
             logger.warning("fleet: drain of %s failed mid-handoff",
                            _shard_name(shard), exc_info=True)
+        # flush the final heat snapshot BEFORE the lease is released so
+        # the next owner's adoption inherits everything this holder
+        # attributed (best-effort: HeatLedger.append absorbs OSError)
+        if sched.load is not None:
+            try:
+                self.heat.append(sched.load.heat_record())
+            except Exception:  # noqa: BLE001
+                logger.warning("fleet: heat flush for %s failed",
+                               _shard_name(shard), exc_info=True)
         self._clear_ownership(shard)
         self.leases.release(_shard_name(shard))
         self.handoffs += 1
@@ -553,6 +587,37 @@ class FleetReplica:
             if not self.leases.heartbeat(name):
                 with self._lock:
                     self._drop_shard(int(name[len("shard"):]))
+        self._roll_heat()
+
+    def _shard_heat(self, sched):
+        """One scheduler's cumulative shard heat in ms (0.0 disarmed —
+        every shard ties, so heat-aware ordering degrades to the old
+        count-only behavior)."""
+        return (0.0 if sched is None or sched.load is None
+                else sched.load.heat_ms)
+
+    def _roll_heat(self, force=False):
+        """Append one cumulative heat snapshot per held armed scheduler
+        to this replica's durable ledger file — the fleet-wide
+        aggregation every other replica's ``/fleet/load`` and
+        ``obs.report --fleet`` read.  Rate-limited to the steward
+        cadence (``force`` bypasses, for drain/handoff flushes);
+        best-effort throughout — heat durability never fails a
+        heartbeat."""
+        now = time.monotonic()
+        if not force and now - self._heat_last < max(1.0, self.poll):
+            return
+        self._heat_last = now
+        with self._lock:
+            scheds = dict(self.schedulers)
+        for shard, sched in scheds.items():
+            if sched.load is None:
+                continue
+            try:
+                self.heat.append(sched.load.heat_record())
+            except Exception:  # noqa: BLE001
+                logger.warning("fleet: heat roll-up for %s failed",
+                               _shard_name(shard), exc_info=True)
 
     def manage_once(self):
         """Reclaim stale leases fleet-wide (adopting what we freed
@@ -591,9 +656,19 @@ class FleetReplica:
                         n_held += 1
         elif n_held > target and len(self.live_replicas()) > 1:
             # volunteer handoff toward an underfull joiner; one shard
-            # per sweep keeps rebalance gradual (no thundering drain)
+            # per sweep keeps rebalance gradual (no thundering drain).
+            # Heat-aware (ISSUE 17): release the HOTTEST held shard
+            # first so a rebalance sheds load, not just count — a pure
+            # ordering change over the same drain-handoff path
+            # (migration stays bitwise).  Disarmed ledgers tie at 0.0
+            # and the shard-number tie-break reproduces the old
+            # highest-shard pick exactly.
             with self._lock:
-                excess = max(self.schedulers, default=None)
+                excess = max(
+                    self.schedulers,
+                    key=lambda k: (self._shard_heat(self.schedulers[k]),
+                                   k),
+                    default=None)
             if excess is not None:
                 self.handoff(excess)
 
@@ -647,6 +722,8 @@ class FleetReplica:
         between restarts and ``obs/top.py``'s FLEET row renders."""
         with self._lock:
             shards = {}
+            heat_ms = busy = 0.0
+            any_load = False
             for shard, sched in self.schedulers.items():
                 j = sched.journal
                 shards[str(shard)] = {
@@ -657,7 +734,15 @@ class FleetReplica:
                         "syncs": j.syncs, "compactions": j.compactions,
                     },
                 }
-        return {
+                if sched.load is not None:
+                    any_load = True
+                    h = sched.load.heat_ms
+                    b = sched.load.busy
+                    heat_ms += h
+                    busy += b
+                    shards[str(shard)]["heat_ms"] = round(h, 3)
+                    shards[str(shard)]["busy_frac"] = round(b, 4)
+        out = {
             "ok": not self._draining,
             "replica": self.replica_id,
             "addr": self.addr,
@@ -674,6 +759,24 @@ class FleetReplica:
             "lease_ttl": self.lease_ttl,
             "ts": time.time(),
         }
+        if any_load:
+            # per-replica held-shard heat summary (ISSUE 17): the sum
+            # of held cumulative heats + the replica's duty cycle —
+            # what obs/top.py's FLEET row and the load smoke read
+            out["load"] = {"heat_ms": round(heat_ms, 3),
+                           "busy_frac": round(busy, 4)}
+        # replica -> advertised addr, from the published ownership
+        # table: the `obs.top --fleet <seed-url>` discovery seam (the
+        # `replicas` list above is ids only)
+        addrs = {}
+        if self.addr:
+            addrs[self.replica_id] = self.addr
+        for shard in range(self.n_shards):
+            rec = self.read_owner(shard)
+            if rec and rec.get("replica") and rec.get("addr"):
+                addrs.setdefault(str(rec["replica"]), rec["addr"])
+        out["replica_addrs"] = addrs
+        return out
 
     def studies_status(self):
         """The fleet replica's ``GET /studies`` body: every held

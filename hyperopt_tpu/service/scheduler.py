@@ -724,9 +724,9 @@ class StudyScheduler:
     def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
                  store_root=None, wave_window=0.0, wal=None, degrade=None,
                  overload=None, auto_resume=True, compile_plane=None,
-                 widen=None, quality=None):
+                 widen=None, quality=None, load=None):
         from .._env import (parse_compile_plane, parse_compile_widen,
-                            parse_quality, parse_service_degrade,
+                            parse_load, parse_quality, parse_service_degrade,
                             parse_service_idle_sec,
                             parse_service_max_pending,
                             parse_service_max_studies,
@@ -847,6 +847,23 @@ class StudyScheduler:
             self.quality = None
         else:
             self.quality = quality
+
+        # load & cost attribution ledger (ISSUE 17): None resolves
+        # HYPEROPT_TPU_LOAD (default ON — pure wave-time arithmetic,
+        # zero threads, never feeds proposals), False disarms (the wave
+        # path pays one `is None` check and nothing else), an instance
+        # arms explicitly.  Replayed tells are NOT recounted — adopted
+        # heat arrives through the durable heat ledger (CostLedger
+        # .inherit), so replay stays bitwise and heat is never doubled.
+        if load is None:
+            from ..obs.load import CostLedger
+
+            self.load = (CostLedger(metrics=self.metrics)
+                         if parse_load() else None)
+        elif load is False:
+            self.load = None
+        else:
+            self.load = load
 
         self.last_resume = None  # stats dict of the latest WAL replay
         if auto_resume and self.journal is not None:
@@ -1250,9 +1267,15 @@ class StudyScheduler:
         st.trials.refresh()
 
     def _answers(self, st, docs, algo="tpe", degraded=False,
-                 warming=False):
+                 warming=False, wave=None):
         out = [{"study_id": st.study_id, "tid": d["tid"],
                 "params": spec_from_misc(d["misc"])} for d in docs]
+        if wave is not None:
+            # the wave sequence that served this ask — response
+            # metadata only (the HTTP layer lifts it into the access
+            # log's `wave` field); proposals never depend on it
+            for a in out:
+                a["wave"] = int(wave)
         if degraded:
             # flag degraded service in-band: the client learns its
             # proposal came from the ladder (possibly plain random
@@ -1485,6 +1508,33 @@ class StudyScheduler:
             except Exception as e:  # noqa: BLE001
                 r.error = e
 
+    def _charge_wave(self, cohort, cohort_reqs, device_sec):
+        """Feed one cohort tick to the cost ledger (ISSUE 17): the
+        measured dispatch+readback seconds, attributed across the
+        tick's studies by their K-row share.  Armed path only (callers
+        guard on ``self.load is not None``); a ledger fault is absorbed
+        — cost accounting must never fail a wave — and the ledger never
+        touches the reqs' docs/seeds, so armed proposals stay
+        bit-identical to disarmed (the standing obs invariant)."""
+        try:
+            entries = [(r.study.study_id, len(r.new_ids))
+                       for r in cohort_reqs]
+            n_ask = 0
+            for _, k in entries:
+                n_ask += k
+            cand = float(n_ask * cohort.cfg.get("n_EI_candidates", 24))
+            # cohort history footprint the tick streamed: per label an
+            # f32 vals plane + a bool active plane, plus the f32 losses
+            # + bool has_loss planes — all [n_slots, cap]
+            hbm = float(cohort.n_slots * cohort.cap
+                        * (len(cohort.cs.labels) * 5 + 5))
+            self.load.observe_tick(entries, device_sec, cand=cand,
+                                   hbm_bytes=hbm,
+                                   cohort=f"cap{cohort.cap}")
+        except Exception as e:  # noqa: BLE001
+            logging.getLogger(__name__).warning(
+                "load observe_tick failed: %s", e)
+
     def _retry_cohort_down_ladder(self, cohort, cohort_reqs, mesh, exc):
         """A cohort tick device-faulted: walk the ladder down and retry
         synchronously until the cohort serves (the rand floor always
@@ -1596,6 +1646,12 @@ class StudyScheduler:
                     if n_dev > 1 and cohort.n_slots % n_dev == 0:
                         mesh = m
                 spec = self._ladder_spec()
+                # cost attribution (ISSUE 17): measured dispatch +
+                # readback seconds per cohort tick.  Disarmed pays one
+                # `is None` check and allocates nothing (0.0 is a code
+                # constant; the dispatched tuple exists either way).
+                t_c = (time.perf_counter() if self.load is not None
+                       else 0.0)
                 try:
                     packed = self._dispatch_cohort(
                         cohort, cohort_reqs, mesh, spec)
@@ -1603,20 +1659,37 @@ class StudyScheduler:
                     wave_faults += self._retry_cohort_down_ladder(
                         cohort, cohort_reqs, mesh, e)
                     served_any = True
+                    if self.load is not None:
+                        self._charge_wave(cohort, cohort_reqs,
+                                          time.perf_counter() - t_c)
                     continue
                 if packed is None:  # ladder floor: host-side service
                     self._serve_cohort_host_side(cohort_reqs)
                     served_any = True
+                    if self.load is not None:
+                        # host-side service spends no device time; the
+                        # charge still counts the asks/waves so /studies
+                        # cost columns cover rand-floor studies too
+                        self._charge_wave(cohort, cohort_reqs, 0.0)
                     continue
-                dispatched.append((cohort, cohort_reqs, mesh, packed))
+                dt_disp = (time.perf_counter() - t_c
+                           if self.load is not None else 0.0)
+                dispatched.append((cohort, cohort_reqs, mesh, packed,
+                                   dt_disp))
             # readback phase: block per cohort, build and land the docs
-            for cohort, cohort_reqs, mesh, packed in dispatched:
+            for cohort, cohort_reqs, mesh, packed, dt_disp in dispatched:
                 served_any = True
+                t_c = (time.perf_counter() if self.load is not None
+                       else 0.0)
                 try:
                     self._readback_cohort(cohort, cohort_reqs, packed)
                 except Exception as e:  # noqa: BLE001 - runtime XLA error
                     wave_faults += self._retry_cohort_down_ladder(
                         cohort, cohort_reqs, mesh, e)
+                if self.load is not None:
+                    self._charge_wave(
+                        cohort, cohort_reqs,
+                        dt_disp + (time.perf_counter() - t_c))
             reqs = leftover
         if self.journal is not None:
             try:
@@ -1745,7 +1818,8 @@ class StudyScheduler:
         self.metrics.histogram("service.ask_sec").observe(
             time.perf_counter() - t0)
         return self._answers(req.study, req.docs, algo=req.algo,
-                             degraded=req.degraded, warming=req.warming)
+                             degraded=req.degraded, warming=req.warming,
+                             wave=req.wave)
 
     def ask_many(self, requests):
         """Explicit wave: ``[(study_id, n), ...]`` asked in ONE batched
@@ -1786,7 +1860,7 @@ class StudyScheduler:
                     out.setdefault(r.study.study_id, []).extend(
                         self._answers(r.study, r.docs, algo=r.algo,
                                       degraded=r.degraded,
-                                      warming=r.warming))
+                                      warming=r.warming, wave=r.wave))
             if failed:
                 if not out:
                     raise failed[0].error
@@ -1890,6 +1964,15 @@ class StudyScheduler:
             except Exception as e:  # noqa: BLE001 - never fail a tell
                 logging.getLogger(__name__).warning(
                     "quality observe_tell failed: %s", e)
+        if self.load is not None and not replay:
+            # replayed tells are never recounted: adopted heat arrives
+            # through the durable heat ledger (CostLedger.inherit), so
+            # migration replay stays bitwise and heat is never doubled
+            try:
+                self.load.observe_tell(st.study_id)
+            except Exception as e:  # noqa: BLE001 - never fail a tell
+                logging.getLogger(__name__).warning(
+                    "load observe_tell failed: %s", e)
         if (st.max_trials is not None
                 and st.n_trials >= st.max_trials and st.n_pending == 0):
             st.state = "done"
@@ -2385,6 +2468,11 @@ class StudyScheduler:
                     q = self.quality.study_status(s.get("study_id"))
                     if q is not None:
                         s["quality"] = q
+            if self.load is not None:
+                for s in studies:
+                    c = self.load.study_status(s.get("study_id"))
+                    if c is not None:
+                        s["load"] = c
             for sid, info in sorted(self._quarantined.items()):
                 if sid not in self._studies:
                     # quarantined before its admit record could replay:

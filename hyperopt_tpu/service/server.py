@@ -131,8 +131,8 @@ class ServiceHTTPServer:
     def __init__(self, port, scheduler=None, host=None, store_root=None,
                  guard=None, trace=None, slo=None, access_log=None,
                  fleet=None):
-        from .._env import (parse_quality_slo, parse_reqtrace,
-                            parse_service_access_log,
+        from .._env import (parse_load_slo, parse_quality_slo,
+                            parse_reqtrace, parse_service_access_log,
                             parse_service_deadline_ms, parse_service_slo)
         from ..obs.metrics import get_metrics
 
@@ -204,6 +204,30 @@ class ServiceHTTPServer:
                     self.slo.add_objective(name, spec)
                 for plane in self._quality_planes():
                     plane.slo = self.slo
+        # fleet-imbalance SLO (ISSUE 17): when BOTH the burn-rate
+        # plane and a scheduler-side cost ledger are armed, install the
+        # `imbalance` objective.  The skew bound rides the spec dict
+        # (add_objective ignores unknown keys); the server keeps it and
+        # feeds one pre-judged good/bad event per load-gauge refresh
+        self.load_skew_max = None
+        if self.slo is not None:
+            from .._env import parse_load
+
+            l_targets = parse_load_slo()
+            # fleet replicas adopt shards AFTER server construction, so
+            # "a cost ledger is armed" must be judged from the kwargs
+            # future schedulers will be built with, not the (empty)
+            # current plane list
+            armed = bool(self._load_planes()) or (
+                self.fleet is not None
+                and self.fleet.scheduler_kwargs.get("load") is not False
+                and (self.fleet.scheduler_kwargs.get("load") is not None
+                     or parse_load()))
+            if l_targets is not None and armed:
+                for name, spec in l_targets.items():
+                    self.slo.add_objective(name, spec)
+                self.load_skew_max = l_targets.get(
+                    "imbalance", {}).get("skew_max")
         # opt-in structured access log (JSONL; one record per request)
         log_path = (parse_service_access_log() if access_log is None
                     else (access_log or None))
@@ -300,6 +324,11 @@ class ServiceHTTPServer:
                     rec["degraded"] = True
                 if payload.get("study_id"):
                     rec["study_id"] = payload["study_id"]
+                if payload.get("wave") is not None:
+                    # the wave sequence that served this ask — joins an
+                    # access record to the cohort tick (and its cost
+                    # attribution) that produced the response
+                    rec["wave"] = payload["wave"]
             self.access_log.write(rec)
             # the flight-ring tap: the last requests ride into every
             # postmortem dump next to the spans that served them
@@ -354,9 +383,9 @@ class ServiceHTTPServer:
         rest pooled (an attacker probing random paths must not mint
         unbounded metric families)."""
         known = ("/study", "/ask", "/tell", "/close", "/studies",
-                 "/metrics", "/snapshot", "/healthz", "/")
+                 "/metrics", "/snapshot", "/healthz", "/fleet/load", "/")
         if path in known:
-            return path.strip("/") or "root"
+            return path.strip("/").replace("/", "_") or "root"
         if _timeline_study_id(path) is not None:
             return "timeline"
         return "other"
@@ -431,6 +460,8 @@ class ServiceHTTPServer:
                     return 200, self.healthz_dict()
                 if path == "/snapshot":
                     return 200, self.snapshot_dict()
+                if path == "/fleet/load":
+                    return 200, self.fleet_load_dict()
                 sid = _timeline_study_id(path)
                 if sid is not None:
                     return 200, self._route(sid).study_timeline(sid)
@@ -442,7 +473,8 @@ class ServiceHTTPServer:
                                       "GET /studies",
                                       "GET /study/<id>/timeline",
                                       "GET /healthz",
-                                      "GET /metrics", "GET /snapshot"]}
+                                      "GET /metrics", "GET /snapshot",
+                                      "GET /fleet/load"]}
                 raise _RequestError(404, f"no such endpoint: {path}")
             if method != "POST":
                 raise _RequestError(405, f"{method} not supported")
@@ -474,6 +506,13 @@ class ServiceHTTPServer:
                                     "warming")
                                    if k in t}
                                   for t in trials]}
+                wave = next((t.get("wave") for t in trials
+                             if t.get("wave") is not None), None)
+                if wave is not None:
+                    # response metadata: the wave sequence that served
+                    # this ask (the access log's correlation key to the
+                    # tick's cost attribution); trials stay wave-free
+                    out["wave"] = wave
                 if any(t.get("degraded") for t in trials):
                     out["degraded"] = True
                 if any(t.get("warming") for t in trials):
@@ -649,6 +688,77 @@ class ServiceHTTPServer:
         except Exception:  # noqa: BLE001 - fail-open scrape
             return None
 
+    def _load_planes(self):
+        """Every armed cost ledger this server fronts: one per adopted
+        shard scheduler in fleet mode, the scheduler's own otherwise."""
+        if self.fleet is not None:
+            return [s.load for s in self.fleet.schedulers.values()
+                    if s.load is not None]
+        if self.scheduler is not None and self.scheduler.load is not None:
+            return [self.scheduler.load]
+        return []
+
+    def _refresh_load_gauges(self):
+        """Scrape/snapshot-time ``service.load.*`` gauge refresh
+        (ISSUE 17): each plane publishes its per-shard gauges, the
+        merged view sets the replica-level family — totals, busy
+        fraction and the heat-skew scalar — and feeds one good/bad
+        event into the ``imbalance`` SLO objective.  Returns the merged
+        status section for ``/snapshot``, or None when disarmed."""
+        from ..obs.load import merge_status
+
+        try:
+            merged = merge_status([p.publish()
+                                   for p in self._load_planes()])
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            return None
+        if merged is None:
+            return None
+        try:
+            g = self.metrics.gauge
+            g("service.load.device_ms").set(merged["device_ms"])
+            g("service.load.heat_ms").set(merged["heat_ms"])
+            g("service.load.busy_frac").set(merged["busy_frac"])
+            g("service.load.heat_skew").set(merged["heat_skew"])
+            g("service.load.studies").set(merged["studies"])
+            if self.slo is not None and self.load_skew_max:
+                self.slo.record_load(
+                    merged["heat_skew"] <= self.load_skew_max)
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            pass
+        return merged
+
+    def fleet_load_dict(self):
+        """``GET /fleet/load``: this replica's merged cost-attribution
+        view plus the FLEET-WIDE heat table read from every replica's
+        durable ledger under the shared store root — per-shard
+        cumulative heat (max over cumulative snapshots, so it survives
+        restarts and ownership moves), per-replica latest snapshot, and
+        the heat-skew scalar.  Works single-server too (no `fleet`
+        section without a store root)."""
+        out = {"ok": True, "ts": time.time(), "endpoint": "fleet_load"}
+        merged = self._refresh_load_gauges()
+        if merged is not None:
+            out["local"] = merged
+        if self.fleet is not None:
+            from ..obs.load import read_heat
+
+            out["replica"] = self.fleet.replica_id
+            try:
+                out["fleet"] = read_heat(self.fleet.store_root)
+            except Exception:  # noqa: BLE001 - fail-open read
+                logger.warning("fleet/load: heat-ledger read failed",
+                               exc_info=True)
+        elif self.scheduler is not None \
+                and self.scheduler.store_root is not None:
+            from ..obs.load import read_heat
+
+            try:
+                out["fleet"] = read_heat(self.scheduler.store_root)
+            except Exception:  # noqa: BLE001 - fail-open read
+                pass
+        return out
+
     def _refresh_compile_gauges(self):
         """Publish the compile-visibility gauges (ISSUE 14 satellite):
         the cohort-program LRU and the single-study jit LRU counters as
@@ -677,6 +787,9 @@ class ServiceHTTPServer:
         qual = self._refresh_quality_gauges()
         if qual is not None:
             out["quality"] = qual
+        load = self._refresh_load_gauges()
+        if load is not None:
+            out["load"] = load
         self._refresh_compile_gauges()
         out["sections"] = {
             "service": self.metrics.snapshot()["metrics"]}
@@ -836,6 +949,7 @@ def _make_handler(server):
                     except Exception:  # noqa: BLE001 - fail-open scrape
                         pass
                     server._refresh_quality_gauges()
+                    server._refresh_load_gauges()
                     server._refresh_store_gauges()
                     server._count_response(method, path, 200)
                     self._answer(

@@ -82,6 +82,15 @@
 # on /studies with a stagnation event on its timeline, and /metrics
 # must lint with the quality_* gauge families — then bench_gate
 # --explain prints the windowed per-metric verdicts.
+# Opt-in load gate: LOAD_GATE=1 additionally re-runs the cost-
+# attribution suites and then scripts/load_smoke.py — a real
+# 3-subprocess-replica fleet with a ~10:1 skewed study placement:
+# /fleet/load must serve the merged heat table on every replica with
+# the hot shard hottest and the skew gauge reflecting the imbalance,
+# /metrics must lint with the service_load_* gauge families, the
+# heat-aware volunteer handoff must drain the hottest shard first,
+# the durable heat ledger must replay after a SIGKILL (the adopter
+# inherits the shard's heat), and zero tells may be lost throughout.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -164,5 +173,11 @@ if [ "${QUALITY_GATE:-0}" = "1" ]; then
         -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/quality_smoke.py || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_gate.py --explain || exit 1
+fi
+if [ "${LOAD_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_load.py tests/test_service_fleet.py \
+        -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/load_smoke.py || exit 1
 fi
 exit 0

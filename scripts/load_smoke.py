@@ -1,0 +1,454 @@
+"""LOAD_GATE end-to-end smoke (ISSUE 17): the cost-attribution
+observatory over a REAL 3-replica serving fleet on one shared store
+root, with a deliberately skewed (~10:1) study placement.
+
+What it pins (the fleet-wide aggregation contract no unit test can):
+
+* phase 1 — **skew is visible on every surface**: ~10 studies homed on
+  one hot shard vs one study on each other shard, all driven past
+  startup so real device waves burn heat.  Then: ``GET /fleet/load``
+  on EVERY replica returns the merged fleet heat table with the hot
+  shard hottest and ``heat_skew`` well above balanced; the
+  ``service.load.*`` gauge family (per-shard heat, busy fraction, the
+  skew scalar) appears on ``/metrics`` and the scrape LINTS clean
+  (``validate_scrape.validate_metrics_text``); ``/snapshot`` carries
+  the load section; ``/studies`` rows carry the per-study cost column;
+  a raw ``/ask`` answer carries the ``wave`` correlation field; and
+  zero tells are lost (every study ends with exactly its budget told,
+  none pending).
+
+* phase 2 — **heat follows the shard through BOTH migration paths**:
+  a third replica joins an overfull two-replica fleet and the
+  volunteer handoff releases the HOTTEST held shard first (the
+  ISSUE-17 ordering change — pre-PR the highest shard number went);
+  the adopter's ``/healthz`` shows the shard arriving with its
+  accumulated heat (graceful-handoff inheritance).  Then the current
+  owner is SIGKILLed mid-serving: survivors reclaim the lease, replay
+  the durable heat ledger, and the shard is STILL hot on its new
+  owner — plus the driven study keeps accepting asks/tells across the
+  kill with zero lost tells.
+
+Opt in via ``LOAD_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+from fleet_restart import wait_coverage  # noqa: E402
+
+LEASE_TTL = 2.0
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HYPEROPT_TPU_CHAOS", None)
+    env.pop("HYPEROPT_TPU_LOAD", None)   # default ON is the pin
+    return env
+
+
+def _launch(store, rid, n_shards, port="0"):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--announce", "--port", str(port), "--store", store,
+         "--fleet", "--fleet-shards", str(n_shards),
+         "--lease-ttl", str(LEASE_TTL), "--replica-id", rid],
+        cwd=_REPO, env=_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 180
+    url = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SERVICE_URL "):
+            url = line.split(None, 1)[1].strip()
+            break
+        if proc.poll() is not None:
+            break
+    return proc, url
+
+
+def _fetch(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        body = r.read()
+    if path == "/metrics":
+        return body.decode()
+    return json.loads(body)
+
+
+def _client(urls, key=0):
+    from hyperopt_tpu.retry import RetryPolicy
+    from hyperopt_tpu.service import ServiceClient
+
+    return ServiceClient(list(urls), key=key, timeout=60,
+                         retry=RetryPolicy(max_retries=80, base_delay=0.2,
+                                           max_delay=2.0))
+
+
+def _drive(client, sid, n):
+    for _ in range(n):
+        t = client.ask(sid)[0]
+        client.tell(sid, t["tid"], loss=float(t["params"]["x"] ** 2))
+
+
+def _study_rows(urls):
+    rows = {}
+    for url in urls:
+        try:
+            table = _fetch(url, "/studies")
+        except Exception:  # noqa: BLE001 - dead replicas are expected
+            continue
+        for s in table.get("studies", []):
+            rows[s["study_id"]] = s
+    return rows
+
+
+def _held(url):
+    return set((_fetch(url, "/healthz") or {}).get("shards_held", []))
+
+
+SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+def phase1_skew_surfaces():
+    from hyperopt_tpu.service import shard_of
+
+    n_shards = 6
+    print("load_smoke: phase 1 — 3 replicas, ~10:1 skewed placement; "
+          "skew visible and linting on every surface")
+    from validate_scrape import validate_metrics_text
+
+    with tempfile.TemporaryDirectory() as store:
+        procs, urls = [], []
+        try:
+            for i in range(3):
+                proc, url = _launch(store, f"r{i}", n_shards)
+                if url is None:
+                    print(f"phase1: FAIL — replica r{i} never announced",
+                          file=sys.stderr)
+                    return 1
+                procs.append(proc)
+                urls.append(url)
+            if not wait_coverage(urls, timeout=60):
+                print("phase1: FAIL — fleet never covered the keyspace",
+                      file=sys.stderr)
+                return 1
+            client = _client(urls)
+            # mint the skewed placement: ~10 studies on one hot shard,
+            # one study on each of two cold shards (the ids hash to
+            # shards, so keep minting until the census is met; every
+            # extra mint is torn down by max_trials=0 asks never sent)
+            hot = None
+            hot_sids, cold_sids = [], {}
+            for seed in range(200):
+                sid = client.create_study(space=SPEC, seed=1000 + seed,
+                                          n_startup_jobs=2, max_trials=8)
+                shard = shard_of(sid, n_shards)
+                if hot is None:
+                    hot = shard
+                if shard == hot and len(hot_sids) < 10:
+                    hot_sids.append(sid)
+                elif shard != hot and shard not in cold_sids:
+                    cold_sids[shard] = sid
+                if len(hot_sids) == 10 and len(cold_sids) >= 2:
+                    break
+            else:
+                print("phase1: FAIL — could not mint the skewed census",
+                      file=sys.stderr)
+                return 1
+            print(f"phase1: placement skew {len(hot_sids)}:1 — "
+                  f"{len(hot_sids)} studies on shard {hot}, 1 on each of "
+                  f"{sorted(cold_sids)}")
+            # budget 4 with startup 2: the last two asks are REAL device
+            # waves — the hot shard burns ~10x the cohort ticks
+            for sid in hot_sids:
+                _drive(client, sid, 4)
+            for sid in cold_sids.values():
+                _drive(client, sid, 4)
+            time.sleep(2.5)               # > the 1s heat-roll cadence
+
+            # every replica serves the merged fleet view
+            for url in urls:
+                fl = _fetch(url, "/fleet/load")
+                if not fl.get("ok") or "fleet" not in fl:
+                    print(f"phase1: FAIL — {url}/fleet/load missing the "
+                          f"fleet section: {fl}", file=sys.stderr)
+                    return 1
+            fl = _fetch(urls[0], "/fleet/load")["fleet"]
+            if not fl["shards"]:
+                print("phase1: FAIL — no heat records in the fleet view",
+                      file=sys.stderr)
+                return 1
+            hottest = max(fl["shards"], key=lambda k:
+                          fl["shards"][k]["heat_ms"])
+            if hottest != str(hot):
+                print(f"phase1: FAIL — hottest shard {hottest}, want "
+                      f"{hot}: {fl['shards']}", file=sys.stderr)
+                return 1
+            if fl["heat_skew"] < 2.0:
+                print(f"phase1: FAIL — fleet heat_skew "
+                      f"{fl['heat_skew']} does not reflect the ~10:1 "
+                      "placement", file=sys.stderr)
+                return 1
+            if fl["corrupt"]:
+                print(f"phase1: FAIL — {fl['corrupt']} corrupt ledger "
+                      "records on a clean run", file=sys.stderr)
+                return 1
+
+            # the gauge family lints on the owner's scrape
+            owner = next(u for u in urls if hot in _held(u))
+            text = _fetch(owner, "/metrics")
+            errors = validate_metrics_text(text)
+            if errors:
+                print("phase1: FAIL — /metrics lint errors:",
+                      file=sys.stderr)
+                for e in errors[:10]:
+                    print("  " + e, file=sys.stderr)
+                return 1
+            for needle in ("service_load_heat_skew",
+                           "service_load_busy_frac",
+                           f"service_load_shard_{hot}_heat_ms"):
+                if needle not in text:
+                    print(f"phase1: FAIL — gauge {needle} missing from "
+                          "the owner's scrape", file=sys.stderr)
+                    return 1
+            snap = _fetch(owner, "/snapshot")
+            if "load" not in snap or snap["load"]["heat_skew"] < 1.0:
+                print("phase1: FAIL — /snapshot missing the load "
+                      "section", file=sys.stderr)
+                return 1
+            hz = _fetch(owner, "/healthz")
+            if "load" not in hz \
+                    or "heat_ms" not in hz["shards"][str(hot)]:
+                print("phase1: FAIL — /healthz missing the heat "
+                      "columns", file=sys.stderr)
+                return 1
+
+            # per-study cost column + the wave correlation field
+            rows = _study_rows(urls)
+            hot_row = rows.get(hot_sids[0])
+            # `asks` counts device-wave rows only (startup rand asks
+            # never reach the wave chokepoint): budget 4 = 2 startup +
+            # 2 device asks, and all 4 tells
+            if not hot_row or "load" not in hot_row \
+                    or hot_row["load"]["tells"] < 4 \
+                    or hot_row["load"]["device_ms"] <= 0:
+                print(f"phase1: FAIL — /studies row lacks the cost "
+                      f"column: {hot_row}", file=sys.stderr)
+                return 1
+            req = urllib.request.Request(
+                owner + "/ask",
+                data=json.dumps({"study_id": hot_sids[0]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                ans = json.loads(r.read())
+            if ans.get("wave") is None:
+                print(f"phase1: FAIL — /ask answer lacks the wave "
+                      f"field: {sorted(ans)}", file=sys.stderr)
+                return 1
+            client.tell(hot_sids[0], ans["trials"][0]["tid"], loss=1.0)
+
+            # zero lost tells: every driven study holds exactly its
+            # budget, none pending (the extra wave-lint trial included)
+            rows = _study_rows(urls)
+            lost = []
+            for sid in hot_sids + list(cold_sids.values()):
+                want = 5 if sid == hot_sids[0] else 4
+                s = rows.get(sid)
+                if not s or s["n_trials"] != want or s["n_pending"]:
+                    lost.append((sid, s and s["n_trials"],
+                                 s and s["n_pending"]))
+            if lost:
+                print(f"phase1: FAIL — lost tells: {lost}",
+                      file=sys.stderr)
+                return 1
+            print(f"phase1: PASS — skew {fl['heat_skew']}x on "
+                  f"/fleet/load, gauges lint clean, zero lost tells "
+                  f"({len(rows)} studies)")
+            return 0
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def phase2_heat_follows_the_shard():
+    from hyperopt_tpu.service import shard_of
+
+    n_shards = 6
+    print("load_smoke: phase 2 — volunteer handoff drains the hottest "
+          "shard; SIGKILL replays the ledger; zero lost tells")
+    with tempfile.TemporaryDirectory() as store:
+        procs, urls = [], []
+        try:
+            for i in range(2):
+                proc, url = _launch(store, f"q{i}", n_shards)
+                if url is None:
+                    print(f"phase2: FAIL — replica q{i} never announced",
+                          file=sys.stderr)
+                    return 1
+                procs.append(proc)
+                urls.append(url)
+            if not wait_coverage(urls, timeout=60):
+                print("phase2: FAIL — fleet never covered the keyspace",
+                      file=sys.stderr)
+                return 1
+            held0 = _held(urls[0])
+            if len(held0) < 2:
+                print(f"phase2: FAIL — q0 holds {held0}, want ≥2 of "
+                      f"{n_shards}", file=sys.stderr)
+                return 1
+            # home the hot study on one of q0's shards and burn heat
+            client = _client(urls)
+            sid = hot = None
+            for seed in range(200):
+                cand = client.create_study(space=SPEC, seed=2000 + seed,
+                                           n_startup_jobs=2,
+                                           max_trials=30)
+                if shard_of(cand, n_shards) in held0:
+                    sid, hot = cand, shard_of(cand, n_shards)
+                    break
+            if sid is None:
+                print("phase2: FAIL — no study landed on q0",
+                      file=sys.stderr)
+                return 1
+            _drive(client, sid, 12)
+            # the steward may have rebalanced during convergence — pin
+            # the shard's CURRENT owner, then watch that replica
+            owner0 = next((u for u in urls if hot in _held(u)), None)
+            if owner0 is None:
+                print(f"phase2: FAIL — shard {hot} unowned after "
+                      "driving", file=sys.stderr)
+                return 1
+            heat0 = _fetch(owner0, "/healthz")["shards"][
+                str(hot)]["heat_ms"]
+            if heat0 <= 0:
+                print("phase2: FAIL — no heat attributed to the hot "
+                      "shard before the handoff", file=sys.stderr)
+                return 1
+            held0 = _held(owner0)
+
+            # the joiner makes q0 overfull: the volunteer handoff must
+            # release the HOTTEST shard, not the highest-numbered one
+            proc, url = _launch(store, "q2", n_shards)
+            if url is None:
+                print("phase2: FAIL — q2 never announced",
+                      file=sys.stderr)
+                return 1
+            procs.append(proc)
+            urls.append(url)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                now0 = _held(owner0)
+                if len(now0) < len(held0):
+                    break
+                time.sleep(0.25)
+            else:
+                print("phase2: FAIL — the hot owner never volunteered "
+                      "a shard", file=sys.stderr)
+                return 1
+            if hot in now0:
+                print(f"phase2: FAIL — the owner released "
+                      f"{held0 - now0}, but the hottest shard {hot} "
+                      "stayed (heat-aware ordering broken)",
+                      file=sys.stderr)
+                return 1
+            # graceful-handoff inheritance: the adopter shows the shard
+            # arriving with its accumulated heat
+            deadline = time.monotonic() + 60
+            owner = None
+            while time.monotonic() < deadline and owner is None:
+                for u in urls:
+                    if hot in _held(u):
+                        owner = u
+                        break
+                time.sleep(0.25)
+            if owner is None:
+                print(f"phase2: FAIL — shard {hot} never re-adopted",
+                      file=sys.stderr)
+                return 1
+            h = _fetch(owner, "/healthz")["shards"][str(hot)]
+            if h["heat_ms"] < heat0 * 0.99:
+                print(f"phase2: FAIL — adopter heat {h['heat_ms']} < "
+                      f"pre-handoff {heat0}: inheritance lost",
+                      file=sys.stderr)
+                return 1
+            print(f"phase2: handoff drained hottest shard {hot} "
+                  f"(heat {heat0:.0f}ms) and the adopter inherited it")
+
+            # now the SIGKILL path: no drain, no handoff record — the
+            # durable ledger is all that survives
+            _drive(client, sid, 4)
+            time.sleep(2.5)               # let a heat roll land
+            pre = _fetch(owner, "/healthz")["shards"][str(hot)]["heat_ms"]
+            victim = urls.index(owner)
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait()
+            procs[victim] = None
+            live = [u for u, p in zip(urls, procs) if p is not None]
+            deadline = time.monotonic() + 90
+            new_owner = None
+            while time.monotonic() < deadline and new_owner is None:
+                for u in live:
+                    try:
+                        if hot in _held(u):
+                            new_owner = u
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                time.sleep(0.25)
+            if new_owner is None:
+                print(f"phase2: FAIL — shard {hot} never reclaimed "
+                      "after SIGKILL", file=sys.stderr)
+                return 1
+            h2 = _fetch(new_owner, "/healthz")["shards"][str(hot)]
+            if h2["heat_ms"] < heat0 * 0.99:
+                print(f"phase2: FAIL — post-SIGKILL heat "
+                      f"{h2['heat_ms']} < {heat0}: the ledger did not "
+                      "replay", file=sys.stderr)
+                return 1
+            # and serving continues: more trials, zero lost tells
+            client2 = _client(live)
+            _drive(client2, sid, 4)
+            rows = _study_rows(live)
+            s = rows.get(sid)
+            if not s or s["n_trials"] != 20 or s["n_pending"]:
+                print(f"phase2: FAIL — lost tells across the kill: {s}",
+                      file=sys.stderr)
+                return 1
+            print(f"phase2: PASS — heat followed shard {hot} through a "
+                  f"graceful handoff AND a SIGKILL (ledger heat "
+                  f"{h2['heat_ms']:.0f}ms ≥ pre-kill {pre:.0f}ms "
+                  "baseline), 20/20 tells settled")
+            return 0
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def main():
+    for phase in (phase1_skew_surfaces, phase2_heat_follows_the_shard):
+        rc = phase()
+        if rc:
+            return rc
+    print("load_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

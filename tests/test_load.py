@@ -1,0 +1,545 @@
+"""ISSUE 17: the load & cost-attribution observatory.
+
+The acceptance pins:
+
+* K-row share attribution is exact arithmetic: each study in a cohort
+  tick is charged ``k_i / sum(k)`` of the measured device time (and the
+  candidate/HBM estimates), so per-study rows sum to the scheduler
+  totals to the float;
+* armed attribution NEVER changes proposals: armed == disarmed
+  bit-identical, directly and over HTTP — and disarmed really is
+  ``scheduler.load is None``: zero threads, zero allocations traced to
+  the ledger module on the serving path;
+* the durable heat ledger survives SIGKILL (complete lines parse, a
+  torn tail is classified TORN and skipped silently, a bit-flip is
+  CORRUPT and skipped loudly) and migration adoption INHERITS the
+  shard's accumulated heat — a shard doesn't cool off by moving;
+* the steward's volunteer handoff releases the HOTTEST held shard
+  first (pure ordering change; disarmed ties reproduce the old
+  highest-shard pick);
+* the ``imbalance`` SLO objective burns budget on skew breaches, and
+  the new bench keys really gate: ``attribution_overhead_frac``
+  absolute from the first record, ``shard_heat_skew`` windowed
+  lower-is-better.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu._env import parse_load, parse_load_slo
+from hyperopt_tpu.obs.load import (
+    CostLedger,
+    HeatLedger,
+    heat_path_for,
+    heat_skew,
+    inherited_heat,
+    merge_status,
+    read_heat,
+)
+from hyperopt_tpu.obs.slo import LOAD_TARGETS, SLOPlane
+from hyperopt_tpu.service import FleetReplica
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+# ---------------------------------------------------------------------------
+# attribution math: hand-computed K-row shares
+# ---------------------------------------------------------------------------
+
+
+def test_tick_attribution_matches_hand_computed_shares():
+    led = CostLedger()
+    # one 4 ms tick, studies a/b/c asking 2/1/1 rows of the 4
+    led.observe_tick([("a", 2), ("b", 1), ("c", 1)], device_sec=0.004,
+                     cand=96.0, hbm_bytes=400.0, cohort="cap16")
+    a = led.study_status("a")
+    assert a["device_ms"] == pytest.approx(2.0)       # 2/4 of 4 ms
+    assert a["asks"] == 2 and a["waves"] == 1
+    assert a["cand"] == pytest.approx(48.0)           # 2/4 of 96
+    assert a["hbm_bytes"] == pytest.approx(200.0)
+    assert a["cohort"] == "cap16"
+    b = led.study_status("b")
+    assert b["device_ms"] == pytest.approx(1.0)
+    assert b["cand"] == pytest.approx(24.0)
+    # shares sum EXACTLY to the measured tick
+    assert led.device_ms == pytest.approx(4.0)
+    assert led.asks == 4 and led.waves == 1
+    # second tick, only a: its EWMA folds (alpha=0.3 default)
+    led.observe_tick([("a", 1)], device_sec=0.001)
+    a2 = led.study_status("a")
+    assert a2["device_ms"] == pytest.approx(3.0)
+    assert a2["ewma_ms"] == pytest.approx(0.3 * 1.0 + 0.7 * (0.3 * 2.0))
+    # tells ride separately (the tell path has no wave)
+    led.observe_tell("a")
+    led.observe_tell("zz")                            # admits a row
+    assert led.study_status("a")["tells"] == 1
+    assert led.study_status("zz")["asks"] == 0
+    assert led.tells == 2
+    st = led.status()
+    assert st["studies"] == 4
+    assert st["cohorts"]["cap16"]["studies"] == 3
+    assert st["cohorts"]["unticked"]["studies"] == 1  # zz: told, never ticked
+    # zero-K ticks are ignored, forget drops the row
+    led.observe_tick([], device_sec=1.0)
+    assert led.waves == 2
+    led.forget("zz")
+    assert led.study_status("zz") is None
+
+
+def test_heat_inheritance_is_idempotent_max():
+    led = CostLedger()
+    led.observe_tick([("a", 1)], device_sec=0.002)
+    assert led.heat_ms == pytest.approx(2.0)
+    led.inherit(100.0)
+    led.inherit(50.0)        # a smaller re-adoption never shrinks heat
+    led.inherit(100.0)       # nor does a repeat double it
+    assert led.inherited_ms == 100.0
+    assert led.heat_ms == pytest.approx(102.0)
+    rec = led.heat_record()
+    assert rec["kind"] == "heat" and rec["heat_ms"] == pytest.approx(102.0)
+    json.dumps(rec)          # ledger rows must serialize
+
+
+def test_heat_skew_and_merge_status():
+    assert heat_skew([]) == 1.0
+    assert heat_skew([5.0]) == 1.0                    # one shard: balanced
+    assert heat_skew([0.0, 0.0]) == 1.0               # idle fleet: balanced
+    assert heat_skew([9.0, 1.0, 2.0]) == pytest.approx(9.0 / 4.0)
+    assert merge_status([]) is None
+    a, b = CostLedger(), CostLedger()
+    a.bind(shard=0, replica="r")
+    b.bind(shard=1, replica="r")
+    a.observe_tick([("s0", 3)], device_sec=0.009)
+    b.observe_tick([("s1", 1)], device_sec=0.003)
+    b.observe_tell("s1")
+    m = merge_status([a.status(), b.status(), None])
+    assert m["studies"] == 2 and m["asks"] == 4 and m["tells"] == 1
+    assert m["device_ms"] == pytest.approx(12.0)
+    assert m["shards"]["0"]["heat_ms"] == pytest.approx(9.0)
+    assert m["heat_skew"] == pytest.approx(9.0 / 6.0)
+
+
+def test_gauges_publish_only_when_bound():
+    from hyperopt_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    led = CostLedger(metrics=reg)
+    led.observe_tick([("a", 1)], device_sec=0.001)
+    led.publish()                                     # unbound: no gauges
+    assert not any(n.startswith("service.load.shard.")
+                   for n in reg.snapshot()["metrics"])
+    led.bind(shard=3, replica="r")
+    st = led.publish()
+    snap = reg.snapshot()["metrics"]
+    assert snap["service.load.shard.3.heat_ms"] == st["heat_ms"]
+    assert snap["service.load.shard.3.waves"] == 1
+
+
+# ---------------------------------------------------------------------------
+# armed == disarmed: attribution never changes proposals
+# ---------------------------------------------------------------------------
+
+
+def _drive(sched, sid, n):
+    out = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        out.append((a["tid"], repr(a["params"]["x"])))
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+    return out
+
+
+def test_armed_equals_disarmed_bit_identical():
+    on = StudyScheduler(wal=False, quality=False, load=CostLedger())
+    off = StudyScheduler(wal=False, quality=False, load=False)
+    assert on.load is not None and off.load is None
+    sid_on = on.create_study(SPACE, seed=21, n_startup_jobs=2)
+    sid_off = off.create_study(SPACE, seed=21, n_startup_jobs=2)
+    assert _drive(on, sid_on, 8) == _drive(off, sid_off, 8)
+    # the armed run really attributed: device waves happened past startup
+    c = on.load.study_status(sid_on)
+    assert c is not None and c["tells"] == 8
+    assert c["waves"] >= 1 and c["device_ms"] > 0.0
+
+
+def test_armed_equals_disarmed_over_http():
+    def drive(srv, sid, n):
+        seq = []
+        waves = []
+        for _ in range(n):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid})
+            assert code == 200
+            t = a["trials"][0]
+            seq.append((t["tid"], repr(t["params"]["x"])))
+            if a.get("wave") is not None:
+                waves.append(a["wave"])
+                assert "wave" not in t     # top-level field, not a trial key
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": t["tid"],
+                "loss": float((t["params"]["x"] - 1.0) ** 2)})
+            assert code == 200
+        return seq, waves
+
+    seqs = {}
+    for armed in (True, False):
+        sched = StudyScheduler(wal=False, quality=False,
+                               load=CostLedger() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, slo=armed, trace=False)
+        code, r = srv.handle("POST", "/study", {
+            "space": SPACE_SPEC, "seed": 33, "n_startup_jobs": 2})
+        seqs[armed], waves = drive(srv, r["study_id"], 8)
+        # the wave correlation field (access-log satellite) rides both
+        # sides — it comes from the scheduler's wave counter, not the
+        # cost plane
+        assert waves and waves == sorted(waves)
+        if armed:
+            snap = srv.snapshot_dict()
+            assert snap["load"]["studies"] == 1
+            assert snap["load"]["device_ms"] > 0.0
+            assert snap["studies"][0]["load"]["tells"] == 8
+            code, fl = srv.handle("GET", "/fleet/load", None)
+            assert code == 200
+            assert fl["local"]["studies"] == 1
+        else:
+            assert "load" not in srv.snapshot_dict()
+    assert seqs[True] == seqs[False]
+
+
+def test_disarmed_is_none_no_threads_no_ledger_allocations():
+    n0 = threading.active_count()
+    sched = StudyScheduler(wal=False, quality=False, load=False)
+    assert sched.load is None
+    sid = sched.create_study(SPACE, seed=9, n_startup_jobs=2)
+    _drive(sched, sid, 3)                  # compile outside the trace
+    load_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "hyperopt_tpu", "obs", "load.py")
+    tracemalloc.start()
+    try:
+        _drive(sched, sid, 3)              # device waves, disarmed
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, load_py)]).statistics("filename")
+    assert stats == []                     # zero allocations from the ledger
+    # and the armed plane spawns no threads either
+    CostLedger().observe_tick([("a", 1)], device_sec=0.001)
+    assert threading.active_count() == n0
+
+
+def test_load_fault_never_fails_the_wave_or_tell():
+    sched = StudyScheduler(wal=False, quality=False, load=CostLedger())
+
+    def boom(*a, **kw):
+        raise RuntimeError("ledger exploded")
+
+    sched.load.observe_tick = boom
+    sched.load.observe_tell = boom
+    sid = sched.create_study(SPACE, seed=2, n_startup_jobs=1)
+    seq = _drive(sched, sid, 3)            # asks past startup: device waves
+    assert len(seq) == 3
+    assert sched._studies[sid].best_loss() is not None
+
+
+# ---------------------------------------------------------------------------
+# the durable heat ledger: SIGKILL survival, classification, inheritance
+# ---------------------------------------------------------------------------
+
+
+def test_heat_ledger_roundtrip_and_corruption_classification(tmp_path):
+    root = str(tmp_path)
+    led = HeatLedger(heat_path_for(root, "rep-a"))
+    for i, h in enumerate((10.0, 25.0, 40.0)):
+        led.append({"kind": "heat", "replica": "rep-a", "shard": 0,
+                    "heat_ms": h, "busy_frac": 0.5, "ts": 100.0 + i})
+    HeatLedger(heat_path_for(root, "rep-b")).append(
+        {"kind": "heat", "replica": "rep-b", "shard": 1,
+         "heat_ms": 5.0, "busy_frac": 0.1, "ts": 200.0})
+    m = read_heat(root)
+    assert m["files"] == 2 and m["corrupt"] == 0 and m["torn"] == 0
+    # cumulative snapshots: merged heat is the MAX, not the sum
+    assert m["shards"]["0"]["heat_ms"] == 40.0
+    assert m["shards"]["1"]["heat_ms"] == 5.0
+    assert m["replicas"]["rep-a"]["busy_frac"] == 0.5
+    assert m["heat_skew"] == pytest.approx(40.0 / 22.5, abs=1e-3)
+    assert inherited_heat(root, 0) == 40.0
+    assert inherited_heat(root, 7) == 0.0             # never-heated shard
+
+    # bit-flip a sealed mid-file record → CORRUPT, skipped, others kept
+    pa = heat_path_for(root, "rep-a")
+    lines = open(pa, "rb").read().splitlines(keepends=True)
+    lines[2] = lines[2].replace(b"40.0", b"41.0", 1)  # breaks the CRC
+    open(pa, "wb").write(b"".join(lines))
+    # and a torn final line (the SIGKILL-mid-write artifact) → TORN
+    with open(pa, "ab") as f:
+        f.write(b'{"kind": "heat", "sha')
+    m = read_heat(root)
+    assert m["corrupt"] == 1 and m["torn"] == 1
+    assert m["shards"]["0"]["heat_ms"] == 25.0        # the corrupt max lost
+    assert inherited_heat(root, 0) == 25.0
+
+
+def test_heat_ledger_survives_sigkill(tmp_path):
+    root = str(tmp_path)
+    child = (
+        "import sys\n"
+        "from hyperopt_tpu.obs.load import HeatLedger, heat_path_for\n"
+        "led = HeatLedger(heat_path_for(sys.argv[1], 'victim'))\n"
+        "i = 0\n"
+        "while True:\n"
+        "    i += 1\n"
+        "    led.append({'kind': 'heat', 'replica': 'victim',\n"
+        "                'shard': 0, 'heat_ms': float(i),\n"
+        "                'busy_frac': 0.5, 'ts': float(i)})\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(filter(None, (
+                   os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))),
+                   os.environ.get("PYTHONPATH")))))
+    proc = subprocess.Popen([sys.executable, "-c", child, root], env=env)
+    try:
+        path = heat_path_for(root, "victim")
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            try:
+                if open(path, "rb").read().count(b"\n") >= 5:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.02)
+        else:
+            pytest.fail("child never wrote 5 heat records")
+        proc.send_signal(signal.SIGKILL)              # mid-write, maybe
+    finally:
+        proc.kill()
+        proc.wait()
+    m = read_heat(root)
+    # every COMPLETE line survives; the only tolerable artifact of the
+    # kill is one torn tail — never a corrupt record, never an exception
+    assert m["corrupt"] == 0 and m["torn"] <= 1
+    assert m["shards"]["0"]["heat_ms"] >= 5.0
+    assert inherited_heat(root, 0) == m["shards"]["0"]["heat_ms"]
+
+
+def _replica(root, rid, n_shards=2, **kw):
+    return FleetReplica(root, n_shards=n_shards, replica_id=rid,
+                        addr=f"http://{rid}", lease_ttl=5.0,
+                        scheduler_kwargs={"wave_window": 0.0}, **kw)
+
+
+def _age_lease(replica, shard, sec=60.0):
+    path = replica.leases._lease_path(f"shard{shard:04d}")
+    t = time.time() - sec
+    os.utime(path, (t, t))
+
+
+def test_adoption_inherits_heat_and_healthz_carries_cost(tmp_path):
+    root = str(tmp_path / "store")
+    a = _replica(root, "rep-a")
+    a.join()
+    assert a.adopt(0)
+    sched = a.schedulers[0]
+    assert sched.load is not None                     # armed by default
+    assert sched.load.shard == 0 and sched.load.replica == "rep-a"
+    sched.load.observe_tick([("s", 2)], device_sec=0.05)
+    a._roll_heat(force=True)
+    hz = a.healthz()
+    assert hz["shards"]["0"]["heat_ms"] == pytest.approx(50.0)
+    assert "busy_frac" in hz["shards"]["0"]
+    assert hz["load"]["heat_ms"] == pytest.approx(50.0)
+    assert hz["replica_addrs"]["rep-a"] == "http://rep-a"
+
+    # the crash: lease goes stale, no drain, no handoff record
+    _age_lease(a, 0)
+    os.utime(a._replica_path(), (time.time() - 600,) * 2)
+
+    b = _replica(root, "rep-b")
+    b.join()
+    b.manage_once()                                   # reclaims + adopts
+    assert 0 in b.schedulers
+    # adoption inherits the shard's accumulated heat from the ledger —
+    # the shard did not cool off by moving
+    assert b.schedulers[0].load.inherited_ms == pytest.approx(50.0)
+    assert b.schedulers[0].load.heat_ms == pytest.approx(50.0)
+    b.leave()
+
+
+def test_graceful_handoff_flushes_heat_before_release(tmp_path):
+    root = str(tmp_path / "store")
+    a = _replica(root, "rep-a")
+    a.join()
+    assert a.adopt(1)
+    a.schedulers[1].load.observe_tick([("s", 1)], device_sec=0.03)
+    assert a.handoff(1)
+    m = read_heat(root)
+    assert m["shards"]["1"]["heat_ms"] == pytest.approx(30.0)
+    assert inherited_heat(root, 1) == pytest.approx(30.0)
+    a.leave()
+
+
+def test_volunteer_handoff_releases_hottest_shard_first(tmp_path):
+    root = str(tmp_path / "store")
+    a = _replica(root, "rep-a")
+    a.join()
+    assert a.adopt(0) and a.adopt(1)
+    # shard 0 is the hot one — under the OLD count-only pick the
+    # volunteer would release the highest shard number (1)
+    a.schedulers[0].load.observe_tick([("s", 1)], device_sec=0.9)
+    a.schedulers[1].load.observe_tick([("s", 1)], device_sec=0.001)
+    b = _replica(root, "rep-b")
+    b.join()
+    a.manage_once()                   # 2 held > target 1 → volunteer one
+    assert 0 not in a.schedulers      # the HOTTEST went first
+    assert 1 in a.schedulers
+    # and the released heat is durable for the adopter to inherit
+    assert inherited_heat(root, 0) == pytest.approx(900.0)
+    a.leave()
+    b.leave()
+
+
+# ---------------------------------------------------------------------------
+# the skew SLO objective + env knobs
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_LOAD", raising=False)
+    assert parse_load()                         # default ON for serving
+    for off in ("0", "off", "false", "no"):
+        assert not parse_load({"HYPEROPT_TPU_LOAD": off})
+    assert parse_load({"HYPEROPT_TPU_LOAD": "1"})
+    # the SLO rider: default on, explicit off, and the token grammar
+    assert parse_load_slo({}) == LOAD_TARGETS
+    assert parse_load_slo({}) is not LOAD_TARGETS     # a copy, not the map
+    assert parse_load_slo({"HYPEROPT_TPU_LOAD_SLO": "off"}) is None
+    t = parse_load_slo({"HYPEROPT_TPU_LOAD_SLO": "skew=5"})
+    assert t["imbalance"]["skew_max"] == 5.0
+    t = parse_load_slo({"HYPEROPT_TPU_LOAD_SLO": "balanced=5"})
+    assert t["imbalance"]["target"] == pytest.approx(0.95)
+    # malformed tokens warn once and fall back to the defaults
+    assert parse_load_slo(
+        {"HYPEROPT_TPU_LOAD_SLO": "skew=banana"}) == LOAD_TARGETS
+    assert parse_load_slo(
+        {"HYPEROPT_TPU_LOAD_SLO": "skew=0.5"}) == LOAD_TARGETS
+
+
+def test_slo_imbalance_objective_records():
+    slo = SLOPlane(metrics=None, clock=lambda: 1000.0)
+    slo.add_objective("imbalance", LOAD_TARGETS["imbalance"])
+    assert slo.objectives["imbalance"].target == 0.90
+    for _ in range(9):
+        slo.record_load(False, now=1000.0)            # skew breaches burn
+    slo.record_load(True, now=1000.0)
+    st = slo.status(now=1000.0)["imbalance"]
+    assert st["budget_remaining_frac"] < 1.0
+    # disarmed plane: record_load is a no-op, not a KeyError
+    SLOPlane(metrics=None).record_load(True)
+
+
+def test_server_feeds_skew_slo_from_merged_view():
+    sched = StudyScheduler(wal=False, quality=False, load=CostLedger())
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False)
+    assert srv.load_skew_max == LOAD_TARGETS["imbalance"]["skew_max"]
+    assert "imbalance" in srv.slo.objectives
+    # a single unbound plane has no shards table → skew 1.0 → balanced
+    sched.load.observe_tick([("a", 1)], device_sec=0.001)
+    merged = srv._refresh_load_gauges()
+    assert merged["heat_skew"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# the new bench keys really gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_rec(ts, **keys):
+    return {"kind": "bench", "ts": ts, "backend": "cpu",
+            "source": "test", "keys": keys}
+
+
+def test_attribution_overhead_gates_absolute_from_first_run():
+    """``attribution_overhead_frac`` uses the fixed absolute bar (the
+    quality/checksum overhead pattern): it gates with NO history at
+    all — the very first recorded round already enforces ≤5%."""
+    import bench_gate
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS
+
+    old = _bench_rec(0.0, trials_per_sec=100.0)   # no load keys at all
+    over = _bench_rec(1.0, attribution_overhead_frac=0.09)
+    regs, _ = bench_gate.windowed_compare([old], over, KEY_DIRECTIONS)
+    assert any("attribution_overhead_frac" in r for r in regs)
+    ok = _bench_rec(1.0, attribution_overhead_frac=0.04)
+    regs, _ = bench_gate.windowed_compare([old], ok, KEY_DIRECTIONS)
+    assert regs == []
+
+
+def test_shard_heat_skew_gates_windowed_lower_is_better():
+    import bench_gate
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS
+
+    history = [_bench_rec(float(i), shard_heat_skew=2.0) for i in range(3)]
+    bad = _bench_rec(3.0, shard_heat_skew=3.0)        # +50% > the 30% bar
+    regs, _ = bench_gate.windowed_compare(history, bad, KEY_DIRECTIONS)
+    assert any("shard_heat_skew" in r for r in regs)
+    ok = _bench_rec(3.0, shard_heat_skew=2.2)
+    regs, _ = bench_gate.windowed_compare(history, ok, KEY_DIRECTIONS)
+    assert regs == []
+
+
+# ---------------------------------------------------------------------------
+# render surfaces: report --fleet, Perfetto heat tracks
+# ---------------------------------------------------------------------------
+
+
+def test_report_fleet_view(tmp_path, capsys):
+    from hyperopt_tpu.obs.report import main, render_fleet_load
+
+    root = str(tmp_path)
+    led = HeatLedger(heat_path_for(root, "rep-a"))
+    for i, (shard, h) in enumerate([(0, 100.0), (0, 9000.0), (1, 10.0),
+                                    (2, 10.0), (3, 10.0)]):
+        led.append({"kind": "heat", "replica": "rep-a", "shard": shard,
+                    "heat_ms": h, "busy_frac": 0.7, "ts": float(i)})
+    text = render_fleet_load(root)
+    assert "fleet load" in text and "shard0" in text
+    assert "SKEW" in text       # 9000 vs 3×10: skew ≈ 4.0 > the 3.0x bound
+    assert "rep-a" in text
+    assert main(["--fleet", root]) == 0
+    assert "heat skew" in capsys.readouterr().out
+    # --fleet is its own view and text-only
+    assert main(["--fleet", root, "--trend"]) == 2
+    assert main(["--fleet", root, "--format", "json"]) == 2
+    assert main(["--fleet", str(tmp_path / "nope")]) == 2
+
+
+def test_export_emits_per_shard_heat_counters(tmp_path):
+    from hyperopt_tpu.obs.export import write_trace
+
+    stream = [
+        {"kind": "run_meta", "ts": 1.0, "run_id": "r"},
+        {"kind": "metrics", "ts": 2.0, "snapshot": {
+            "metrics": {"service.load.shard.3.heat_ms": 1234.0},
+            "load": {"shards": {"5": {"heat_ms": 77.0}}}}},
+    ]
+    out = str(tmp_path / "trace.json")
+    write_trace(out, [("s", iter(stream))])
+    events = json.load(open(out))["traceEvents"]
+    heat = {e["name"]: e for e in events if e.get("cat") == "load"}
+    assert heat["heat.shard3"]["args"]["heat_ms"] == 1234.0
+    assert heat["heat.shard5"]["args"]["heat_ms"] == 77.0
+    assert all(e["ph"] == "C" for e in heat.values())
