@@ -40,6 +40,9 @@ __all__ = [
     "parse_store_gc",
     "parse_load",
     "parse_load_slo",
+    "parse_probe",
+    "parse_probe_period",
+    "parse_probe_slo",
 ]
 
 logger = logging.getLogger(__name__)
@@ -594,6 +597,97 @@ def parse_load_slo(env=None):
         else:
             _warn_once("HYPEROPT_TPU_LOAD_SLO", token,
                        "skew=<ratio>1> or balanced=<percent>")
+    return targets
+
+
+# -- blackbox prober knobs (ISSUE 18) ---------------------------------------
+# Same warn-and-disable convention — except the arming default, which is
+# OFF: the prober is the one obs plane that generates TRAFFIC (synthetic
+# canary studies through the real client path), so it must be asked for.
+
+
+DEFAULT_PROBE_PERIOD_SEC = 30.0
+
+
+def parse_probe(env=None):
+    """``HYPEROPT_TPU_PROBE`` → whether the server arms the blackbox
+    prober (``obs/prober.py``) against itself after startup.  Default
+    OFF — disarmed means zero threads, zero allocations, no canary
+    traffic; ``1``/``on`` arms it (also ``--probe`` on the server CLI,
+    which wins over the env)."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_PROBE", "").strip().lower()
+    return raw in ("1", "on", "true", "yes")
+
+
+def parse_probe_period(env=None):
+    """``HYPEROPT_TPU_PROBE_PERIOD=<seconds>`` → the probe cycle cadence
+    (default 30s).  One canary study per cycle per target; malformed or
+    non-positive values warn once and keep the default."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_PROBE_PERIOD", "").strip()
+    if not raw:
+        return DEFAULT_PROBE_PERIOD_SEC
+    try:
+        v = float(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_PROBE_PERIOD", raw, "a number of seconds")
+        return DEFAULT_PROBE_PERIOD_SEC
+    if v <= 0:
+        _warn_once("HYPEROPT_TPU_PROBE_PERIOD", raw, "a positive period")
+        return DEFAULT_PROBE_PERIOD_SEC
+    return v
+
+
+def parse_probe_slo(env=None):
+    """``HYPEROPT_TPU_PROBE_SLO`` → the blackbox objectives the prober
+    feeds into the server's SLO burn-rate plane, or None when disabled:
+
+    * unset / ``1`` / ``on`` → the default ``probe_avail`` /
+      ``probe_golden_match`` / ``probe_ask_p99_ms`` objectives —
+      client-view signals, deliberately distinct from the server-side
+      ``availability``/``ask_latency`` pair so a wedged listener burns
+      budget;
+    * ``0`` / ``off`` → None — probing still runs and renders verdicts,
+      it just does not burn an error budget;
+    * ``avail=N`` (percent), ``golden=N`` (percent of cycles that must
+      match golden), ``ask_p99_ms=N`` (the latency threshold a probe
+      ask must beat).  Malformed tokens warn once and keep defaults.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_PROBE_SLO", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        from .obs.slo import PROBE_TARGETS
+
+        return {k: dict(v) for k, v in PROBE_TARGETS.items()}
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    from .obs.slo import PROBE_TARGETS
+
+    targets = {k: dict(v) for k, v in PROBE_TARGETS.items()}
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        key, _, val = token.partition("=")
+        key = key.strip().lower()
+        try:
+            v = float(val)
+        except ValueError:
+            _warn_once("HYPEROPT_TPU_PROBE_SLO", token,
+                       "a key=number token")
+            continue
+        if key in ("avail", "availability") and 0 < v <= 100:
+            targets["probe_avail"]["target"] = min(0.9999, v / 100.0)
+        elif key == "golden" and 0 < v <= 100:
+            targets["probe_golden_match"]["target"] = \
+                min(0.9999, v / 100.0)
+        elif key == "ask_p99_ms" and v > 0:
+            targets["probe_ask_p99_ms"]["threshold_ms"] = v
+        else:
+            _warn_once("HYPEROPT_TPU_PROBE_SLO", token,
+                       "one of avail=/golden=/ask_p99_ms= with a sane "
+                       "value")
     return targets
 
 

@@ -70,7 +70,7 @@ import signal
 import time
 
 __all__ = ["ChaosPlan", "parse_spec", "get_plan", "configure", "armed",
-           "point", "io_point", "corrupt_bytes"]
+           "point", "io_point", "corrupt_bytes", "corrupt_floats"]
 
 logger = logging.getLogger(__name__)
 
@@ -336,3 +336,49 @@ def corrupt_bytes(site, data, metrics=None):
         pass
     logger.warning("chaos: flipped bit %d in a %s record", pos, site)
     return bytes(out)
+
+
+def corrupt_floats(site, arr, metrics=None):
+    """A proposal-mutation chaos site (ISSUE 18): when a ``corrupt``
+    rule is due, perturb ONE seeded element per row of the float array
+    ``arr`` (a copy — device buffers are never mutated) and return it;
+    otherwise ``arr`` unchanged.  The perturbation is finite, small and
+    SILENT — no flag, no exception, values still in-range-ish — i.e.
+    exactly the wrong-answer class that slips past the non-finite guard
+    and every checksum, and that only the blackbox prober's golden
+    stream digest can catch.  Per-ROW so every study slot served by a
+    corrupted tick is affected (a single global flip could land in
+    masked padding and detect as nothing).  Disarmed cost: one
+    attribute check.  Deterministic: positions draw from the rule's own
+    seeded stream, one draw per row per fired hit."""
+    plan = _plan if _plan is not _UNSET else get_plan()
+    if plan is None:
+        return arr
+    rule = plan.mutate_rule(site)
+    if rule is None:
+        return arr
+    import numpy as _np
+
+    out = _np.array(arr, copy=True)
+    flat = out.reshape(-1) if out.ndim <= 1 \
+        else out.reshape(out.shape[0], -1)
+    rows = flat.reshape(1, -1) if flat.ndim == 1 else flat
+    if rows.shape[-1] == 0:
+        return arr
+    for i in range(rows.shape[0]):
+        j = rule.rng.randrange(rows.shape[-1])
+        rows[i, j] = rows[i, j] * 1.03125 + 0.03125
+    if metrics is not None:
+        metrics.counter(f"chaos.corrupt.{site}").inc()
+    try:
+        from .obs.flight import get_flight
+
+        get_flight().record({"kind": "chaos", "ts": time.time(),
+                             "action": "corrupt", "site": site,
+                             "rows": int(rows.shape[0]),
+                             "pid": os.getpid()})
+    except Exception:
+        pass
+    logger.warning("chaos: silently perturbed %d proposal row(s) at %s",
+                   int(rows.shape[0]), site)
+    return out

@@ -69,7 +69,8 @@ from .trace import iter_jsonl, read_jsonl  # noqa: F401  (read_jsonl re-export)
 
 __all__ = ["main", "render", "render_merged", "render_postmortem",
            "render_trend", "headline_sections", "json_report",
-           "render_study_timeline", "study_timeline_events"]
+           "render_study_timeline", "study_timeline_events",
+           "render_probes"]
 
 _BAR_W = 30
 
@@ -583,6 +584,125 @@ def _storage_section(metrics, out):
             f"  repaired {int(keys.get('scrub.repaired', 0))}")
 
 
+def _probe_section(metrics, out):
+    """Blackbox probes (ISSUE 18): the synthetic-canary audit plane —
+    cycle count, newest verdict, golden-match streak and the measured
+    green→red detection latency — from the ``probe.*`` gauges a
+    prober-armed server snapshots.  Rendered only when the stream
+    recorded the prober (a disarmed run keeps its report unchanged)."""
+    pr = {k: v for k, v in metrics.items() if k.startswith("probe.")}
+    if not pr:
+        return
+    verdict_names = ("ok", "degraded", "contract", "mismatch", "error")
+    out.append("")
+    out.append("== blackbox probes " + "=" * 45)
+    code = int(pr.get("probe.last_verdict_code", -1))
+    verdict = verdict_names[code] if 0 <= code < len(verdict_names) \
+        else "?"
+    out.append(
+        f"  cycles   {int(pr.get('probe.cycles', 0))}"
+        f"  targets {int(pr.get('probe.targets', 0))}"
+        f"  last verdict {verdict}"
+        f"  golden-match streak "
+        f"{int(pr.get('probe.golden_match_streak', 0))}")
+    counts = "  ".join(
+        f"{v} {int(pr[f'probe.verdict.{v}'])}" for v in verdict_names
+        if pr.get(f"probe.verdict.{v}"))
+    if counts:
+        out.append(f"  verdicts {counts}")
+    lat = pr.get("probe.detection_latency_sec")
+    if lat is not None:
+        out.append(f"  detection latency {float(lat):.2f}s "
+                   "(last green->red edge, client-view)")
+    esc = int(pr.get("probe.escalations", 0))
+    if esc or verdict == "mismatch":
+        out.append(
+            f"  GOLDEN MISMATCH: escalations {esc} — the canary's "
+            "proposal stream diverged from the committed golden digest "
+            "(evidence bundles under fleet/probes/, flight ring has "
+            "probe_mismatch records)")
+
+
+def render_probes(path):
+    """The blackbox-probe verdict view (ISSUE 18) from the durable
+    CRC-sealed ledgers: give one ``<replica>.jsonl`` ledger, a
+    ``fleet/probes`` dir, or a store root — per replica the verdict
+    census, current/newest verdict, golden digest provenance and the
+    measured detection-latency stats over every green→red edge.
+    Corrupt ledger lines are counted, not fatal (the census read
+    discipline)."""
+    from .prober import PROBES_DIR, detection_stats, read_probes
+
+    if os.path.isdir(path):
+        probes_dir = os.path.join(path, PROBES_DIR)
+        if not os.path.isdir(probes_dir):
+            probes_dir = path
+        ledgers = sorted(
+            os.path.join(probes_dir, f) for f in os.listdir(probes_dir)
+            if f.endswith(".jsonl"))
+    else:
+        ledgers = [path]
+    out = []
+    out.append("== blackbox probes " + "=" * 45)
+    if not ledgers:
+        out.append(f"  (no probe ledgers under {path} — is any replica "
+                   "running with --probe on / HYPEROPT_TPU_PROBE=1?)")
+        return "\n".join(out) + "\n"
+    verdict_names = ("ok", "degraded", "contract", "mismatch", "error")
+    glyph = {"ok": ".", "degraded": "d", "contract": "c",
+             "mismatch": "X", "error": "!"}
+    for ledger in ledgers:
+        recs, corrupt, torn = read_probes(ledger)
+        name = os.path.basename(ledger)[: -len(".jsonl")]
+        line = f"  {name:<24} verdicts {len(recs)}"
+        if corrupt:
+            line += f"  CORRUPT {corrupt}"
+        if torn:
+            line += f"  torn {torn}"
+        out.append(line)
+        if not recs:
+            continue
+        recs = sorted(recs, key=lambda r: (r.get("ts") or 0.0,
+                                           r.get("cycle") or 0))
+        counts = {}
+        for r in recs:
+            counts[r.get("verdict") or "?"] = (
+                counts.get(r.get("verdict") or "?", 0) + 1)
+        census = "  ".join(f"{v} {counts[v]}" for v in verdict_names
+                           if v in counts)
+        extra = sum(n for v, n in counts.items()
+                    if v not in verdict_names)
+        if extra:
+            census += f"  other {extra}"
+        last = recs[-1]
+        out.append(f"    census   {census}")
+        out.append(
+            f"    newest   cycle {int(last.get('cycle') or 0)}"
+            f"  verdict {last.get('verdict')}"
+            + (f"  ({last.get('why')})" if last.get("why") else ""))
+        golden = last.get("golden")
+        if golden:
+            out.append(
+                f"    golden   {golden} [{last.get('golden_source')}]"
+                f"  canary {last.get('canary')}"
+                f"  backend {last.get('backend')}")
+        strip = "".join(glyph.get(r.get("verdict"), "?")
+                        for r in recs[-48:])
+        out.append(f"    verdicts [{strip}]  (newest right)")
+        stats = detection_stats(recs)
+        if stats["episodes"]:
+            out.append(
+                f"    detect   {stats['episodes']} episode(s)  "
+                f"latency min {stats['min_sec']:.2f}s  "
+                f"mean {stats['mean_sec']:.2f}s  "
+                f"max {stats['max_sec']:.2f}s (client-view "
+                "green->red)")
+        evidence = [r.get("evidence") for r in recs if r.get("evidence")]
+        if evidence:
+            out.append(f"    evidence {evidence[-1]}")
+    return "\n".join(out) + "\n"
+
+
 def _slo_lines(metrics, out):
     """SLO error-budget lines (ISSUE 11): one row per objective from the
     ``slo.*`` gauges, budget bar + fast/slow burn rates, with the
@@ -1006,6 +1126,7 @@ def render(records, top=5):
     _service_section(_last_snapshot_metrics(records), out)
     _quality_section(_last_snapshot_metrics(records), events, out)
     _storage_section(_last_snapshot_metrics(records), out)
+    _probe_section(_last_snapshot_metrics(records), out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)
     out.append("")
@@ -1510,12 +1631,39 @@ def main(argv=None):
                         "heat ledgers under STORE_ROOT/fleet/heat/: merged "
                         "per-shard heat with sparklines, replica busy "
                         "fractions, and a SKEW banner on imbalance")
+    p.add_argument("--probes", metavar="PATH", default=None,
+                   help="render the blackbox-probe verdict view from the "
+                        "durable probe ledger(s): a <replica>.jsonl "
+                        "ledger file, a fleet/probes dir, or the store "
+                        "root — verdict census, golden provenance and "
+                        "detection-latency stats per replica")
     p.add_argument("--study", metavar="ID", default=None,
                    help="render one study's audit timeline from the "
                         "service WAL (give the WAL file or the --store "
                         "root; extra obs/flight/access streams join the "
                         "request-correlation view)")
     args = p.parse_args(argv)
+    if args.probes is not None:
+        if (args.merge or args.postmortem or args.export_trace
+                or args.trend or args.study or args.fleet):
+            print("error: --probes is its own view; it does not combine "
+                  "with --merge/--postmortem/--export-trace/--trend/"
+                  "--study/--fleet", file=sys.stderr)
+            return 2
+        if args.format == "json":
+            # erroring beats a scripted consumer silently getting text:
+            # the ledgers are already machine-readable sealed JSONL and
+            # the live view is served as JSON by GET /probes
+            print("error: --probes renders text only; for machine-"
+                  "readable verdicts GET /probes or read the ledgers "
+                  "under fleet/probes/", file=sys.stderr)
+            return 2
+        if not os.path.exists(args.probes):
+            print(f"error: no probe ledger or store at {args.probes}",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_probes(args.probes))
+        return 0
     if args.fleet is not None:
         if (args.merge or args.postmortem or args.export_trace
                 or args.trend or args.study):

@@ -63,7 +63,8 @@ import time
 from collections import deque
 
 __all__ = ["SLOPlane", "Objective", "DEFAULT_TARGETS", "QUALITY_TARGETS",
-           "LOAD_TARGETS", "WINDOWS", "FAST_BURN", "SLOW_BURN"]
+           "LOAD_TARGETS", "PROBE_TARGETS", "WINDOWS", "FAST_BURN",
+           "SLOW_BURN"]
 
 logger = logging.getLogger(__name__)
 
@@ -113,6 +114,19 @@ QUALITY_TARGETS = {
 #: feeds pre-judged booleans via ``record_load``.
 LOAD_TARGETS = {
     "imbalance": {"target": 0.90, "skew_max": 3.0},
+}
+
+#: blackbox-prober objectives (ISSUE 18) — the CLIENT-view signals,
+#: deliberately distinct from the server-side ``availability`` /
+#: ``ask_latency`` pair: they are measured through the real HTTP path
+#: (retries and redirect hops included), so a wedged listener — which
+#: server-side objectives never see — burns budget here.
+#: ``probe_golden_match`` is the correctness objective: the fraction of
+#: probe cycles whose canary proposal-stream digest matched golden.
+PROBE_TARGETS = {
+    "probe_avail": {"target": 0.99},
+    "probe_golden_match": {"target": 0.999},
+    "probe_ask_p99_ms": {"target": 0.99, "threshold_ms": 2000.0},
 }
 
 
@@ -294,6 +308,20 @@ class SLOPlane:
             if obj is None:
                 return
             obj.record(bool(balanced), now)
+        self._maybe_evaluate(now)
+
+    def record_probe(self, objective, ok, now=None):
+        """Feed one blackbox-probe observation into a ``probe_*``
+        objective (the prober judges good/bad client-side — request
+        succeeded, ask under threshold, cycle matched golden — and this
+        plane only does the burn math).  No-op when the objective was
+        never installed (probe SLO disarmed)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            obj = self.objectives.get(str(objective))
+            if obj is None:
+                return
+            obj.record(bool(ok), now)
         self._maybe_evaluate(now)
 
     # -- evaluation --------------------------------------------------------

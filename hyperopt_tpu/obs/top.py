@@ -352,6 +352,24 @@ def _render_service_source(name, snap, out, w):
                 and qual.get("studies", 0) > 1):
             qline += "  STAGNANT"
         out.append(qline)
+    # the PROBE row (ISSUE 18): the blackbox canary's verdict — is the
+    # server provably serving the RIGHT proposals as a client sees it —
+    # from /snapshot's probes section (prober-armed servers only)
+    probes = snap.get("probes")
+    if probes and probes.get("armed"):
+        last = probes.get("last") or {}
+        pline = (f"  {'':<{w}}  PROBE  "
+                 f"{'green' if probes.get('green') else 'RED'}"
+                 f"  cycles {probes.get('cycles', 0)}"
+                 f"  verdict {last.get('verdict', '?')}"
+                 f"  streak {probes.get('golden_match_streak', 0)}")
+        det = probes.get("detection")
+        if det:
+            pline += f"  detect {float(det['mean_sec']):.1f}s"
+        if probes.get("escalations"):
+            pline += (f"  MISMATCH x{probes['escalations']} "
+                      "(golden-stream divergence)")
+        out.append(pline)
     degrade = snap.get("degrade")
     if degrade and (degrade.get("level") or degrade.get("faults")):
         out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"

@@ -235,6 +235,20 @@ KEY_DIRECTIONS = {
     # skewed placement — lower is better (1.0 = balanced); a regression
     # means attribution stopped seeing the imbalance it exists to see
     "shard_heat_skew": {"direction": "lower", "threshold": 0.30},
+    # blackbox time-to-detect (bench.py blackbox_probe stage, ISSUE 18):
+    # wall seconds from corruption injected into the serving path to the
+    # prober's first non-green verdict, driven with a tight probe period
+    # so the measurement is the detection pipeline, not the period.  The
+    # loose bar catches detection taking extra cycles (a broken digest
+    # or lint path), not shared-hardware cycle-time noise.
+    "probe_detection_latency_sec": {"direction": "lower",
+                                    "threshold": 1.00},
+    # armed-vs-disarmed prober tax on TENANT traffic through the real
+    # handle() path while canary cycles run concurrently — the same 5%
+    # absolute acceptance bar as the other planes: blackbox auditing
+    # must be noise on the tenants it audits, not a tax.
+    "probe_overhead_frac": {"direction": "lower", "threshold": 0.05,
+                            "absolute": True},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -264,7 +278,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "solved_frac_anneal", "solved_frac_mix",
                 "solved_frac_atpe",
                 "quality_overhead_frac",
-                "attribution_overhead_frac", "shard_heat_skew")
+                "attribution_overhead_frac", "shard_heat_skew",
+                "probe_detection_latency_sec", "probe_overhead_frac")
 
 
 def trajectory_path(root=None):

@@ -85,11 +85,16 @@ class ServiceClient:
     max_hops = 4
 
     def __init__(self, url, retry=None, timeout=60.0, deadline_ms=None,
-                 sleep=time.sleep, key=0, trace=None):
+                 sleep=time.sleep, key=0, trace=None, headers=None):
         from .._env import parse_reqtrace
 
         urls = [url] if isinstance(url, str) else list(url)
         self.urls = [str(u).rstrip("/") for u in urls]
+        # static extra headers on EVERY request (the blackbox prober
+        # stamps ``x-probe: 1`` so canary traffic stays out of the
+        # server-side tenant SLO objectives); attempt-scoped headers
+        # (traceparent) still layer on top
+        self.headers = dict(headers or {})
         self.retry = (RetryPolicy(max_retries=5, base_delay=0.2,
                                   max_delay=5.0)
                       if retry is None else RetryPolicy.coerce(retry))
@@ -163,6 +168,8 @@ class ServiceClient:
         ride in ``self._attempt_headers`` — the signature stays what
         every harness that monkeypatches ``_once`` expects."""
         headers = {"Content-Type": "application/json"}
+        if self.headers:
+            headers.update(self.headers)
         if self.deadline_ms is not None:
             headers["X-Deadline-Ms"] = str(self.deadline_ms)
         if self._attempt_headers:

@@ -148,8 +148,15 @@ class Study:
 
     def __init__(self, study_id, space, seed=0, n_startup_jobs=None,
                  max_trials=None, trials=None, space_spec=None,
-                 **tpe_kwargs):
+                 canary=False, **tpe_kwargs):
         self.study_id = study_id
+        # canary (ISSUE 18): a synthetic blackbox-prober study.  Serves
+        # EXACTLY like a tenant study (same ask/tell/WAL path — that is
+        # the point of probing), but is excluded from the quality and
+        # load tenant telemetry, device-time charging and the census
+        # bank, so canary traffic is free.  Round-trips through the WAL
+        # admit record like every other admit kwarg.
+        self.canary = bool(canary)
         self.domain = Domain(None, space)
         self.trials = trials if trials is not None else Trials()
         self.rstate = np.random.default_rng(seed)
@@ -161,6 +168,8 @@ class Study:
         # what it had to skip.
         self.space_spec = space_spec
         self.admit_kwargs = {}
+        if self.canary:
+            self.admit_kwargs["canary"] = True
         if n_startup_jobs is not None:
             self.admit_kwargs["n_startup_jobs"] = int(n_startup_jobs)
         if max_trials is not None:
@@ -306,7 +315,7 @@ class Study:
         self._best_dirty = True
 
     def status_dict(self):
-        return {
+        out = {
             "study_id": self.study_id,
             "state": self.state,
             "labels": list(self.domain.cs.labels),
@@ -321,6 +330,11 @@ class Study:
             "seed": self.seed,
             "warming": self.warming,
         }
+        if self.canary:
+            # only stamped on synthetic prober studies — tenant status
+            # payloads stay byte-for-byte what they always were
+            out["canary"] = True
+        return out
 
 
 class _AskReq:
@@ -1344,7 +1358,11 @@ class StudyScheduler:
         pmesh = None if widen else mesh
         spec0 = next((r.study.space_spec for r in cohort_reqs
                       if r.study.space_spec is not None), None)
-        if plane.census is not None and spec0 is not None:
+        if plane.census is not None and spec0 is not None \
+                and any(not r.study.canary for r in cohort_reqs):
+            # canary-only ticks never feed the census bank: the prober's
+            # synthetic signature must not displace a real tenant space
+            # from the top-N pre-warm set
             from .compile_plane import SignatureCensus
 
             if cohort._census_kid is None:
@@ -1457,6 +1475,11 @@ class StudyScheduler:
         except BaseException:
             cohort.abandon_device()
             raise
+        # chaos `corrupt@tick` (ISSUE 18): a seeded SILENT perturbation
+        # of the read-back proposals — no flag, no error, finite values.
+        # Exactly the fault class only the blackbox prober's golden
+        # digest can catch; a no-op attribute check when chaos is off.
+        mat = chaos.corrupt_floats("tick", mat, self.metrics)
         live = [cohort.extract(mat[cohort.slot_of[r.study.study_id]],
                                len(r.new_ids))
                 for r in cohort_reqs
@@ -1517,8 +1540,13 @@ class StudyScheduler:
         touches the reqs' docs/seeds, so armed proposals stay
         bit-identical to disarmed (the standing obs invariant)."""
         try:
+            # canary reqs are never charged: probe traffic must read as
+            # free in the cost observatory (it is synthetic, and billing
+            # it would skew every per-study share on a quiet fleet)
             entries = [(r.study.study_id, len(r.new_ids))
-                       for r in cohort_reqs]
+                       for r in cohort_reqs if not r.study.canary]
+            if not entries:
+                return
             n_ask = 0
             for _, k in entries:
                 n_ask += k
@@ -1958,13 +1986,13 @@ class StudyScheduler:
         ok_loss = float(loss) if ok else None
         st.record_result(ok_loss)
         self.metrics.counter("service.tells").inc()
-        if self.quality is not None:
+        if self.quality is not None and not st.canary:
             try:
                 self.quality.observe_tell(st, ok_loss, replay=replay)
             except Exception as e:  # noqa: BLE001 - never fail a tell
                 logging.getLogger(__name__).warning(
                     "quality observe_tell failed: %s", e)
-        if self.load is not None and not replay:
+        if self.load is not None and not replay and not st.canary:
             # replayed tells are never recounted: adopted heat arrives
             # through the durable heat ledger (CostLedger.inherit), so
             # migration replay stays bitwise and heat is never doubled
@@ -2240,7 +2268,8 @@ class StudyScheduler:
                 st.state = rec.get("state", "active")
                 for rid, tids in (rec.get("served") or {}).items():
                     st.remember_req(rid, tids)
-                if self.quality is not None and st.n_told:
+                if self.quality is not None and st.n_told \
+                        and not st.canary:
                     # a compacted WAL carries no tell records for the
                     # settled history, so the tracker state (best-so-far,
                     # plateau clock, timeline events) is rebuilt from the
@@ -2345,7 +2374,7 @@ class StudyScheduler:
                 ok_loss = (res.get("loss")
                            if res.get("status") == STATUS_OK else None)
                 st.record_result(ok_loss)
-                if self.quality is not None:
+                if self.quality is not None and not st.canary:
                     try:
                         self.quality.observe_tell(st, ok_loss,
                                                   replay=True)

@@ -100,7 +100,7 @@ logger = logging.getLogger(__name__)
 
 _STUDY_KWARGS = ("n_startup_jobs", "max_trials", "prior_weight",
                  "n_EI_candidates", "gamma", "linear_forgetting",
-                 "ei_select", "ei_tau", "prior_eps")
+                 "ei_select", "ei_tau", "prior_eps", "canary")
 
 
 class _RequestError(Exception):
@@ -232,6 +232,10 @@ class ServiceHTTPServer:
         log_path = (parse_service_access_log() if access_log is None
                     else (access_log or None))
         self.access_log = JsonlSink(log_path) if log_path else None
+        # blackbox prober (ISSUE 18): disarmed = None — zero threads,
+        # zero allocations, no probe SLO objectives installed.  Armed
+        # post-start via arm_prober() (it needs the bound URL).
+        self.prober = None
         self._httpd = None
         self._thread = None
         self._stopped = False
@@ -283,17 +287,22 @@ class ServiceHTTPServer:
             payload.setdefault("request_id", req_id)
         self._count_response(method, path, status)
         self._observe_response(method, path, status, latency, payload,
-                               ctx, req_id)
+                               ctx, req_id,
+                               probe=headers.get("x-probe") == "1")
         return status, payload
 
     def _observe_response(self, method, path, status, latency_sec,
-                          payload, ctx, req_id):
+                          payload, ctx, req_id, probe=False):
         """Post-response observability: feed the SLO plane and write the
-        access-log record (JSONL + flight ring).  Never raises."""
+        access-log record (JSONL + flight ring).  Never raises.
+        ``probe`` marks blackbox-prober traffic (the ``x-probe: 1``
+        header): it must NOT feed the server-side tenant SLO objectives
+        — the prober judges itself through its own ``probe_*``
+        objectives — but it stays in the access log, tagged."""
         ep = self._endpoint_label(method, path)
         shed = bool(status == 429 and isinstance(payload, dict)
                     and payload.get("retry_after") is not None)
-        if self.slo is not None:
+        if self.slo is not None and not probe:
             try:
                 self.slo.record_request(ep, status,
                                         latency_sec=latency_sec,
@@ -313,6 +322,8 @@ class ServiceHTTPServer:
                    "path": path, "status": int(status),
                    "latency_ms": round(latency_sec * 1e3, 3),
                    "trace": ctx.trace_id if ctx is not None else None}
+            if probe:
+                rec["probe"] = True
             if req_id:
                 rec["request_id"] = req_id
             if isinstance(payload, dict):
@@ -383,7 +394,8 @@ class ServiceHTTPServer:
         rest pooled (an attacker probing random paths must not mint
         unbounded metric families)."""
         known = ("/study", "/ask", "/tell", "/close", "/studies",
-                 "/metrics", "/snapshot", "/healthz", "/fleet/load", "/")
+                 "/metrics", "/snapshot", "/healthz", "/fleet/load",
+                 "/probes", "/")
         if path in known:
             return path.strip("/").replace("/", "_") or "root"
         if _timeline_study_id(path) is not None:
@@ -425,7 +437,13 @@ class ServiceHTTPServer:
         it).  Single-server mode reports the same shape with no shard
         table."""
         if self.fleet is not None:
-            return self.fleet.healthz()
+            out = self.fleet.healthz()
+            if self.prober is not None:
+                # blackbox verdict fields (ISSUE 18): the rolling-restart
+                # gate reads these — fail-open (never flips `ok`; the
+                # gate decides what "blackbox-green" requires)
+                out["probe"] = self.prober.healthz_fields()
+            return out
         sched = self.scheduler
         out = {"ok": True, "replica": None, "addr": self.url,
                "n_shards": None, "shards_held": [], "shards": {},
@@ -444,6 +462,8 @@ class ServiceHTTPServer:
             if store.get("store_full"):
                 out["ok"] = False
         out["ok"] = out["ok"] and not sched._draining
+        if self.prober is not None:
+            out["probe"] = self.prober.healthz_fields()
         return out
 
     def _studies_status(self):
@@ -462,6 +482,8 @@ class ServiceHTTPServer:
                     return 200, self.snapshot_dict()
                 if path == "/fleet/load":
                     return 200, self.fleet_load_dict()
+                if path == "/probes":
+                    return 200, self.probes_dict()
                 sid = _timeline_study_id(path)
                 if sid is not None:
                     return 200, self._route(sid).study_timeline(sid)
@@ -474,7 +496,8 @@ class ServiceHTTPServer:
                                       "GET /study/<id>/timeline",
                                       "GET /healthz",
                                       "GET /metrics", "GET /snapshot",
-                                      "GET /fleet/load"]}
+                                      "GET /fleet/load",
+                                      "GET /probes"]}
                 raise _RequestError(404, f"no such endpoint: {path}")
             if method != "POST":
                 raise _RequestError(405, f"{method} not supported")
@@ -812,6 +835,25 @@ class ServiceHTTPServer:
             out["store"] = status["store"]
         if "quarantined" in status:
             out["quarantined"] = status["quarantined"]
+        if self.prober is not None:
+            out["probes"] = self.prober.status_dict()
+        return out
+
+    def probes_dict(self):
+        """``GET /probes``: the blackbox prober's rolling verdict view —
+        armed state, golden digest + source, per-verdict counts, match
+        streak, recent cycles and detection-latency stats.  Disarmed
+        servers answer a one-field shape instead of a 404 so dashboards
+        can scrape unconditionally."""
+        out = {"ok": True, "ts": time.time(), "endpoint": "probes"}
+        if self.prober is None:
+            out["armed"] = False
+            return out
+        try:
+            out.update(self.prober.status_dict())
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            out["armed"] = True
+            out["error"] = "probe status unavailable"
         return out
 
     def _refresh_store_gauges(self):
@@ -872,12 +914,63 @@ class ServiceHTTPServer:
         cleared) so a survivor adopts it — the rolling-restart
         zero-lost-tells path.  Returns True when everything quiesced
         within ``timeout``."""
+        if self.prober is not None:
+            # stop probing BEFORE the listener starts refusing: a drain
+            # must not manufacture error verdicts on its way out
+            try:
+                self.prober.stop()
+            except Exception:  # noqa: BLE001
+                pass
         if self.fleet is not None:
             quiesced = self.fleet.drain(timeout=timeout)
         else:
             quiesced = self.scheduler.drain(timeout=timeout)
         self.stop()
         return quiesced
+
+    def arm_prober(self, period=None, targets=None):
+        """Arm the blackbox prober (ISSUE 18) against this server —
+        called AFTER ``start()`` (the prober probes the real bound URL
+        through the real HTTP path).  Installs the ``probe_*`` SLO
+        objectives (only now: a disarmed prober leaves the burn-rate
+        plane untouched), resolves the sealed verdict-ledger path under
+        the store root when one exists, and starts the probe thread.
+        Idempotent; returns the prober (or None when unbound)."""
+        if self.prober is not None:
+            return self.prober
+        if not targets and self.url is None:
+            logger.warning("probe: server is not bound; prober stays "
+                           "disarmed")
+            return None
+        from .._env import parse_probe_period, parse_probe_slo
+        from ..obs.prober import Prober, probes_path_for
+
+        slo_targets = parse_probe_slo() if self.slo is not None else None
+        if slo_targets:
+            for name, spec in slo_targets.items():
+                self.slo.add_objective(name, spec)
+        if self.fleet is not None:
+            replica = self.fleet.replica_id
+            store_root = self.fleet.store_root
+            wal_path = None  # per-(shard, epoch) WALs; evidence skips it
+        else:
+            replica = "single"
+            store_root = self.scheduler.store_root
+            j = self.scheduler.journal
+            wal_path = j.path if j is not None else None
+        self.prober = Prober(
+            list(targets) if targets else [self.url],
+            period=(period if period is not None
+                    else parse_probe_period()),
+            slo=self.slo if slo_targets else None,
+            metrics=self.metrics,
+            ledger_path=(probes_path_for(store_root, replica)
+                         if store_root else None),
+            replica=replica, wal_path=wal_path)
+        self.prober.start()
+        logger.info("blackbox prober armed: %s every %.3gs",
+                    self.prober.targets, self.prober.period)
+        return self.prober
 
     def stop(self):
         if self._stopped:
@@ -1054,6 +1147,14 @@ def main(argv=None):
     p.add_argument("--announce", action="store_true",
                    help="print 'SERVICE_URL <url>' once bound (harness "
                         "handshake)")
+    p.add_argument("--probe", default=None, choices=("on", "off"),
+                   help="blackbox prober (ISSUE 18): pinned-seed canary "
+                        "studies through the real HTTP path, golden-"
+                        "stream verdicts on GET /probes (default: "
+                        "$HYPEROPT_TPU_PROBE or off)")
+    p.add_argument("--probe-period", type=float, default=None,
+                   help="probe cycle period in seconds (default: "
+                        "$HYPEROPT_TPU_PROBE_PERIOD or 30)")
     args = p.parse_args(argv)
 
     port = args.port if args.port is not None else parse_service()
@@ -1138,6 +1239,10 @@ def main(argv=None):
             return 1
     if args.announce:
         print(f"SERVICE_URL {server.url}", flush=True)
+    from .._env import parse_probe
+
+    if args.probe == "on" or (args.probe is None and parse_probe()):
+        server.arm_prober(period=args.probe_period)
 
     # graceful drain on SIGTERM: stop admitting, finish in-flight waves,
     # compact + close the WAL, exit 0 — a supervised restart (or spot

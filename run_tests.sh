@@ -180,4 +180,9 @@ if [ "${LOAD_GATE:-0}" = "1" ]; then
         -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/load_smoke.py || exit 1
 fi
+if [ "${PROBE_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_prober.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/probe_smoke.py || exit 1
+fi
 exit 0

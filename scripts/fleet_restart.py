@@ -6,10 +6,16 @@ For each replica IN TURN:
    (in-flight waves finish, the shard's epoch WAL compacts to one
    snapshot per live study, the ownership entry clears, the lease
    releases) and the process exits 0.
-2. **Wait for coverage** — poll the REMAINING replicas' ``GET /healthz``
-   until their held-shard tables jointly cover the whole keyspace again
-   (survivors' stewards adopt the released shards by WAL replay;
-   clients meanwhile ride 307/503 + Retry-After, never a hard failure).
+2. **Wait for coverage AND blackbox-green** — poll the REMAINING
+   replicas' ``GET /healthz`` until their held-shard tables jointly
+   cover the whole keyspace again (survivors' stewards adopt the
+   released shards by WAL replay; clients meanwhile ride 307/503 +
+   Retry-After, never a hard failure) and, on every survivor that runs
+   the blackbox prober (ISSUE 18), until its ``probe`` verdict is green
+   — a restart must not march on while the remaining fleet is serving
+   wrong or stale proposals that lease coverage alone cannot see.
+   Replicas with the prober disarmed do not veto (you cannot gate on a
+   signal nobody measures).
 3. **Relaunch** — run the replica's launch command again and wait for
    the new process's ``/healthz`` to answer ``ok`` (its steward will be
    volunteered shards back by the rebalance).
@@ -39,7 +45,8 @@ import time
 import urllib.request
 
 __all__ = ["fetch_healthz", "fleet_coverage", "wait_coverage",
-           "wait_exit", "restart_one", "main"]
+           "blackbox_green", "wait_blackbox_green", "wait_exit",
+           "restart_one", "main"]
 
 
 def fetch_healthz(url, timeout=3.0):
@@ -81,6 +88,33 @@ def wait_coverage(urls, timeout=60.0, poll=0.2):
     return False
 
 
+def blackbox_green(urls):
+    """True when every replica at ``urls`` answers healthz AND every
+    one that reports blackbox-probe fields (prober armed) is green —
+    newest canary verdict ``ok`` and fresh.  A replica with the prober
+    disarmed (no ``probe`` section) never vetoes: the gate tightens
+    when the signal exists, it does not manufacture one."""
+    for url in urls:
+        h = fetch_healthz(url)
+        if not h:
+            return False
+        probe = h.get("probe")
+        if probe is not None and not probe.get("green"):
+            return False
+    return True
+
+
+def wait_blackbox_green(urls, timeout=60.0, poll=0.2):
+    """Block until :func:`blackbox_green` holds for ``urls``.  Returns
+    True on success, False on timeout."""
+    deadline = time.monotonic() + float(timeout)
+    while time.monotonic() < deadline:
+        if blackbox_green(urls):
+            return True
+        time.sleep(poll)
+    return False
+
+
 def wait_exit(pid, timeout=60.0, poll=0.1):
     """Wait for ``pid`` to exit.  Uses ``waitpid`` for our own children
     (returns the exit code) and signal-0 polling for foreign pids
@@ -117,6 +151,13 @@ def restart_one(pid, url, other_urls, relaunch=None, timeout=120.0):
     if other_urls and not wait_coverage(other_urls, timeout=timeout):
         raise RuntimeError("survivors never re-adopted the drained "
                            f"shards (urls: {other_urls})")
+    if other_urls and not wait_blackbox_green(other_urls,
+                                              timeout=timeout):
+        raise RuntimeError(
+            "survivors are not blackbox-green (canary probe verdict "
+            "not ok/fresh) — refusing to take down the next replica "
+            f"while the fleet serves suspect proposals (urls: "
+            f"{other_urls})")
     if relaunch is None:
         return None
     cmd = relaunch if isinstance(relaunch, (list, tuple)) else [
@@ -125,7 +166,10 @@ def restart_one(pid, url, other_urls, relaunch=None, timeout=120.0):
     deadline = time.monotonic() + float(timeout)
     while time.monotonic() < deadline:
         h = fetch_healthz(url)
-        if h and h.get("ok"):
+        if h and h.get("ok") and (h.get("probe") is None
+                                  or h["probe"].get("green")):
+            # the reborn replica must be blackbox-green too (when its
+            # prober is armed) before the next step proceeds
             return proc
         if proc.poll() is not None:
             raise RuntimeError(

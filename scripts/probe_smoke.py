@@ -1,0 +1,432 @@
+"""PROBE_GATE end-to-end smoke: a REAL 2-replica subprocess fleet with
+the blackbox prober armed on every replica, tenant traffic running
+throughout, and a deterministic proposal-corruption fault on exactly
+one replica — caught by golden-stream divergence within bounded probe
+cycles, with the sealed ledger, the evidence bundle, the probe SLO
+burn and the drain contract all checked from the outside.
+
+What it pins (the audit contract no unit test can):
+
+* phase 1 — **steady state is green and free**: two clean replicas,
+  each self-probing over its real bound URL (``--probe on``), plus an
+  out-of-process auditor prober cross-checking BOTH replicas' canary
+  streams bitwise per cycle.  Every in-server prober must go green,
+  the auditor must see zero divergence and burn zero probe SLO
+  budget, ``/metrics`` must pass the probe-family exposition lint on
+  both replicas, every verdict ledger line must be CRC-sealed, and
+  the concurrent tenant studies must finish with exactly their budget
+  of trials and zero pending — canary traffic stole nothing.
+
+* phase 2 — **corruption is caught, bounded, and evidenced**: replica
+  r1 is drained (SIGTERM → exit 0 — the restart-gate contract) and
+  relaunched with ``corrupt@tick:1.0`` chaos silently perturbing one
+  float per proposal row.  The auditor's cross-replica check must
+  render a ``mismatch`` verdict within 3 cycles, burn the
+  ``probe_golden_match`` SLO budget (and NOT ``probe_avail`` — the
+  replica answers fine, it answers *wrong*), write a readable
+  evidence bundle naming the diverging digests, and seal the red
+  verdict into its ledger.  r1's own in-server prober must also turn
+  red on ``GET /probes``.  Tenant traffic on the clean replica rides
+  through it all with zero lost tells, and both replicas still drain
+  to exit 0.
+
+Opt in via ``PROBE_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+from validate_scrape import PROBE_FAMILIES, validate_probe_families  # noqa: E402
+
+PROBE_PERIOD = 2.0
+
+
+def _env(chaos=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HYPEROPT_TPU_CHAOS", None)
+    env.pop("HYPEROPT_TPU_PROBE", None)
+    if chaos:
+        env["HYPEROPT_TPU_CHAOS"] = chaos
+    return env
+
+
+def _launch(store, port="0", chaos=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--announce", "--port", str(port), "--store", store,
+         "--probe", "on", "--probe-period", str(PROBE_PERIOD)],
+        cwd=_REPO, env=_env(chaos=chaos), stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 180
+    url = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SERVICE_URL "):
+            url = line.split(None, 1)[1].strip()
+            break
+        if proc.poll() is not None:
+            break
+    return proc, url
+
+
+def _get(url, path, timeout=20):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _get_json(url, path, timeout=20):
+    code, body = _get(url, path, timeout=timeout)
+    return code, json.loads(body)
+
+
+def _sigterm_drain(proc, label):
+    """SIGTERM → drain → exit 0: the restart-gate contract."""
+    if proc.poll() is not None:
+        print(f"{label}: FAIL — replica died early "
+              f"(rc {proc.returncode})", file=sys.stderr)
+        return False
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        print(f"{label}: FAIL — replica ignored SIGTERM", file=sys.stderr)
+        return False
+    if rc != 0:
+        print(f"{label}: FAIL — drain exited {rc}, want 0",
+              file=sys.stderr)
+        return False
+    return True
+
+
+class _TenantDriver(threading.Thread):
+    """One tenant study riding alongside the canaries: create →
+    budget x (ask → tell), then assert nothing was lost."""
+
+    def __init__(self, url, seed, budget=8, n_startup=3):
+        super().__init__()
+        self.url = url
+        self.seed = seed
+        self.budget = budget
+        self.n_startup = n_startup
+        self.study_id = None
+        self.told = 0
+        self.error = None
+
+    def run(self):
+        from hyperopt_tpu.service import ServiceClient
+
+        try:
+            client = ServiceClient([self.url], key=self.seed, timeout=60)
+            sid = client.create_study(
+                space={"x": {"dist": "uniform", "args": [-5, 5]}},
+                seed=self.seed, n_startup_jobs=self.n_startup)
+            for _ in range(self.budget):
+                t = client.ask(sid)[0]
+                client.tell(sid, t["tid"],
+                            float((t["params"]["x"] - 1.0) ** 2))
+                self.told += 1
+            self.study_id = sid
+        except Exception as e:  # noqa: BLE001
+            self.error = f"tenant@{self.url}: {type(e).__name__}: {e}"
+
+
+def _check_tenants(drivers, label):
+    errors = [d.error for d in drivers if d.error]
+    if errors:
+        print(f"{label}: FAIL — tenant errors: {errors}", file=sys.stderr)
+        return False
+    lost = []
+    for d in drivers:
+        _, table = _get_json(d.url, "/studies")
+        s = {s["study_id"]: s for s in table["studies"]}.get(d.study_id)
+        if s is None or s["n_trials"] != d.budget or s["n_pending"]:
+            lost.append((d.study_id,
+                         s and s["n_trials"], s and s["n_pending"]))
+    if lost:
+        print(f"{label}: FAIL — lost/duplicated tenant tells: {lost}",
+              file=sys.stderr)
+        return False
+    print(f"{label}: {len(drivers)} tenant studies complete, "
+          "zero lost tells")
+    return True
+
+
+def _wait_probe_green(url, label, timeout=120):
+    """The in-server prober must go green: newest verdict ok, fresh.
+    Early ``error`` cycles (cold-compile timeouts) are the fail-open
+    contract working, not a failure — we wait through them."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            _, p = _get_json(url, "/probes")
+        except Exception:  # noqa: BLE001 - server mid-cycle
+            time.sleep(0.5)
+            continue
+        last = p
+        if p.get("armed") and p.get("green") and p.get("cycles", 0) >= 2:
+            return p
+        time.sleep(0.5)
+    print(f"{label}: FAIL — prober never went green: "
+          f"{json.dumps(last)[:400]}", file=sys.stderr)
+    return None
+
+
+def _check_sealed_ledger(store, label, want_verdict="ok"):
+    from hyperopt_tpu.obs.prober import probes_path_for, read_probes
+
+    path = probes_path_for(store, "single")
+    if not os.path.exists(path):
+        print(f"{label}: FAIL — no verdict ledger at {path}",
+              file=sys.stderr)
+        return False
+    recs, corrupt, torn = read_probes(path)
+    if corrupt:
+        print(f"{label}: FAIL — {corrupt} corrupt ledger lines in "
+              f"{path}", file=sys.stderr)
+        return False
+    if not any(r.get("verdict") == want_verdict for r in recs):
+        print(f"{label}: FAIL — no {want_verdict!r} verdict in {path} "
+              f"({[r.get('verdict') for r in recs]})", file=sys.stderr)
+        return False
+    return True
+
+
+def _lint_metrics(url, label):
+    code, text = _get(url, "/metrics")
+    if code != 200:
+        print(f"{label}: FAIL — /metrics {code}", file=sys.stderr)
+        return False
+    errors = validate_probe_families(text)
+    if errors:
+        print(f"{label}: FAIL — probe exposition lint: {errors}",
+              file=sys.stderr)
+        return False
+    missing = [f for f in PROBE_FAMILIES if f not in text]
+    if missing:
+        print(f"{label}: FAIL — /metrics missing probe families "
+              f"{missing}", file=sys.stderr)
+        return False
+    return True
+
+
+def _auditor(urls, ledger):
+    """The out-of-process cross-replica prober: generous per-request
+    timeout (subprocess replicas cold-compile), its own SLO plane."""
+    from hyperopt_tpu.obs.prober import Prober
+    from hyperopt_tpu.obs.slo import PROBE_TARGETS, SLOPlane
+
+    plane = SLOPlane()
+    for name, spec in PROBE_TARGETS.items():
+        plane.add_objective(name, spec)
+    # the wide period buys a wide cycle deadline: a freshly relaunched
+    # replica cold-compiles its first canary ask, and a deadline miss
+    # reads as `error` where the check wants a clean mismatch verdict
+    return Prober(urls, period=30.0, slo=plane,
+                  ledger_path=ledger, replica="auditor",
+                  request_timeout=30.0, escalation_cooldown=0.0), plane
+
+
+def phase1_steady_green():
+    print("probe_smoke: phase 1 — 2 clean replicas, every prober green, "
+          "canary traffic free")
+    with tempfile.TemporaryDirectory() as root:
+        stores = [os.path.join(root, "r0"), os.path.join(root, "r1")]
+        procs, urls = [], []
+        for store in stores:
+            proc, url = _launch(store)
+            if url is None:
+                print("phase1: FAIL — replica never announced",
+                      file=sys.stderr)
+                return 1
+            procs.append(proc)
+            urls.append(url)
+        try:
+            drivers = [_TenantDriver(u, seed=100 + i, budget=8)
+                       for i, u in enumerate(urls)]
+            for d in drivers:
+                d.start()
+            for i, url in enumerate(urls):
+                if _wait_probe_green(url, f"phase1 r{i}") is None:
+                    return 1
+            # the auditor: both canary streams must agree bitwise
+            aud, plane = _auditor(urls, os.path.join(root, "aud.jsonl"))
+            for cyc in range(2):
+                rec = aud.run_cycle()
+                if rec["verdict"] != "ok" or rec["diverged"]:
+                    print(f"phase1: FAIL — auditor cycle {cyc + 1} "
+                          f"{rec['verdict']} diverged={rec['diverged']}",
+                          file=sys.stderr)
+                    return 1
+            g = plane.status()["probe_golden_match"]
+            if g["budget_remaining_frac"] < 1.0:
+                print("phase1: FAIL — clean fleet burned golden-match "
+                      "budget", file=sys.stderr)
+                return 1
+            for d in drivers:
+                d.join()
+            if not _check_tenants(drivers, "phase1"):
+                return 1
+            for i, (url, store) in enumerate(zip(urls, stores)):
+                if not _lint_metrics(url, f"phase1 r{i}"):
+                    return 1
+                if not _check_sealed_ledger(store, f"phase1 r{i}"):
+                    return 1
+            for i, proc in enumerate(procs):
+                if not _sigterm_drain(proc, f"phase1 r{i}"):
+                    return 1
+            print("phase1: PASS — both replicas green, auditor saw zero "
+                  "divergence, ledgers sealed, tenants whole, "
+                  "drains exit 0")
+            return 0
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def phase2_divergence_caught():
+    print("probe_smoke: phase 2 — corrupt one replica's proposal "
+          "stream; the prober catches it within 3 cycles")
+    with tempfile.TemporaryDirectory() as root:
+        stores = [os.path.join(root, "r0"), os.path.join(root, "r1")]
+        procs, urls = [], []
+        # r0 clean; r1 launches clean too, goes green, then is drained
+        # and relaunched with every proposal row silently perturbed
+        for store in stores:
+            proc, url = _launch(store)
+            if url is None:
+                print("phase2: FAIL — replica never announced",
+                      file=sys.stderr)
+                return 1
+            procs.append(proc)
+            urls.append(url)
+        try:
+            for i, url in enumerate(urls):
+                if _wait_probe_green(url, f"phase2 r{i}") is None:
+                    return 1
+            # the restart-gate drain contract, then the fault
+            if not _sigterm_drain(procs[1], "phase2 r1"):
+                return 1
+            port = urls[1].rsplit(":", 1)[1]
+            procs[1], urls[1] = _launch(stores[1], port=port,
+                                        chaos="7:corrupt@tick:1.0")
+            if urls[1] is None:
+                print("phase2: FAIL — corrupted r1 never announced",
+                      file=sys.stderr)
+                return 1
+            drivers = [_TenantDriver(urls[0], seed=200, budget=8)]
+            drivers[0].start()
+            # the auditor must catch the divergence within 3 cycles
+            aud, plane = _auditor(urls, os.path.join(root, "aud.jsonl"))
+            caught = None
+            for cyc in range(1, 4):
+                rec = aud.run_cycle()
+                if rec["verdict"] == "mismatch":
+                    caught = cyc
+                    break
+            if caught is None:
+                print("phase2: FAIL — 3 auditor cycles, no mismatch "
+                      f"verdict (last: {aud.last})", file=sys.stderr)
+                return 1
+            print(f"phase2: auditor caught the divergence at cycle "
+                  f"{caught}/3")
+            st = plane.status()
+            if st["probe_golden_match"]["budget_remaining_frac"] >= 1.0:
+                print("phase2: FAIL — mismatch burned no golden-match "
+                      "budget", file=sys.stderr)
+                return 1
+            if st["probe_avail"]["budget_remaining_frac"] < 1.0:
+                print("phase2: FAIL — mismatch burned probe_avail (the "
+                      "replica answered; it answered WRONG)",
+                      file=sys.stderr)
+                return 1
+            if not aud.evidence_bundles:
+                print("phase2: FAIL — no evidence bundle written",
+                      file=sys.stderr)
+                return 1
+            bpath = os.path.join(aud.evidence_bundles[-1], "bundle.json")
+            with open(bpath, encoding="utf-8") as f:
+                bundle = json.load(f)
+            for key in ("verdict", "digest", "golden", "responses",
+                        "timeline"):
+                if key not in bundle:
+                    print(f"phase2: FAIL — evidence bundle missing "
+                          f"{key!r}: {bpath}", file=sys.stderr)
+                    return 1
+            from hyperopt_tpu.obs.prober import read_probes
+
+            recs, corrupt, _ = read_probes(os.path.join(root,
+                                                        "aud.jsonl"))
+            if corrupt or not any(r.get("verdict") == "mismatch"
+                                  for r in recs):
+                print("phase2: FAIL — auditor ledger unsealed or "
+                      "missing the red verdict", file=sys.stderr)
+                return 1
+            # r1's own in-server prober must also turn red
+            deadline = time.monotonic() + 120
+            red = None
+            while time.monotonic() < deadline:
+                try:
+                    _, p = _get_json(urls[1], "/probes")
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.5)
+                    continue
+                if p.get("verdicts", {}).get("mismatch", 0) >= 1:
+                    red = p
+                    break
+                time.sleep(0.5)
+            if red is None:
+                print("phase2: FAIL — r1's in-server prober never "
+                      "rendered mismatch", file=sys.stderr)
+                return 1
+            if red.get("green"):
+                print("phase2: FAIL — r1 /probes still green after "
+                      "mismatch", file=sys.stderr)
+                return 1
+            drivers[0].join()
+            if not _check_tenants(drivers, "phase2"):
+                return 1
+            for i, proc in enumerate(procs):
+                if not _sigterm_drain(proc, f"phase2 r{i}"):
+                    return 1
+            print("phase2: PASS — mismatch in "
+                  f"{caught} cycle(s), golden-match SLO burned, "
+                  "evidence bundle readable, tenants whole, "
+                  "drains exit 0")
+            return 0
+        finally:
+            for proc in procs:
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+
+def main():
+    for phase in (phase1_steady_green, phase2_divergence_caught):
+        rc = phase()
+        if rc:
+            return rc
+    print("probe_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,31 @@ def parse_samples(text):
     return out
 
 
+#: the blackbox-prober gauge families (ISSUE 18) a probe-armed server
+#: must expose: one per `probe.*` registry gauge set every cycle.  The
+#: probe smoke lints a live scrape against these; counters
+#: (``hyperopt_tpu_probe_verdict_*_total``) are per-verdict-lazy, so
+#: only the unconditional families are required.
+PROBE_FAMILIES = (
+    "hyperopt_tpu_probe_cycles",
+    "hyperopt_tpu_probe_last_verdict_code",
+    "hyperopt_tpu_probe_golden_match_streak",
+    "hyperopt_tpu_probe_last_cycle_ts",
+    "hyperopt_tpu_probe_targets",
+)
+
+
+def validate_probe_families(text):
+    """Full exposition lint PLUS presence of every probe gauge family —
+    the check a probe-armed scrape must pass (empty = valid)."""
+    errors = validate_metrics_text(text)
+    names = {name for name, _ in parse_samples(text)}
+    for fam in PROBE_FAMILIES:
+        if fam not in names:
+            errors.append(f"probe-armed scrape lacks family {fam!r}")
+    return errors
+
+
 _SNAPSHOT_SECTIONS = ("report", "health", "utilization", "ask_pipeline")
 
 
@@ -282,6 +307,10 @@ def main(argv=None):
     p.add_argument("--self-test", action="store_true",
                    help="arm the server on a short real fmin and validate "
                         "a mid-run scrape end to end (the CI gate)")
+    p.add_argument("--require-probe", action="store_true",
+                   help="additionally require the hyperopt_tpu_probe_* "
+                        "gauge families in every metrics payload (a "
+                        "probe-armed server's scrape contract)")
     args = p.parse_args(argv)
     if args.self_test:
         return _self_test()
@@ -301,7 +330,8 @@ def main(argv=None):
             except ValueError as e:
                 errors = [f"not JSON: {e}"]
         else:
-            errors = validate_metrics_text(body)
+            errors = (validate_probe_families(body) if args.require_probe
+                      else validate_metrics_text(body))
         if errors:
             rc = 1
             print(f"{path}: INVALID")

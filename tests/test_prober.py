@@ -1,0 +1,608 @@
+"""ISSUE 18: the blackbox prober & continuous correctness audit.
+
+The acceptance pins:
+
+* the committed golden fixture really is what the serving path emits:
+  a pinned canary driven through the REAL handler path digests to the
+  fixture entry bitwise — when this fails, either the proposal stream
+  regressed or an intentional algorithm change needs
+  ``python -m hyperopt_tpu.obs.prober --regen-golden`` and review;
+* corruption on the serving path turns the verdict red within bounded
+  cycles, with an honest fake-clock detection latency, an evidence
+  bundle, and ONE edge-triggered escalation per red episode;
+* canary traffic is free: armed == disarmed tenant proposals
+  bit-identical (directly AND over HTTP), and canary studies never
+  touch the quality plane, the cost ledger, or the tenant SLOs;
+* verdict ledgers are CRC-sealed and torn-tolerant, read back with the
+  census discipline (corrupt counted, torn tail silent);
+* the probe SLO objectives exist only when the prober is armed.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from hyperopt_tpu import chaos, hp
+from hyperopt_tpu._env import (
+    parse_probe,
+    parse_probe_period,
+    parse_probe_slo,
+)
+from hyperopt_tpu.obs.prober import (
+    CANARY,
+    ProbeLedger,
+    Prober,
+    _LocalTransport,
+    canary_key,
+    detection_stats,
+    load_golden,
+    local_digest,
+    probes_path_for,
+    read_probes,
+    stream_digest,
+)
+from hyperopt_tpu.obs.quality import QualityPlane
+from hyperopt_tpu.obs.slo import PROBE_TARGETS, SLOPlane
+from hyperopt_tpu.service import integrity
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _local_server():
+    sched = StudyScheduler(wal=False, quality=False)
+    return ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+
+
+def _local_prober(srv, **kw):
+    kw.setdefault("transport_factory",
+                  lambda url: _LocalTransport(srv))
+    kw.setdefault("period", 30.0)
+    return Prober(["local://srv"], **kw)
+
+
+# ---------------------------------------------------------------------------
+# the golden fixture
+# ---------------------------------------------------------------------------
+
+
+def test_golden_digest_matches_committed_fixture():
+    """THE regression pin: the serving path's canary stream must digest
+    to the committed fixture bitwise."""
+    golden = load_golden(CANARY)
+    if golden is None:
+        pytest.skip("no committed golden for this backend (TOFU mode)")
+    digest, flagged = local_digest(CANARY)
+    assert not flagged, "canary stream came back degraded/warming"
+    assert digest == golden, (
+        f"canary proposal stream digest {digest} != committed golden "
+        f"{golden} for {canary_key(CANARY)}.  Either the proposal "
+        "path regressed (find it before shipping) or an intentional "
+        "algorithm change moved the stream — then regenerate and "
+        "review the fixture: python -m hyperopt_tpu.obs.prober "
+        "--regen-golden")
+
+
+def test_local_digest_is_deterministic():
+    a, _ = local_digest(CANARY)
+    b, _ = local_digest(CANARY)
+    assert a == b
+
+
+def test_stream_digest_canonical_and_wire_stable():
+    stream = [{"tid": 0, "params": {"x": 0.1 + 0.2, "y": -3.5}},
+              {"tid": 1, "params": {"y": 1e-17, "x": 2.0}}]
+    d1 = stream_digest(stream)
+    # a JSON wire round trip must not move the digest (shortest-repr)
+    d2 = stream_digest(json.loads(json.dumps(stream)))
+    # key order must not matter (canonical sort)
+    d3 = stream_digest([{"params": dict(reversed(list(
+        e["params"].items()))), "tid": e["tid"]} for e in stream])
+    assert d1 == d2 == d3
+    assert d1 != stream_digest(
+        [{"tid": 0, "params": {"x": 0.30000000000000010, "y": -3.5}},
+         stream[1]])
+
+
+def test_canary_key_pins_every_config_axis():
+    base = canary_key()
+    assert base == canary_key(CANARY)
+    for knob, val in (("seed", 7), ("asks", 9), ("n_startup", 1),
+                      ("n_ei", 8), ("zoo", "other")):
+        assert canary_key({knob: val}) != base
+
+
+# ---------------------------------------------------------------------------
+# cycles, verdicts, detection
+# ---------------------------------------------------------------------------
+
+
+def test_clean_cycle_is_ok_green_and_sealed(tmp_path):
+    srv = _local_server()
+    led = probes_path_for(tmp_path, "r0")
+    p = _local_prober(srv, ledger_path=led, replica="r0",
+                      clock=lambda: 1000.0)
+    s = p.run_cycle(now=1000.0)
+    assert s["verdict"] == "ok" and not s["diverged"]
+    assert p.green(now=1000.0)
+    assert p.streak == 1
+    recs, corrupt, torn = read_probes(led)
+    assert corrupt == 0 and torn == 0
+    assert [r["verdict"] for r in recs] == ["ok"]
+    assert recs[0]["replica"] == "r0"
+    assert recs[0]["canary"] == canary_key(CANARY)
+    assert recs[0]["digest"]
+    h = p.healthz_fields(now=1000.0)
+    assert h["green"] and h["last_verdict"] == "ok"
+    assert h["golden_match_streak"] == 1
+
+
+def test_corruption_detected_with_fake_clock_latency(tmp_path):
+    srv = _local_server()
+    led = probes_path_for(tmp_path, "r0")
+    p = _local_prober(srv, ledger_path=led)
+    assert p.run_cycle(now=100.0)["verdict"] == "ok"
+    # silent float corruption on the serving readback path: no flag, no
+    # error — exactly the failure the blackbox exists to catch
+    chaos.configure("7:corrupt@tick:1.0")
+    s = p.run_cycle(now=107.0)
+    assert s["verdict"] == "mismatch"
+    assert s["detection_latency_sec"] == pytest.approx(7.0)
+    assert p.streak == 0 and not p.green(now=107.0)
+    # the ledger agrees: detection_stats recomputes the same latency
+    recs, _, _ = read_probes(led)
+    st = detection_stats(recs)
+    assert st["episodes"] == 1
+    assert st["mean_sec"] == pytest.approx(7.0)
+    # evidence bundle written and readable
+    ev = [r.get("evidence") for r in recs if r.get("evidence")]
+    assert ev, "mismatch verdict carries no evidence bundle"
+    with open(os.path.join(ev[-1], "bundle.json"),
+              encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["verdict"] == "mismatch"
+
+
+def test_escalation_is_once_per_episode(tmp_path):
+    srv = _local_server()
+    p = _local_prober(srv, escalation_cooldown=0.0,
+                      profile_capture=False)
+    assert p.run_cycle(now=10.0)["verdict"] == "ok"
+    chaos.configure("7:corrupt@tick:1.0")
+    for i, now in enumerate((20.0, 30.0, 40.0)):
+        assert p.run_cycle(now=now)["verdict"] == "mismatch"
+    assert p.escalations == 1, "a red STREAK must escalate once"
+    chaos.configure(None)
+    assert p.run_cycle(now=50.0)["verdict"] == "ok"
+    chaos.configure("7:corrupt@tick:1.0")
+    assert p.run_cycle(now=60.0)["verdict"] != "ok"
+    assert p.escalations == 2, "a new episode escalates again"
+
+
+def test_error_verdict_fail_open_never_raises():
+    class Boom:
+        def request(self, *a, **kw):
+            raise RuntimeError("probe transport exploded")
+
+    p = Prober(["local://x"], transport_factory=lambda url: Boom(),
+               period=30.0)
+    s = p.run_cycle(now=5.0)
+    assert s["verdict"] == "error"
+    assert not p.green(now=5.0)
+
+
+def test_fleet_divergence_turns_mismatch():
+    """Two replicas answering different clean streams = divergence,
+    even with no golden fixture (TOFU mode)."""
+    srv_a, srv_b = _local_server(), _local_server()
+
+    class Skewed(_LocalTransport):
+        def request(self, method, path, body=None):
+            if path == "/study" and body:
+                body = dict(body, seed=int(body["seed"]) + 1)
+            return super().request(method, path, body)
+
+    transports = {"local://a": _LocalTransport(srv_a),
+                  "local://b": Skewed(srv_b)}
+    p = Prober(["local://a", "local://b"], period=30.0,
+               transport_factory=lambda url: transports[url],
+               golden=None, profile_capture=False)
+    p.golden, p.golden_source = None, "tofu"  # force pure TOFU
+    s = p.run_cycle(now=1.0)
+    assert s["diverged"]
+    assert s["verdict"] == "mismatch"
+
+
+def test_tofu_pins_first_clean_digest():
+    srv = _local_server()
+    p = _local_prober(srv)
+    p.golden, p.golden_source = None, "tofu"
+    assert p.run_cycle(now=1.0)["verdict"] == "ok"
+    assert p.golden is not None          # self-pinned
+    pinned = p.golden
+    assert p.run_cycle(now=2.0)["verdict"] == "ok"
+    assert p.golden == pinned
+
+
+def test_prober_thread_starts_and_stops():
+    srv = _local_server()
+    p = _local_prober(srv, period=0.05)
+    names = lambda: {t.name for t in threading.enumerate()}  # noqa: E731
+    assert "hyperopt-prober" not in names()
+    p.start()
+    assert "hyperopt-prober" in names()
+    deadline = time.monotonic() + 10.0
+    while p.cycles < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    p.stop()
+    assert "hyperopt-prober" not in names()
+    assert p.cycles >= 1 and p.last["verdict"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# the sealed ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_corrupt_line_counted_torn_tail_silent(tmp_path):
+    led = str(tmp_path / "r0.jsonl")
+    L = ProbeLedger(led)
+    for i in range(3):
+        L.append({"kind": "probe", "cycle": i, "ts": float(i),
+                  "verdict": "ok"})
+    with open(led, "ab") as f:
+        f.write(b'{"kind": "probe", "torn-no-newline')
+    data = open(led, "rb").read()
+    # sealed lines are canonical compact JSON (no spaces after ':')
+    flipped = data.replace(b'"cycle":1', b'"cycle":9', 1)
+    assert flipped != data
+    with open(led, "wb") as f:
+        f.write(flipped)
+    recs, corrupt, torn = read_probes(led)
+    assert corrupt == 1 and torn == 1
+    assert [r["cycle"] for r in recs] == [0, 2]
+
+
+def test_ledger_append_fail_open(tmp_path, caplog):
+    L = ProbeLedger(str(tmp_path / "nope" / "x" / "r0.jsonl"))
+    os.makedirs(os.path.dirname(os.path.dirname(L.path)))
+    with open(os.path.dirname(os.path.dirname(L.path)) + "/x", "w"):
+        pass  # a FILE where the dir should be → OSError on makedirs
+    L.append({"kind": "probe", "verdict": "ok"})  # must not raise
+    L.append({"kind": "probe", "verdict": "ok"})  # warn-once latch
+
+
+def test_ledger_lines_are_integrity_sealed(tmp_path):
+    led = str(tmp_path / "r0.jsonl")
+    ProbeLedger(led).append({"kind": "probe", "cycle": 1,
+                             "verdict": "ok"})
+    line = open(led, encoding="utf-8").read().strip()
+    checked = list(integrity.iter_checked_jsonl(led))
+    assert len(checked) == 1 and checked[0].status == integrity.OK
+    assert integrity.CHECKSUM_FIELD in json.loads(line)
+
+
+# ---------------------------------------------------------------------------
+# canary traffic is free
+# ---------------------------------------------------------------------------
+
+
+def _drive_direct(sched, sid, n):
+    out = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        out.append((a["tid"], repr(a["params"]["x"])))
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+    return out
+
+
+def test_armed_equals_disarmed_bit_identical_direct():
+    """Tenant proposals with probe cycles interleaved == without."""
+    on = StudyScheduler(wal=False, quality=False)
+    srv_on = ServiceHTTPServer(0, scheduler=on, trace=False, slo=False)
+    off = StudyScheduler(wal=False, quality=False)
+    p = _local_prober(srv_on)
+
+    sid_on = on.create_study(SPACE, seed=21, n_startup_jobs=2)
+    sid_off = off.create_study(SPACE, seed=21, n_startup_jobs=2)
+    seq_on, seq_off = [], []
+    for i in range(3):
+        assert p.run_cycle(now=float(i))["verdict"] == "ok"
+        seq_on += _drive_direct(on, sid_on, 3)
+        seq_off += _drive_direct(off, sid_off, 3)
+    assert seq_on == seq_off
+
+
+def test_armed_equals_disarmed_bit_identical_over_http():
+    def drive(srv, sid, n):
+        seq = []
+        for _ in range(n):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid})
+            assert code == 200
+            t = a["trials"][0]
+            seq.append((t["tid"], repr(t["params"]["x"])))
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": t["tid"],
+                "loss": float((t["params"]["x"] - 1.0) ** 2)})
+            assert code == 200
+        return seq
+
+    seqs = {}
+    for armed in (True, False):
+        sched = StudyScheduler(wal=False, quality=False)
+        srv = ServiceHTTPServer(0, scheduler=sched, trace=False,
+                                slo=False)
+        p = _local_prober(srv) if armed else None
+        code, r = srv.handle("POST", "/study", {
+            "space": SPACE_SPEC, "seed": 33, "n_startup_jobs": 2})
+        assert code == 200
+        sid = r["study_id"]
+        seq = []
+        for i in range(3):
+            if p is not None:
+                assert p.run_cycle(now=float(i))["verdict"] == "ok"
+            seq += drive(srv, sid, 3)
+        seqs[armed] = seq
+    assert seqs[True] == seqs[False]
+
+
+def test_canary_studies_invisible_to_quality_and_load():
+    from hyperopt_tpu.obs.load import CostLedger
+
+    sched = StudyScheduler(wal=False, quality=QualityPlane(),
+                           load=CostLedger())
+    canary = sched.create_study(SPACE, seed=5, n_startup_jobs=2,
+                                canary=True)
+    tenant = sched.create_study(SPACE, seed=6, n_startup_jobs=2)
+    _drive_direct(sched, canary, 6)
+    _drive_direct(sched, tenant, 6)
+    # quality plane: only the tenant is tracked
+    assert sched.quality.study_status(canary) is None
+    assert sched.quality.study_status(tenant) is not None
+    # cost ledger: the canary is never charged
+    assert sched.load.study_status(canary) is None
+    t = sched.load.study_status(tenant)
+    assert t is not None and t["tells"] == 6
+
+
+def test_canary_flag_rides_status_and_wal_replay(tmp_path):
+    sched = StudyScheduler(store_root=str(tmp_path))
+    sid = sched.create_study(SPACE, seed=5, n_startup_jobs=2,
+                             space_spec={"space": SPACE_SPEC}, canary=True)
+    _drive_direct(sched, sid, 3)
+    assert sched._studies[sid].canary
+    assert sched._studies[sid].status_dict().get("canary") is True
+    del sched  # crash-style: no drain, resume replays the WAL
+    resumed = StudyScheduler(store_root=str(tmp_path),
+                             quality=QualityPlane())
+    assert sid in resumed._studies, "canary study did not resume"
+    assert resumed._studies[sid].canary, \
+        "canary flag lost across WAL replay"
+    assert resumed.quality.study_status(sid) is None
+
+
+def test_probe_header_skips_tenant_slo():
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=True)
+    before = srv.slo.status()
+    code, _ = srv.handle("POST", "/study",
+                         {"space": SPACE_SPEC, "seed": 1,
+                          "canary": True},
+                         headers={"x-probe": "1"})
+    assert code == 200
+    after = srv.slo.status()
+    assert (after["availability"]["window_events"]
+            == before["availability"]["window_events"]), \
+        "probe-tagged requests leaked into the tenant SLO window"
+    code, _ = srv.handle("GET", "/healthz", None)
+    assert code == 200
+
+
+# ---------------------------------------------------------------------------
+# SLO objectives, server surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_probe_objectives_installed_only_when_armed():
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=True)
+    assert "probe_avail" not in srv.slo.status()
+    assert srv.start()
+    try:
+        p = srv.arm_prober(period=30.0)
+        assert p is not None
+        assert srv.arm_prober() is p          # idempotent
+        st = srv.slo.status()
+        for name in PROBE_TARGETS:
+            assert name in st
+    finally:
+        srv.drain()
+
+
+def test_probe_slo_burns_on_mismatch():
+    plane = SLOPlane(clock=lambda: 1000.0)
+    for name, spec in PROBE_TARGETS.items():
+        plane.add_objective(name, spec)
+    srv = _local_server()
+    p = _local_prober(srv, slo=plane)
+    assert p.run_cycle(now=1000.0)["verdict"] == "ok"
+    g0 = plane.status()["probe_golden_match"]
+    assert g0["window_events"] >= 1
+    assert g0["budget_remaining_frac"] == pytest.approx(1.0)
+    chaos.configure("7:corrupt@tick:1.0")
+    assert p.run_cycle(now=1010.0)["verdict"] == "mismatch"
+    g1 = plane.status()["probe_golden_match"]
+    assert g1["window_events"] == g0["window_events"] + 1
+    assert g1["budget_remaining_frac"] < g0["budget_remaining_frac"], \
+        "a golden mismatch must burn probe_golden_match budget"
+    a1 = plane.status()["probe_avail"]
+    assert a1["budget_remaining_frac"] == pytest.approx(1.0), \
+        "mismatch is not an availability failure"
+
+
+def test_server_surfaces_probes_and_healthz():
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    # disarmed: /probes answers, healthz has no probe section
+    code, d = srv.handle("GET", "/probes", None)
+    assert code == 200 and d["armed"] is False
+    code, h = srv.handle("GET", "/healthz", None)
+    assert code == 200 and "probe" not in h
+    assert "probes" not in srv.snapshot_dict()
+    assert srv.start()
+    try:
+        p = srv.arm_prober(period=30.0)
+        p.run_cycle()
+        code, d = srv.handle("GET", "/probes", None)
+        assert code == 200 and d["armed"] is True
+        assert d["cycles"] >= 1 and d["golden_match_streak"] >= 1
+        code, h = srv.handle("GET", "/healthz", None)
+        assert code == 200
+        assert h["ok"] and h["probe"]["green"]
+        snap = srv.snapshot_dict()
+        assert snap["probes"]["armed"] is True
+    finally:
+        srv.drain()
+
+
+def test_metrics_expose_probe_families():
+    from validate_scrape import PROBE_FAMILIES, validate_probe_families
+
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    assert srv.start()
+    try:
+        p = srv.arm_prober(period=30.0)
+        p.run_cycle()
+        # /metrics only exists on the real HTTP dispatch path
+        import urllib.request
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode("utf-8")
+        errors = validate_probe_families(text)
+        assert errors == [], errors
+        for fam in PROBE_FAMILIES:
+            assert fam in text
+    finally:
+        srv.drain()
+
+
+def test_disarmed_prober_costs_nothing():
+    n0 = threading.active_count()
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    assert srv.prober is None
+    assert threading.active_count() == n0
+    code, _ = srv.handle("POST", "/study",
+                         {"space": SPACE_SPEC, "seed": 1})
+    assert code == 200
+    assert srv.prober is None and threading.active_count() == n0
+
+
+# ---------------------------------------------------------------------------
+# knobs, report, restart gate
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_PROBE", raising=False)
+    assert parse_probe() is False             # default OFF
+    monkeypatch.setenv("HYPEROPT_TPU_PROBE", "1")
+    assert parse_probe() is True
+    monkeypatch.setenv("HYPEROPT_TPU_PROBE_PERIOD", "2.5")
+    assert parse_probe_period() == 2.5
+    monkeypatch.setenv("HYPEROPT_TPU_PROBE_PERIOD", "bogus")
+    assert parse_probe_period() == 30.0       # warn-once fallback
+    monkeypatch.delenv("HYPEROPT_TPU_PROBE_SLO", raising=False)
+    assert parse_probe_slo() == PROBE_TARGETS
+    monkeypatch.setenv("HYPEROPT_TPU_PROBE_SLO", "off")
+    assert parse_probe_slo() is None
+    monkeypatch.setenv("HYPEROPT_TPU_PROBE_SLO",
+                       "avail=99.5,ask_p99_ms=500")
+    cfg = parse_probe_slo()
+    assert cfg["probe_avail"]["target"] == 0.995
+    assert cfg["probe_ask_p99_ms"]["threshold_ms"] == 500.0
+
+
+def test_report_probes_view(tmp_path):
+    from hyperopt_tpu.obs.report import main as report_main
+
+    led = probes_path_for(tmp_path, "r1")
+    L = ProbeLedger(led)
+    L.append({"kind": "probe", "cycle": 1, "ts": 10.0, "verdict": "ok",
+              "replica": "r1", "target": "u", "golden": "abc",
+              "golden_source": "fixture", "canary": canary_key(),
+              "backend": "cpu"})
+    L.append({"kind": "probe", "cycle": 2, "ts": 14.0,
+              "verdict": "mismatch", "why": "digest drift",
+              "replica": "r1", "target": "u", "golden": "abc",
+              "golden_source": "fixture", "canary": canary_key(),
+              "backend": "cpu"})
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = report_main(["--probes", str(tmp_path)])
+    assert rc == 0
+    text = buf.getvalue()
+    assert "blackbox probes" in text
+    assert "mismatch" in text and "4.00s" in text
+
+
+def test_blackbox_green_gate(monkeypatch):
+    import fleet_restart
+
+    answers = {}
+    monkeypatch.setattr(fleet_restart, "fetch_healthz",
+                        lambda url, timeout=3.0: answers.get(url))
+    # all disarmed: green (the gate never manufactures a signal)
+    answers["a"] = {"ok": True}
+    answers["b"] = {"ok": True}
+    assert fleet_restart.blackbox_green(["a", "b"])
+    # an armed red replica vetoes
+    answers["b"] = {"ok": True, "probe": {"green": False,
+                                          "last_verdict": "mismatch"}}
+    assert not fleet_restart.blackbox_green(["a", "b"])
+    # armed green passes; a dead replica vetoes
+    answers["b"] = {"ok": True, "probe": {"green": True}}
+    assert fleet_restart.blackbox_green(["a", "b"])
+    answers["a"] = None
+    assert not fleet_restart.blackbox_green(["a", "b"])
+
+
+def test_prober_cli_runs_bounded_cycles(tmp_path):
+    """The standalone entry point: N cycles against a live HTTP
+    replica, sealed ledger on disk, exit code reflects the verdict."""
+    from hyperopt_tpu.obs.prober import main as prober_main
+
+    sched = StudyScheduler(wal=False, quality=False)
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    assert srv.start()
+    led = str(tmp_path / "cli.jsonl")
+    try:
+        rc = prober_main(["--targets", srv.url, "--cycles", "1",
+                          "--period", "1.0", "--ledger", led,
+                          "--replica", "cli"])
+        assert rc == 0
+        recs, corrupt, _ = read_probes(led)
+        assert corrupt == 0
+        assert [r["verdict"] for r in recs] == ["ok"]
+    finally:
+        srv.drain()
