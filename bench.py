@@ -2171,6 +2171,166 @@ def bench_pallas_ei(n=8192, reps=5, seed=0):
     return out
 
 
+#: the megakernel grid: (hist_cap, n_EI_candidates) — components is cap+1
+_MEGAKERNEL_GRID = ((32, 256), (32, 1024), (128, 1024))
+
+
+def bench_megakernel(reps=4, seed=0):
+    """ISSUE 19 stage: the quantized-history fused-suggest megakernel.
+
+    Three measurements.  (1) Fused (armed: Pallas on TPU, interpret
+    emulation elsewhere) vs unfused (jnp cohort) candidates/sec over a
+    (components, candidates, hist_cap) grid through the REAL
+    study-batched tick program (``tpe.build_suggest_batched`` — the
+    megakernel arms inside it); the largest grid point's armed
+    throughput rides the trajectory as ``megakernel_cand_per_sec``.
+    (2) The int8 resident-history byte fraction at EQUAL ``hist_cap``
+    vs f32 (vals int8 + losses bf16), gated absolute ≤0.30 as
+    ``megakernel_int8_bytes_frac`` — the acceptance criterion that
+    quantization pays for its 4× cap.  (3) The tpe quality keys re-run
+    with the kernel ARMED over a small zoo mix through the real
+    scheduler tick (``armed_*`` keys — the disarmed ``search_quality``
+    table stays the gated series).  On CPU the armed path runs the
+    interpret emulation, so the fused-vs-unfused RATIO is meaningless
+    there — only the armed trend and the byte fraction are (SURVEY.md
+    §4); on a TPU backend the ratio is the tentpole's headline."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu import hp, megakernel
+    from hyperopt_tpu.algos import tpe
+    from hyperopt_tpu.base import Domain, PaddedHistory
+
+    space = {f"x{i}": hp.uniform(f"x{i}", -5, 5) for i in range(6)}
+    cs = Domain(None, space).cs
+    L = len(cs.labels)
+    S, B = 4, 4
+    armed_mode = "1" if megakernel.pallas_available() else "interpret"
+    rng = np.random.default_rng(seed)
+    seeds = np.stack([tpe._seed_words(1000 + s) for s in range(S)])
+    ids = np.asarray([[3 + s * B + j for j in range(B)]
+                      for s in range(S)], np.uint32)
+
+    def stack_at(cap, n_live):
+        devs = []
+        for _ in range(S):
+            vals = {l: np.zeros(cap, np.float32) for l in cs.labels}
+            act = {l: np.zeros(cap, bool) for l in cs.labels}
+            losses = np.full(cap, np.inf, np.float32)
+            has = np.zeros(cap, bool)
+            for i in range(n_live):
+                for l in cs.labels:
+                    vals[l][i] = rng.uniform(-4, 4)
+                    act[l][i] = True
+                losses[i] = rng.uniform()
+                has[i] = True
+            devs.append(
+                {"vals": {l: jnp.asarray(vals[l]) for l in cs.labels},
+                 "active": {l: jnp.asarray(act[l]) for l in cs.labels},
+                 "losses": jnp.asarray(losses),
+                 "has_loss": jnp.asarray(has)})
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *devs)
+
+    def measure(cap, n_cand, mode):
+        os.environ["HYPEROPT_TPU_MEGAKERNEL"] = mode
+        cfg = {"prior_weight": 1.0, "n_EI_candidates": n_cand,
+               "gamma": 0.25, "LF": 25, "ei_select": "argmax",
+               "ei_tau": 1.0, "prior_eps": 0.0}
+        fn = tpe.build_suggest_batched(cs, cfg, S, cap, B, donate=False)
+        stack = stack_at(cap, n_live=cap // 2)
+        rows = np.zeros((S, 16, 2 * L + 3), np.float32)
+        rows[:, :, -1] = cap
+        jax.block_until_ready(fn(stack, rows, seeds, ids))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fn(stack, rows, seeds, ids)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / reps
+
+    prev = os.environ.get("HYPEROPT_TPU_MEGAKERNEL")
+    out = {"S": S, "B": B, "reps": reps, "armed_mode": armed_mode,
+           "bar": "int8 history <= 0.3x f32 bytes at equal cap",
+           "by_point": {}}
+    try:
+        gated = None
+        for cap, n_cand in _MEGAKERNEL_GRID:
+            dt_off = measure(cap, n_cand, "off")
+            dt_on = measure(cap, n_cand, armed_mode)
+            n_prop = S * B * n_cand
+            entry = {"components": cap + 1, "candidates": n_cand,
+                     "hist_cap": cap,
+                     "unfused_cand_per_sec": n_prop / dt_off,
+                     "fused_cand_per_sec": n_prop / dt_on,
+                     "fused_speedup": dt_off / max(dt_on, 1e-12)}
+            out["by_point"][f"m{cap + 1}_c{n_cand}"] = entry
+            gated = entry["fused_cand_per_sec"]
+        out["megakernel_cand_per_sec"] = gated  # largest grid point
+        out["megakernel_fallbacks"] = megakernel.fallback_count()
+
+        # int8 vs f32 resident history bytes at the SAME cap (the bf16
+        # comparison in the sharded_suggest stage, pushed to codes)
+        def hist_bytes(dtype):
+            ph = PaddedHistory(cs.labels, hist_dtype=dtype)
+            ph.ensure_qparams(cs)
+            for i in range(100):
+                ph.append({l: float(i % 7) - 3.0 for l in cs.labels},
+                          float(i))
+            dev = ph.device_view()
+            return int(sum(dev["vals"][l].nbytes for l in cs.labels)
+                       + dev["losses"].nbytes)
+
+        f32b, i8b = hist_bytes("float32"), hist_bytes("int8")
+        out["history_bytes_f32"] = f32b
+        out["history_bytes_int8"] = i8b
+        out["megakernel_int8_bytes_frac"] = i8b / max(f32b, 1)
+
+        # tpe quality keys re-run ARMED over a small zoo mix through the
+        # real scheduler tick — visibility, not the gated series
+        os.environ["HYPEROPT_TPU_MEGAKERNEL"] = armed_mode
+        from hyperopt_tpu.obs.quality import summarize_run
+        from hyperopt_tpu.service.scheduler import StudyScheduler
+        from hyperopt_tpu.zoo import make_study_mix
+
+        items = make_study_mix(3, 1)
+        sched = StudyScheduler(wal=False)
+        sids = [sched.create_study(m.domain.space, seed=m.seed,
+                                   n_startup_jobs=5) for m in items]
+        done = [0] * len(items)
+        while any(done[i] < items[i].budget for i in range(len(items))):
+            wave = [(sids[i], min(2, items[i].budget - done[i]))
+                    for i in range(len(items))
+                    if done[i] < items[i].budget]
+            answers = sched.ask_many(wave)
+            for i, m in enumerate(items):
+                for a in answers.get(sids[i], ()):
+                    sched.tell(sids[i], a["tid"],
+                               float(m.domain.objective(a["params"])))
+                    done[i] += 1
+        t2t, regrets, solved = [], [], 0
+        for i, m in enumerate(items):
+            s = summarize_run(
+                list(sched._studies[sids[i]].trials.losses())[:m.budget],
+                m.budget, loss_target=m.domain.loss_target,
+                optimum=m.domain.optimum)
+            t2t.append(s["trials_to_target"])
+            solved += 1 if s["solved"] else 0
+            if s["final_regret"] is not None:
+                regrets.append(s["final_regret"])
+        out["armed_trials_to_target_tpe"] = float(np.mean(t2t))
+        if regrets:
+            out["armed_final_regret_tpe"] = float(np.mean(regrets))
+        out["armed_solved_frac_tpe"] = solved / len(items)
+        out["armed_quality_fallbacks"] = megakernel.fallback_count()
+    finally:
+        if prev is None:
+            os.environ.pop("HYPEROPT_TPU_MEGAKERNEL", None)
+        else:
+            os.environ["HYPEROPT_TPU_MEGAKERNEL"] = prev
+    return out
+
+
 # ---------------------------------------------------------------------------
 # hang-proof orchestration (see module docstring)
 # ---------------------------------------------------------------------------
@@ -2220,6 +2380,11 @@ _JAX_STAGES = (
     # jnp-vs-pallas EI crossover by component count (ISSUE 6 satellite):
     # keeps pallas_ei.py's MEASURED VERDICT current; jnp-only off TPU
     ("pallas_ei", bench_pallas_ei),
+    # ISSUE 19: quantized-history fused-suggest megakernel — fused vs
+    # unfused cand/sec by (components, candidates, hist_cap), the int8
+    # byte fraction at equal cap (gated ≤0.30 absolute), and the tpe
+    # quality keys re-run with the kernel armed
+    ("megakernel", bench_megakernel),
     # ISSUE 9 headline: 1k concurrent studies batched onto cohort ticks —
     # studies/sec, per-ask p99, slot utilization, vs the sequential loop
     ("multi_study", bench_multi_study),
@@ -2555,6 +2720,19 @@ def main():
                       "attribution_overhead_frac",
                       "attribution_overhead_us_per_tell",
                       "shard_heat_skew")}
+    # the megakernel stage (ISSUE 19) rides along: armed cand/sec at the
+    # largest grid point, the int8 byte fraction at equal cap, and the
+    # armed tpe quality re-run over the small zoo mix
+    rec = stages.get("megakernel")
+    if rec and rec.get("ok"):
+        obs_summary["megakernel"] = {
+            k: rec["result"].get(k)
+            for k in ("armed_mode", "megakernel_cand_per_sec",
+                      "megakernel_int8_bytes_frac",
+                      "megakernel_fallbacks",
+                      "armed_trials_to_target_tpe",
+                      "armed_final_regret_tpe",
+                      "armed_solved_frac_tpe")}
     # the blackbox-prober bars (ISSUE 18): tenant overhead with a hot
     # prober armed + chaos inject→detect latency
     rec = stages.get("blackbox_probe")
@@ -2653,6 +2831,10 @@ def main():
                 "blackbox_probe", "probe_overhead_frac"),
             "probe_detection_latency_sec": _stage_val(
                 "blackbox_probe", "probe_detection_latency_sec"),
+            "megakernel_cand_per_sec": _stage_val(
+                "megakernel", "megakernel_cand_per_sec"),
+            "megakernel_int8_bytes_frac": _stage_val(
+                "megakernel", "megakernel_int8_bytes_frac"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

@@ -24,6 +24,7 @@ __all__ = [
     "parse_shard",
     "parse_hist_shard_min",
     "parse_pallas",
+    "parse_megakernel",
     "parse_allgather_timeout",
     "parse_service",
     "parse_service_max_studies",
@@ -114,18 +115,28 @@ def parse_devmem_period(env=None):
 # vars: a bad value must never take down the run it would have tuned.
 
 def parse_hist_dtype(env=None):
-    """``HYPEROPT_TPU_HIST_DTYPE=bf16|f32`` → the DEVICE storage dtype name
-    for the padded-history mirror (``"bfloat16"`` or ``"float32"``, default
-    f32).  The host numpy arrays stay float32 and authoritative either way
-    — pickle/checkpoint never see the compressed form; kernels accumulate
-    in f32 after an on-read upcast (docs/DESIGN.md §13)."""
+    """``HYPEROPT_TPU_HIST_DTYPE=int8|fp8|bf16|f32`` → the DEVICE storage
+    dtype name for the padded-history mirror (default f32).  The host
+    numpy arrays stay float32 and authoritative either way —
+    pickle/checkpoint never see the compressed form; kernels accumulate
+    in f32 after an on-read upcast (docs/DESIGN.md §13).
+
+    ``int8``/``fp8`` (ISSUE 19) store affine-quantized history codes
+    (``quant.py``; per-label scale/zero derived from the space, losses
+    kept bf16) so the same HBM holds 4x the bf16 ``hist_cap``; spaces or
+    backends the code cannot represent degrade that history to bf16 with
+    a warn-once, never failing an ask (docs/DESIGN.md §25)."""
     env = os.environ if env is None else env
     raw = env.get("HYPEROPT_TPU_HIST_DTYPE", "").strip().lower()
     if raw in ("", "f32", "fp32", "float32"):
         return "float32"
     if raw in ("bf16", "bfloat16"):
         return "bfloat16"
-    _warn_once("HYPEROPT_TPU_HIST_DTYPE", raw, "one of bf16|f32")
+    if raw in ("int8", "i8"):
+        return "int8"
+    if raw in ("fp8", "f8", "float8", "float8_e4m3fn"):
+        return "fp8"
+    _warn_once("HYPEROPT_TPU_HIST_DTYPE", raw, "one of int8|fp8|bf16|f32")
     return "float32"
 
 
@@ -179,13 +190,48 @@ def parse_hist_shard_min(env=None):
 
 
 def parse_pallas(env=None):
-    """``HYPEROPT_TPU_PALLAS=1`` → route the un-quantized numeric EI score
-    through ``pallas_ei.ei_diff`` (opt-in; the large-component regime the
-    MEASURED VERDICT in pallas_ei.py identifies).  ``ei_diff`` itself falls
-    back to the jnp twin off-TPU, so arming this flag is always safe."""
+    """``HYPEROPT_TPU_PALLAS=1`` → DEPRECATED alias for
+    ``HYPEROPT_TPU_MEGAKERNEL=1`` (ISSUE 19): routes the numeric EI score
+    through the hand-scheduled ``megakernel.ei_diff`` pair.  Still safe to
+    arm (the kernel falls back to the jnp twin off-TPU), but new deploys
+    should set ``HYPEROPT_TPU_MEGAKERNEL``, which fuses the WHOLE ask tick
+    rather than the single EI op (docs/DESIGN.md §25).  Warns once."""
     env = os.environ if env is None else env
     raw = env.get("HYPEROPT_TPU_PALLAS", "").strip().lower()
-    return raw not in ("", "0", "off", "false", "no")
+    armed = raw not in ("", "0", "off", "false", "no")
+    if armed and "HYPEROPT_TPU_PALLAS" not in _warned_envs:
+        _warned_envs.add("HYPEROPT_TPU_PALLAS")
+        logger.warning(
+            "HYPEROPT_TPU_PALLAS is deprecated (single-op EI kernel); set "
+            "HYPEROPT_TPU_MEGAKERNEL=1 for the fused ask-tick kernel "
+            "(docs/DESIGN.md §25). Honoring the alias this run.")
+    return armed
+
+
+def parse_megakernel(env=None):
+    """``HYPEROPT_TPU_MEGAKERNEL`` → arming mode for the fused ask-tick
+    Pallas megakernel (ISSUE 19, ``megakernel.py``):
+
+    * unset / ``0`` / ``off`` → ``"off"`` — the jnp program, byte-identical
+      to previous rounds;
+    * ``1`` / ``on`` → ``"on"`` — fuse the tick on TPU backends; any
+      non-TPU backend or lowering failure falls back to the jnp program
+      with a warn-once counter (never fails an ask);
+    * ``interpret`` → ``"interpret"`` — run the kernel through the Pallas
+      interpreter on any backend (CPU CI exercises the real kernel body;
+      orders of magnitude slower — tests only).
+
+    Anything else warns once and stays off."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_MEGAKERNEL", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return "off"
+    if raw in ("1", "on", "true", "yes"):
+        return "on"
+    if raw == "interpret":
+        return "interpret"
+    _warn_once("HYPEROPT_TPU_MEGAKERNEL", raw, "one of 1|0|interpret")
+    return "off"
 
 
 def parse_allgather_timeout(env=None):

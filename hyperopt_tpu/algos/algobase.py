@@ -82,6 +82,25 @@ class SuggestAlgo:
             "vals": history["vals"],
             "active": history["active"],
         }
+        # quantized mirrors (ISSUE 19): generic suggesters consume plain
+        # floats — decode any int8/fp8 affine-coded leaf at this read
+        # boundary (one dequant per leaf; f32 out), so subclass kernels
+        # never see storage codes.  Histories that never armed qparams
+        # mirror as bf16 and pass through untouched.
+        ph = trials.history_object(domain.cs.labels)
+        if getattr(ph, "qparams", None) is not None:
+            import jax.numpy as jnp
+
+            from .. import quant
+
+            def _decode(l, v):
+                v = jnp.asarray(v)
+                if quant.quant_dtype_name(v.dtype) is not None:
+                    return quant.dequantize(v, ph.qparams[l])
+                return v
+
+            hist_arrays["vals"] = {
+                l: _decode(l, v) for l, v in hist_arrays["vals"].items()}
         run = self._get_jit(domain, cfg)
         seed = int(seed)
         seed_words = np.asarray(

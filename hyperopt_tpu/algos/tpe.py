@@ -594,30 +594,31 @@ def _prior_draw_numeric(key, prior_mu, prior_sigma, low, high, q, log_space):
 
 
 def _pallas_armed():
-    """``HYPEROPT_TPU_PALLAS=1`` routes the un-quantized numeric EI score
-    through the fused pallas kernel (``pallas_ei.ei_diff``) — opt-in for
-    the large-component regime where the jnp path's ``[m, n]``
-    intermediate stops fitting VMEM (see the MEASURED VERDICT in
-    pallas_ei.py).  Checked at TRACE time; callers that cache traced
-    programs must fold this flag into their cache key."""
-    from .._env import parse_pallas
+    """Hand-scheduled EI is opt-in via ``HYPEROPT_TPU_MEGAKERNEL`` (or the
+    deprecated ``HYPEROPT_TPU_PALLAS=1`` alias): the un-quantized numeric
+    EI score routes through ``megakernel.ei_diff`` — the large-component
+    regime where the jnp path's ``[m, n]`` intermediate stops fitting VMEM
+    (docs/DESIGN.md §25 "when hand-scheduling pays").  Checked at TRACE
+    time; callers that cache traced programs must fold this flag into
+    their cache key."""
+    from .._env import parse_megakernel, parse_pallas
 
-    return parse_pallas()
+    return parse_pallas() or parse_megakernel() != "off"
 
 
 def _ei_pallas(samples, log_space, wb, mb, sb, wa, ma, sa, low, high):
-    """EI = lpdf_below − lpdf_above via ``pallas_ei.ei_diff`` for the
+    """EI = lpdf_below − lpdf_above via ``megakernel.ei_diff`` for the
     un-quantized families.  The kernel computes the raw two-mixture
     log-density difference; the truncation normalizers (``log p_accept``)
     are scalars applied here, and the per-sample Jacobian of the log-space
     density cancels in the difference — so this matches the jnp path's
     math exactly (up to fp reassociation; tests pin 1e-4 agreement)."""
-    from .. import pallas_ei
+    from .. import megakernel
 
     x = jnp.log(jnp.maximum(samples, EPS)) if log_space else samples
     _, _, _, pb = _trunc_masses(wb, mb, sb, low, high)
     _, _, _, pa = _trunc_masses(wa, ma, sa, low, high)
-    return (pallas_ei.ei_diff(x, wb, mb, sb, wa, ma, sa)
+    return (megakernel.ei_diff(x, wb, mb, sb, wa, ma, sa)
             - jnp.log(jnp.maximum(pb, EPS)) + jnp.log(jnp.maximum(pa, EPS)))
 
 
@@ -989,7 +990,37 @@ def _propose_discrete(key, dist, vals, below_mask, above_mask, cfg,
     return val + offset, ei_out, stats
 
 
-def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
+def _read_vals(history, label, qparams=None):
+    """f32 view of one label's vals column — THE kernel read boundary for
+    compressed history (docs/DESIGN.md §13/§25): float storage (f32/bf16)
+    upcasts, int8/fp8 codes affine-decode with the label's baked
+    ``(scale, zero, islog)``.  The branch is decided at TRACE time from
+    the leaf dtype, so a degraded (bf16) history and an armed (quantized)
+    one compile distinct-but-correct programs from the same builder."""
+    v = jnp.asarray(history["vals"][label])
+    if qparams is not None:
+        from .. import quant
+
+        if quant.quant_dtype_name(v.dtype) is not None:
+            return quant.dequantize(v, qparams[label])
+    return v.astype(jnp.float32)
+
+
+def _quant_qparams(cs, hist_dtype):
+    """Per-label qparams for a RESOLVED storage name (None unless
+    ``hist_dtype`` is int8/fp8) — deterministic from (space, name), which
+    is why jit cache keys only need the name, not the values."""
+    if hist_dtype is None:
+        return None
+    from .. import quant
+
+    if not quant.is_quant_name(hist_dtype):
+        return None
+    return quant.space_qparams(cs, hist_dtype)
+
+
+def build_propose_with_scores(cs, cfg, group=True, diagnostics=False,
+                              qparams=None):
     """Compile one proposal step returning per-label ``(value, EI score)``.
 
     ``diagnostics=True`` builds the health-instrumented variant:
@@ -1065,8 +1096,7 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
             keys = jnp.stack([
                 jax.random.fold_in(key, label_hash(l)) for l in ls
             ])
-            obs = jnp.stack([jnp.asarray(history["vals"][l]).astype(
-                jnp.float32) for l in ls])
+            obs = jnp.stack([_read_vals(history, l, qparams) for l in ls])
             act = jnp.stack([jnp.asarray(history["active"][l]) for l in ls])
             return keys, obs, below[None, :] & act, above[None, :] & act
 
@@ -1089,7 +1119,7 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
             if label in grouped:
                 continue
             info = cs.params[label]
-            vals = jnp.asarray(history["vals"][label]).astype(jnp.float32)
+            vals = _read_vals(history, label, qparams)
             active = jnp.asarray(history["active"][label])
             k = jax.random.fold_in(key, label_hash(label))
             b = below & active
@@ -1112,7 +1142,7 @@ def build_propose_with_scores(cs, cfg, group=True, diagnostics=False):
     return propose
 
 
-def build_propose(cs, cfg, group=True):
+def build_propose(cs, cfg, group=True, qparams=None):
     """Compile one proposal step for a CompiledSpace.
 
     Returns a pure function ``propose(history, key) -> {label: value}``:
@@ -1122,7 +1152,7 @@ def build_propose(cs, cfg, group=True):
     (tpe.py sym: build_posterior, suggest).  See
     ``build_propose_with_scores`` for the grouped-pipeline details.
     """
-    scored = build_propose_with_scores(cs, cfg, group=group)
+    scored = build_propose_with_scores(cs, cfg, group=group, qparams=qparams)
 
     def propose(history, key):
         return {l: v for l, (v, _) in scored(history, key).items()}
@@ -1130,7 +1160,7 @@ def build_propose(cs, cfg, group=True):
     return propose
 
 
-def build_propose_candidates(cs, cfg):
+def build_propose_candidates(cs, cfg, qparams=None):
     """Compile the RAW candidate pool: ``propose(history, key) -> {label:
     (samples[n_EI_candidates], ei[n_EI_candidates])}`` — the
     selection-free variant of :func:`build_propose_with_scores`.
@@ -1142,7 +1172,8 @@ def build_propose_candidates(cs, cfg):
     live inside the per-device kernel.  Per-label kernels (not the grouped
     pipeline): the sharded path runs few labels against very wide
     candidate axes, the regime where per-label trace size is irrelevant
-    and the pallas EI opt-in (``HYPEROPT_TPU_PALLAS=1``) applies."""
+    and the hand-scheduled EI opt-in (``HYPEROPT_TPU_MEGAKERNEL``, or the
+    deprecated ``HYPEROPT_TPU_PALLAS=1``) applies."""
 
     def propose(history, key):
         losses = jnp.asarray(history["losses"]).astype(jnp.float32)
@@ -1152,7 +1183,7 @@ def build_propose_candidates(cs, cfg):
         out = {}
         for label in cs.labels:
             info = cs.params[label]
-            vals = jnp.asarray(history["vals"][label]).astype(jnp.float32)
+            vals = _read_vals(history, label, qparams)
             active = jnp.asarray(history["active"][label])
             k = jax.random.fold_in(key, label_hash(label))
             b = below & active
@@ -1173,7 +1204,7 @@ def build_propose_candidates(cs, cfg):
 _suggest_jit_cache = LRUCache(32)
 
 
-def _apply_rows(labels, history, rows):
+def _apply_rows(labels, history, rows, qparams=None):
     """Fold packed trial rows (see ``PaddedHistory._pack_row``) into the
     history arrays in-trace.  Padding rows carry an out-of-bounds index and
     are dropped by ``mode='drop'``.  One VECTORIZED scatter per array (the
@@ -1183,14 +1214,26 @@ def _apply_rows(labels, history, rows):
     tell+ask program compiles exactly once per space."""
     L = len(labels)
     idx = rows[:, 2 * L + 2].astype(jnp.int32)  # [K]
+
     # .astype(leaf dtype): rows arrive f32; a compressed (bf16) resident
-    # history takes the scatter in its own storage dtype
+    # history takes the scatter in its own storage dtype.  An int8/fp8
+    # leaf instead takes the AFFINE ENCODE (quant.quantize) — the rows
+    # hold snapped grid values (PaddedHistory.append), so in-trace encode
+    # and host encode agree bitwise.
+    def vcol(l, j):
+        leaf = history["vals"][l]
+        if qparams is not None:
+            from .. import quant
+
+            qname = quant.quant_dtype_name(leaf.dtype)
+            if qname is not None:
+                return leaf.at[idx].set(
+                    quant.quantize(rows[:, j], qparams[l], qname),
+                    mode="drop")
+        return leaf.at[idx].set(rows[:, j].astype(leaf.dtype), mode="drop")
+
     return {
-        "vals": {
-            l: history["vals"][l].at[idx].set(
-                rows[:, j].astype(history["vals"][l].dtype), mode="drop")
-            for j, l in enumerate(labels)
-        },
+        "vals": {l: vcol(l, j) for j, l in enumerate(labels)},
         "active": {
             l: history["active"][l].at[idx].set(rows[:, L + j] > 0.5, mode="drop")
             for j, l in enumerate(labels)
@@ -1215,7 +1258,7 @@ def _donation_enabled():
 
 
 def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
-                     mesh=None, shard_history=False):
+                     mesh=None, shard_history=False, hist_dtype=None):
     """The fused tell+ask program:
     ``run(history, rows, seed_words[2], ids[B]) -> (history', packed[B, L])``.
 
@@ -1260,6 +1303,11 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
         # the pallas opt-in changes the traced program: its cache entry
         # must not shadow (or be shadowed by) the jnp build
         key = key + ("pallas",)
+    qparams = _quant_qparams(cs, hist_dtype)
+    if qparams is not None:
+        # the quantized build decodes/encodes codes in-trace; qparams are
+        # deterministic from (space, name), so the name alone keys it
+        key = key + ("quant", str(hist_dtype))
     if mesh is not None:
         geom = (tuple(mesh.shape.items()),
                 tuple(d.id for d in mesh.devices.flat))
@@ -1267,7 +1315,8 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
     fn = _suggest_jit_cache.get(key)
     if fn is None:
         if diag:
-            scored = build_propose_with_scores(cs, cfg, diagnostics=True)
+            scored = build_propose_with_scores(cs, cfg, diagnostics=True,
+                                               qparams=qparams)
 
             def propose_diag(history, k):
                 out, d = scored(history, k)
@@ -1277,7 +1326,7 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
                 return vals, stats, split
 
             def run(history, rows, seed_words, ids):
-                hist = _apply_rows(cs.labels, history, rows)
+                hist = _apply_rows(cs.labels, history, rows, qparams)
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(seed_words[0]), seed_words[1]
                 )
@@ -1287,10 +1336,10 @@ def _get_suggest_jit(domain, cfg_key, cfg, diag=False, donate=True,
                 return hist, rand.pack_labels(cs, vals), stats, splits
 
         else:
-            propose = build_propose(cs, cfg)
+            propose = build_propose(cs, cfg, qparams=qparams)
 
             def run(history, rows, seed_words, ids):
-                hist = _apply_rows(cs.labels, history, rows)
+                hist = _apply_rows(cs.labels, history, rows, qparams)
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(seed_words[0]), seed_words[1]
                 )
@@ -1364,14 +1413,27 @@ def cohort_cache_contains(key):
     return _cohort_jit_cache.contains(key)
 
 
-def cohort_key(cs, cfg, n_studies, cap, n_ids, donate=True, mesh=None):
+def cohort_key(cs, cfg, n_studies, cap, n_ids, donate=True, mesh=None,
+               hist_dtype=None):
     """The cohort-program LRU key :func:`build_suggest_batched` will use
     for these build parameters — factored out so the compile plane can
-    ask "is this program compiled?" without building anything."""
+    ask "is this program compiled?" without building anything.
+    ``hist_dtype`` is the cohort's RESOLVED storage name (the quantized
+    build is a different traced program); when the megakernel is armed
+    for this space, the key carries that too — so the PR 13 bank warms
+    the program that will actually serve."""
     key = (cs.signature(), tuple(sorted(cfg.items())), "cohort",
            int(n_studies), int(cap), int(n_ids), bool(donate))
     if _pallas_armed():
         key = key + ("pallas",)
+    from .. import quant
+
+    if hist_dtype is not None and quant.is_quant_name(hist_dtype):
+        key = key + ("quant", str(hist_dtype))
+    from .. import megakernel
+
+    if megakernel.armed(cs):
+        key = key + ("megakernel", megakernel.mode())
     if mesh is not None:
         key = key + ("mesh", tuple(mesh.shape.items()),
                      tuple(d.id for d in mesh.devices.flat))
@@ -1388,7 +1450,7 @@ def cohort_key_wide(profile, cfg, n_studies, cap, n_ids, donate=True):
 
 
 def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
-                          mesh=None):
+                          mesh=None, hist_dtype=None):
     """Compile the STUDY-BATCHED fused tell+ask program:
 
         run(hist_stack, rows_stack, seed_words[S, 2], ids[S, B])
@@ -1415,16 +1477,43 @@ def build_suggest_batched(cs, cfg, n_studies, cap, n_ids, donate=True,
     (``sharding.suggest_partition_rules(study_axis=True)``) with donation
     preserved — ``n_studies`` must then divide the mesh's device count
     total.
+
+    ``hist_dtype`` is the cohort's RESOLVED storage name: int8/fp8 builds
+    the quantized program (codes decoded/encoded in-trace; see
+    ``_read_vals``/``_apply_rows``).  With ``HYPEROPT_TPU_MEGAKERNEL``
+    armed for this space, the whole tick builds as the fused Pallas
+    megakernel instead (``megakernel.build_cohort``) — same signature,
+    same donation, cached under the same LRU via :func:`cohort_key` so
+    the compile plane's bank/warming covers it; a lowering failure falls
+    back to this jnp program (warn-once counter) and re-keys plain.
     """
     key = cohort_key(cs, cfg, n_studies, cap, n_ids, donate=donate,
-                     mesh=mesh)
+                     mesh=mesh, hist_dtype=hist_dtype)
     fn = _cohort_jit_cache.get(key)
+    if fn is not None:
+        return fn
+    qparams = _quant_qparams(cs, hist_dtype)
+    from .. import megakernel
+
+    if megakernel.armed(cs):
+        fn = megakernel.build_cohort(cs, cfg, n_studies, cap, n_ids,
+                                     donate=donate, mesh=mesh,
+                                     qparams=qparams)
+        if fn is not None:
+            _cohort_jit_cache.put(key, fn)
+            return fn
+        # lowering failed: megakernel just disarmed itself for this space
+        # (warn-once + suggest.megakernel.fallback counter); recompute the
+        # now-plain key so the jnp build lands where later asks look
+        return build_suggest_batched(cs, cfg, n_studies, cap, n_ids,
+                                     donate=donate, mesh=mesh,
+                                     hist_dtype=hist_dtype)
     if fn is None:
-        propose = build_propose(cs, cfg)
+        propose = build_propose(cs, cfg, qparams=qparams)
         labels = cs.labels
 
         def one(history, rows, seed_words, ids):
-            hist = _apply_rows(labels, history, rows)
+            hist = _apply_rows(labels, history, rows, qparams)
             k = jax.random.fold_in(
                 jax.random.PRNGKey(seed_words[0]), seed_words[1]
             )
@@ -1509,17 +1598,32 @@ def widened_profile(cs):
     return tuple(profile), tuple(slots)
 
 
-def widened_params(cs, profile, slots):
+def widened_params(cs, profile, slots, qparams=None):
     """The runtime parameter pytree of one space under a widened profile:
     per group, the stacked per-slot statics the grouped kernels consume
     (plus the ``label_hash`` words), padded to the profile's slot width
     with the inert entries.  Host numpy — tiny arrays, converted at
-    dispatch."""
+    dispatch.
+
+    Every group also carries the per-slot quant code
+    (``qscale``/``qzero``/``qlog``; identity ``(1, 0, False)`` when the
+    space's history is not quantized) — runtime inputs, so compatible
+    spaces with DIFFERENT codes still share one compiled program; the
+    wide kernels only touch them when the history leaf dtype is int8/fp8
+    (dead inputs otherwise, DCE'd by XLA)."""
     out = []
     for entry, ls in zip(profile, slots):
         Wg = entry[-1]
         pad = Wg - len(ls)
         hashes = [label_hash(l) for l in ls] + [0] * pad
+        qp = [(qparams[l] if qparams is not None and l in qparams
+               else (1.0, 0.0, False)) for l in ls]
+        qp += [(1.0, 0.0, False)] * pad
+        qarrs = {
+            "qscale": np.asarray([p[0] for p in qp], np.float32),
+            "qzero": np.asarray([p[1] for p in qp], np.float32),
+            "qlog": np.asarray([p[2] for p in qp], bool),
+        }
         if entry[0] == "disc":
             K = entry[1]
             ps = [_prior_probs(cs.params[l].dist) for l in ls]
@@ -1531,13 +1635,33 @@ def widened_params(cs, profile, slots):
                 "hash": np.asarray(hashes, np.uint32),
                 "p": np.stack(ps).astype(np.float32),
                 "off": np.asarray(offs, np.int32),
+                **qarrs,
             })
         else:
             parz = [_parzen_from(cs.params[l].dist) for l in ls]
             parz += [_PAD_PARZEN] * pad
             out.append({"hash": np.asarray(hashes, np.uint32),
-                        **_stack_parzen_statics(parz)})
+                        **_stack_parzen_statics(parz), **qarrs})
     return tuple(out)
+
+
+def _dequant_wide(vals, wparams):
+    """f32 view of the positional ``[W, cap]`` (or ``[W', cap]`` slice-
+    concatenated) vals stack: affine-decode when the stack holds int8/fp8
+    codes, plain upcast otherwise.  Per-slot ``(scale, zero, islog)``
+    come concatenated from the group entries — slot order is profile
+    order, exactly the stack's row order."""
+    from .. import quant
+
+    if quant.quant_dtype_name(vals.dtype) is None:
+        return jnp.asarray(vals).astype(jnp.float32)
+    scale = jnp.concatenate([jnp.asarray(gp["qscale"]) for gp in wparams])
+    zero = jnp.concatenate([jnp.asarray(gp["qzero"]) for gp in wparams])
+    islog = jnp.concatenate([jnp.asarray(gp["qlog"]) for gp in wparams])
+    t = vals.astype(jnp.float32) * scale[:, None] + zero[:, None]
+    # clamp the dead exp branch: where() evaluates both sides, and a
+    # linear slot's t can be large enough to overflow exp into inf
+    return jnp.where(islog[:, None], jnp.exp(jnp.minimum(t, 80.0)), t)
 
 
 def build_propose_wide(profile, cfg):
@@ -1560,7 +1684,7 @@ def build_propose_wide(profile, cfg):
         has_loss = jnp.asarray(history["has_loss"])
         below, above = split_below_above(losses, has_loss, cfg["gamma"],
                                          cfg["LF"])
-        vals = jnp.asarray(history["vals"]).astype(jnp.float32)
+        vals = _dequant_wide(jnp.asarray(history["vals"]), wparams)
         act = jnp.asarray(history["active"])
         outs = []
         off = 0
@@ -1590,15 +1714,34 @@ def build_propose_wide(profile, cfg):
     return propose
 
 
-def _apply_rows_wide(W, history, rows):
+def _apply_rows_wide(W, history, rows, wparams=None):
     """:func:`_apply_rows` over the positional slot layout: ``rows`` is
     ``[K, 2W+3]`` (slot-ordered val columns, slot-ordered active columns,
     loss, has_loss, trial index) and the scatters write the same values
-    to the same (slot, trial) cells as the per-label dict path."""
+    to the same (slot, trial) cells as the per-label dict path.  An
+    int8/fp8 vals stack takes the affine ENCODE instead of an astype,
+    with the per-slot code streamed from ``wparams`` (see
+    :func:`_dequant_wide`)."""
+    from .. import quant
+
     idx = rows[:, 2 * W + 2].astype(jnp.int32)  # [K]
+    vrows = rows[:, :W].T  # [W, K] f32 slot-major
+    qname = quant.quant_dtype_name(history["vals"].dtype)
+    if qname is not None and wparams is not None:
+        scale = jnp.concatenate([jnp.asarray(gp["qscale"])
+                                 for gp in wparams])
+        zero = jnp.concatenate([jnp.asarray(gp["qzero"]) for gp in wparams])
+        islog = jnp.concatenate([jnp.asarray(gp["qlog"]) for gp in wparams])
+        t = jnp.where(islog[:, None],
+                      jnp.log(jnp.maximum(vrows, quant.EPS)), vrows)
+        q = jnp.clip((t - zero[:, None]) / scale[:, None], -127.0, 127.0)
+        if qname == "int8":
+            q = jnp.round(q)
+        vset = q.astype(history["vals"].dtype)
+    else:
+        vset = vrows.astype(history["vals"].dtype)
     return {
-        "vals": history["vals"].at[:, idx].set(
-            rows[:, :W].T.astype(history["vals"].dtype), mode="drop"),
+        "vals": history["vals"].at[:, idx].set(vset, mode="drop"),
         "active": history["active"].at[:, idx].set(
             rows[:, W:2 * W].T > 0.5, mode="drop"),
         "losses": history["losses"].at[idx].set(
@@ -1633,7 +1776,7 @@ def build_suggest_batched_wide(profile, cfg, n_studies, cap, n_ids,
         W = sum(entry[-1] for entry in profile)
 
         def one(history, rows, seed_words, ids, wparams):
-            hist = _apply_rows_wide(W, history, rows)
+            hist = _apply_rows_wide(W, history, rows, wparams)
             k = jax.random.fold_in(
                 jax.random.PRNGKey(seed_words[0]), seed_words[1]
             )
@@ -1696,6 +1839,9 @@ def suggest_async(
     }
     cfg_key = tuple(sorted(cfg.items()))
     ph = trials.history_object(domain.cs.labels)
+    # arm (or degrade) the int8/fp8 history code before any device state
+    # exists — a no-op unless HYPEROPT_TPU_HIST_DTYPE is a quant name
+    ph.ensure_qparams(domain.cs)
 
     # ONE device program (fold completed trials + propose whole queue) and
     # one single-buffer readback; the updated history stays device-resident
@@ -1727,7 +1873,8 @@ def suggest_async(
         shard_hist = _sh.should_shard_history(ph.cap, mesh)
     run = _get_suggest_jit(domain, cfg_key, cfg, diag=health is not None,
                            donate=donate, mesh=mesh,
-                           shard_history=shard_hist)
+                           shard_history=shard_hist,
+                           hist_dtype=ph.hist_dtype)
     ids = rand.pad_ids_sticky(domain, new_ids)
     dev, rows = ph.device_state(donate=donate)
     if mesh is not None:
@@ -1903,23 +2050,30 @@ def suggest_sharded(
             n_dev = int(np.prod(list(m.shape.values())))
             padded = rand.pad_ids_to_multiple(
                 rand.pad_ids_sticky(domain, new_ids), n_dev)
+        ph = trials.history_object(cs.labels)
+        ph.ensure_qparams(cs)
+        qparams = _quant_qparams(cs, ph.hist_dtype)
         # _pallas_armed() changes the traced program (build_propose_
-        # candidates' EI path), so the flag joins the cache key
+        # candidates' EI path), so the flag joins the cache key — as does
+        # the resolved storage name (the quantized build decodes in-trace)
         cache_key = (cs.signature(), tuple(sorted(cfg.items())), geom,
                      batched, len(padded) if cand_batched else None,
-                     _pallas_armed())
+                     _pallas_armed(),
+                     ph.hist_dtype if qparams is not None else None)
         fn = _sharded_jit_cache.get(cache_key)
         if fn is None:
             if cand_batched:
                 fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True,
-                                                    batch=len(padded))
+                                                    batch=len(padded),
+                                                    qparams=qparams)
             elif batched:
-                fn = _sh.suggest_batch_sharded(cs, cfg, m, packed=True)
+                fn = _sh.suggest_batch_sharded(cs, cfg, m, packed=True,
+                                               qparams=qparams)
             else:
-                fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True)
+                fn = _sh.propose_sharded_candidates(cs, cfg, m, packed=True,
+                                                    qparams=qparams)
             _sharded_jit_cache.put(cache_key, fn)
 
-        ph = trials.history_object(cs.labels)
         hv = ph.device_view()
         hist = {k: hv[k] for k in ("losses", "has_loss", "vals", "active")}
         hist_dev = _sh.replicate_history(hist, m)

@@ -191,35 +191,49 @@ def _bucket_cap(n: int) -> int:
     return cap
 
 
-_updater_cache = {}  # (labels, cap, dtype) -> jitted single-row history update
+_updater_cache = {}  # (labels, cap, dtype, qkey) -> jitted row update
 
 
-def _get_history_updater(labels, cap, dtype="float32"):
+def _get_history_updater(labels, cap, dtype="float32", qparams=None):
     """One jitted program that folds a packed trial row into every device
     array of the history — ONE dispatch per completed trial instead of
     2·L+2 separate ``.at[]`` updates (which each cost a host↔device round
-    trip over a tunneled accelerator).  ``dtype`` is the mirror's float
-    STORAGE dtype (``HYPEROPT_TPU_HIST_DTYPE``); rows arrive f32 and cast
-    on the scatter."""
-    key = (labels, cap, str(dtype))
+    trip over a tunneled accelerator).  ``dtype`` is the mirror's STORAGE
+    dtype (``HYPEROPT_TPU_HIST_DTYPE``); rows arrive f32 and cast on the
+    scatter — or, under an armed int8/fp8 plan (``qparams`` per-label
+    scale/zero, baked as trace constants), affine-encode on the scatter;
+    losses then stay bf16 (``quant.losses_dtype``)."""
+    from . import quant
+
+    key = (labels, cap, str(dtype), quant.qkey(qparams, labels))
     fn = _updater_cache.get(key)
     if fn is None:
         L = len(labels)
-        dt = jnp.dtype(dtype)
+        quantized = qparams is not None and quant.is_quant_name(dtype)
+        ldt = quant.losses_dtype(dtype)
+        dt = None if quantized else jnp.dtype(dtype)
 
         def update(dev, row):
             # row layout: [vals(L), active(L), loss, has_loss, index]
             i = row[2 * L + 2].astype(jnp.int32)
-            return {
-                "vals": {
+            if quantized:
+                vals = {
+                    l: dev["vals"][l].at[i].set(
+                        quant.quantize(row[j], qparams[l], dtype))
+                    for j, l in enumerate(labels)
+                }
+            else:
+                vals = {
                     l: dev["vals"][l].at[i].set(row[j].astype(dt))
                     for j, l in enumerate(labels)
-                },
+                }
+            return {
+                "vals": vals,
                 "active": {
                     l: dev["active"][l].at[i].set(row[L + j] > 0.5)
                     for j, l in enumerate(labels)
                 },
-                "losses": dev["losses"].at[i].set(row[2 * L].astype(dt)),
+                "losses": dev["losses"].at[i].set(row[2 * L].astype(ldt)),
                 "has_loss": dev["has_loss"].at[i].set(row[2 * L + 1] > 0.5),
             }
 
@@ -249,6 +263,16 @@ class PaddedHistory:
     pickle/checkpoint/resume never see the compressed form; the dtype is
     captured at construction and travels through pickle, so a resumed run
     keeps proposing bit-identically to the uninterrupted one.
+
+    ``int8``/``fp8`` (ISSUE 19) go further: once :meth:`ensure_qparams`
+    arms the space-derived affine code (``quant.py``), the mirror's vals
+    arrays hold 1-byte codes (losses bf16) and every host value is
+    SNAPPED to the dequantized grid at append time — the invariant that
+    keeps crash-resume bitwise (quant.py rule 2).  Until armed (paths
+    that never see the space, e.g. pure-random suggest), a quant
+    hist_dtype stores bf16 — compression without the truncation hazard
+    of a raw int8 astype.  ``qparams`` (or its absence) travels through
+    pickle alongside ``hist_dtype``.
     """
 
     def __init__(self, labels, hist_dtype=None):
@@ -256,6 +280,7 @@ class PaddedHistory:
 
         self.labels = tuple(labels)
         self.hist_dtype = str(hist_dtype) if hist_dtype else parse_hist_dtype()
+        self.qparams = None  # {label: (scale, zero, islog)} once armed
         self.n = 0
         self.cap = _MIN_CAP
         self._vals = {l: np.zeros(self.cap, np.float32) for l in self.labels}
@@ -283,12 +308,21 @@ class PaddedHistory:
         self._dev = None  # shapes changed: full re-upload at next view
 
     def append(self, flat_vals: dict, loss):
-        """Record one finished trial (flat {label: value}; absent = inactive)."""
+        """Record one finished trial (flat {label: value}; absent =
+        inactive).  Under an armed quant plan the stored value is the
+        SNAPPED grid point — what the device mirror will decode — so host
+        and device agree bitwise across pickle/WAL resume."""
+        if self.qparams is not None:
+            from . import quant
         self._grow(self.n + 1)
         i = self.n
         for l in self.labels:
             if l in flat_vals and flat_vals[l] is not None:
-                self._vals[l][i] = float(flat_vals[l])
+                v = float(flat_vals[l])
+                if self.qparams is not None:
+                    v = float(quant.snap_np(v, self.qparams[l],
+                                            self.hist_dtype))
+                self._vals[l][i] = v
                 self._active[l][i] = True
         if loss is not None and math.isfinite(float(loss)):
             self._losses[i] = float(loss)
@@ -347,25 +381,77 @@ class PaddedHistory:
             "has_loss": self._has_loss,
         }
 
+    def _mirror_plan(self):
+        """Effective ``(storage name, qparams)`` for the device mirror: a
+        quant ``hist_dtype`` is honored only once :meth:`ensure_qparams`
+        armed the code — before that (paths that never see the space) the
+        mirror stores bf16, which compresses without the silent-truncation
+        hazard of a raw astype to int8."""
+        from . import quant
+
+        if quant.is_quant_name(self.hist_dtype):
+            if self.qparams is not None:
+                return self.hist_dtype, self.qparams
+            return "bfloat16", None
+        return self.hist_dtype, None
+
+    def ensure_qparams(self, cs):
+        """Arm (once) the space-derived int8/fp8 code for this history.
+
+        No-op unless ``hist_dtype`` is a quant name and the code is not
+        yet armed.  A space/backend the code cannot represent degrades
+        this history to bf16 permanently (``quant.resolve`` warns once
+        and bumps the ``suggest.quant.fallback`` counter — an ask never
+        fails).  On success, already-recorded rows are retro-snapped to
+        the dequantized grid (quant.py rule 2: every later quantization
+        must round an exact grid point) and the mirror is invalidated so
+        the next view uploads codes."""
+        from . import quant
+
+        if self.qparams is not None or not quant.is_quant_name(self.hist_dtype):
+            return
+        name, qp = quant.resolve(cs, self.hist_dtype, context="history")
+        if qp is None or any(l not in qp for l in self.labels):
+            self.hist_dtype = "bfloat16"
+            return
+        self._check_not_donated("ensure_qparams")
+        self.qparams = {l: qp[l] for l in self.labels}
+        for l in self.labels:
+            m = self._active[l][: self.n]
+            if m.any():
+                v = self._vals[l][: self.n]
+                v[m] = quant.snap_np(v[m], self.qparams[l], self.hist_dtype)
+        self._dev = None
+
     def _full_upload(self):
         # tag the cap-sized mirror buffers for the devmem live-array census
         # (obs/devmem.py) — uploads are rare (first view / growth), so the
         # set-add is off the per-suggest path
+        from . import quant
         from .obs.devmem import register_owner
 
         register_owner("history", (self.cap,))
-        dt = jnp.dtype(self.hist_dtype)
+        name, qp = self._mirror_plan()
         # jnp.array (copy=True), NOT asarray: the mirror is DONATED into
         # the fused tell+ask program, and on the CPU backend asarray can
         # zero-copy a (page-aligned, e.g. large-cap) numpy buffer —
         # donating an aliased buffer lets XLA free memory the
         # authoritative host arrays still own (heap corruption; the
         # cohort stack reproduced it, see service/scheduler.py)
+        if qp is not None:
+            vals = {l: jnp.array(quant.quantize_np(self._vals[l], qp[l],
+                                                   name))
+                    for l in self.labels}
+            losses = jnp.array(self._losses, dtype=quant.losses_dtype(name))
+        else:
+            dt = jnp.dtype(name)
+            vals = {l: jnp.array(self._vals[l], dtype=dt)
+                    for l in self.labels}
+            losses = jnp.array(self._losses, dtype=dt)
         self._dev = {
-            "vals": {l: jnp.array(self._vals[l], dtype=dt)
-                     for l in self.labels},
+            "vals": vals,
             "active": {l: jnp.array(self._active[l]) for l in self.labels},
-            "losses": jnp.array(self._losses, dtype=dt),
+            "losses": losses,
             "has_loss": jnp.array(self._has_loss),
         }
         self._dev_synced = self.n
@@ -458,8 +544,9 @@ class PaddedHistory:
 
     def __setstate__(self, state):
         # pickles from before the storage-dtype round carry no hist_dtype;
-        # they were f32 by construction
+        # they were f32 by construction (and pre-quant ones no qparams)
         state.setdefault("hist_dtype", "float32")
+        state.setdefault("qparams", None)
         self.__dict__.update(state)
 
     def device_view(self):
@@ -475,8 +562,8 @@ class PaddedHistory:
                 # many rows landed at once (batch eval): re-upload wholesale
                 self._dev = None
                 return self.device_view()
-            update = _get_history_updater(self.labels, self.cap,
-                                          self.hist_dtype)
+            name, qp = self._mirror_plan()
+            update = _get_history_updater(self.labels, self.cap, name, qp)
             for i in range(self._dev_synced, self.n):
                 self._dev = update(self._dev, self._pack_row(i))
             self._dev_synced = self.n

@@ -223,8 +223,12 @@ class DeviceLoopRunner:
         self._obs = obs
         L = len(cs.labels)
         # loop-state storage dtype (HYPEROPT_TPU_HIST_DTYPE): the cap-sized
-        # carry holds vals/losses compressed; kernels upcast on read
-        self.hist_dtype = parse_hist_dtype()
+        # carry holds vals/losses compressed; kernels upcast on read.
+        # int8/fp8 degrade to bf16 — the resident loop state compresses by
+        # plain astype (no affine-code boundary in the chunk program)
+        from . import quant
+
+        self.hist_dtype = str(quant.mirror_float_dtype(parse_hist_dtype()))
         # HYPEROPT_TPU_SHARD + a cap past the per-chip threshold: the chunk
         # program compiles with explicit NamedShardings from the
         # partition-rule table, the history axis sharded over the mesh

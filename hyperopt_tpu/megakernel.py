@@ -1,0 +1,560 @@
+"""Quantized-history fused-suggest megakernel (ISSUE 19).
+
+One tiled Pallas kernel per numeric label fuses the hot middle of the
+ask tick — truncated-mixture candidate SAMPLING (interval-indicator
+component pick over the below model's truncated-weight CDF, then
+``x = mu + sigma * ndtri(u)``) and the dual below/above ``GMM1_lpdf``
+EI accumulation, streamed over the component axis (prior + history
+slots — the shardable history axis) with f32 ``(max, scaled-sum)``
+streaming-logsumexp carries.  The jnp path materializes the
+``[components, candidates]`` matrix twice and round-trips the sampled
+candidates through HBM between the sample and score ops; here the
+candidate block stays in VMEM/registers across both phases — one pass,
+no materialized matrix, both models in the same loop.
+
+Division of labor (docs/DESIGN.md §25):
+
+* **XLA preamble** — row fold (``tpe._apply_rows``, donation-aliased),
+  below/above split, adaptive-Parzen fits (the neighbor-gap sigma rule
+  needs a sort — not tileable), truncation tables (alpha/beta/CDF) and
+  the uniform draws.  History dequantization (int8/fp8 codes → f32)
+  happens at the fit's read boundary (``tpe._read_vals``), so the
+  quantized cohort feeds the kernel the same f32 component tables.
+* **Pallas kernel** — component tables live in SMEM (dynamic scalar
+  reads; a dynamic lane index into VMEM is not lowerable), candidates
+  tile the VPU as (8, 128) blocks padded to 1024 lanes.  Loop 1 picks
+  each candidate's component by first-CDF-crossing indicator carry;
+  loop 2 accumulates BOTH mixtures' log-densities with streaming
+  logsumexp.  All accumulators are f32 regardless of the history
+  storage dtype (the §13 contract).
+* **XLA postamble** — truncation normalizers, exp for log-space labels,
+  the pinned ``_select_candidate`` / ``_mix_prior`` RNG stream, and
+  ``rand.pack_labels`` — identical structure to the jnp cohort program,
+  so donation, sharding rules and the scheduler/compile-plane contract
+  are untouched.
+
+Arming ladder: ``HYPEROPT_TPU_MEGAKERNEL=1`` arms on TPU backends;
+``=interpret`` runs the same kernel through the Pallas interpreter on
+any backend (CI).  A space the kernel cannot express (discrete or
+value-quantized ``q*`` labels) simply doesn't arm — the jnp program
+serves it.  A LOWERING failure disarms the space permanently
+(warn-once + ``suggest.megakernel.fallback`` counter) and
+``tpe.build_suggest_batched`` rebuilds the plain program under the
+recomputed cohort key — an ask never fails because hand-scheduling was
+misconfigured.
+
+This module also absorbs the validated EI-pair kernel that previously
+lived in ``pallas_ei.py`` (``ei_diff`` / ``ei_diff_reference``); that
+module is now a deprecated re-export shim.  The measured verdict that
+kept the EI pair out of the default path — XLA already fuses the jnp
+lpdf formulation near-optimally at small component counts — is
+recorded in DESIGN.md §25 ("when hand-scheduling pays"); the
+megakernel targets the regime it identified: large candidate axes and
+component counts where the ``[m, n]`` intermediates stop fitting VMEM,
+now with the extra HBM round trip between sample and score also
+removed.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+__all__ = [
+    "mode",
+    "supports",
+    "armed",
+    "build_cohort",
+    "ei_diff",
+    "ei_diff_reference",
+    "pallas_available",
+    "fallback_count",
+]
+
+logger = logging.getLogger(__name__)
+
+# log(sqrt(2*pi))
+_LOG_SQRT_2PI = 0.9189385332046727
+# stand-in for -inf that survives max/exp arithmetic without NaNs
+_VERY_NEG = -1e30
+
+_LANES = 128
+_SUBLANES = 8
+_BLOCK = _LANES * _SUBLANES  # candidates per grid step
+
+# spaces whose kernel failed to lower on this process' backend — armed()
+# turns False for them so cohort_key recomputes plain (see build_cohort)
+_failed = set()
+_warned = set()
+
+
+def _count(name):
+    try:
+        from .obs.metrics import get_metrics
+
+        get_metrics("service").counter(name).inc()
+    except Exception:  # noqa: BLE001 - telemetry must not take down an ask
+        pass
+
+
+def fallback_count():
+    """Current ``suggest.megakernel.fallback`` counter value (tests)."""
+    from .obs.metrics import get_metrics
+
+    snap = get_metrics("service").snapshot()["metrics"]
+    return int(snap.get("suggest.megakernel.fallback", 0) or 0)
+
+
+def _disarm(cs, err):
+    """Lowering failed: warn once per space, bump the scrape-visible
+    counter, and mark the space so ``armed()`` — and therefore
+    ``tpe.cohort_key`` — flips to the plain jnp program."""
+    sig = cs.signature()
+    _failed.add(sig)
+    if sig not in _warned:
+        _warned.add(sig)
+        logger.warning(
+            "megakernel lowering failed for this space; serving the jnp "
+            "cohort program instead (warn-once; ask unaffected): %s", err)
+    _count("suggest.megakernel.fallback")
+
+
+def mode():
+    """``"off"`` | ``"on"`` | ``"interpret"`` — the resolved arming knob.
+    The deprecated ``HYPEROPT_TPU_PALLAS=1`` alias maps to ``"on"``
+    (with its own warn-once in ``_env.parse_pallas``)."""
+    from ._env import parse_megakernel, parse_pallas
+
+    m = parse_megakernel()
+    if m == "off" and parse_pallas():
+        return "on"
+    return m
+
+
+def pallas_available():
+    """True when the default backend lowers Mosaic (i.e. a real TPU)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def supports(cs):
+    """True when every label is a numeric, un-value-quantized family —
+    the shapes the fused sample+score kernel expresses.  Discrete and
+    ``q*`` labels keep the jnp program (no fallback event: an
+    unsupported SPACE is a routing decision, not a failure)."""
+    from .algos.tpe import _parzen_from
+
+    for l in cs.labels:
+        dist = cs.params[l].dist
+        if dist.family in ("categorical", "randint"):
+            return False
+        try:
+            _, _, _, _, q, _ = _parzen_from(dist)
+        except ValueError:
+            return False
+        if q is not None:
+            return False
+    return True
+
+
+def armed(cs):
+    """Whether THIS space's cohort builds as the megakernel right now:
+    opted in, expressible, not lowering-failed, and on a backend that
+    can run it (TPU, or any backend under ``interpret``)."""
+    m = mode()
+    if m == "off":
+        return False
+    if cs.signature() in _failed:
+        return False
+    if not supports(cs):
+        return False
+    return m == "interpret" or pallas_available()
+
+
+# ---------------------------------------------------------------------------
+# the fused sample + dual-lpdf kernel
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_kernel(m, low, high):
+    """Kernel body for ``m`` mixture components and STATIC t-space bounds
+    (``±inf`` for the unbounded families — the clip resolves at trace
+    time, mirroring ``tpe._trunc_masses``'s static-bounds doctrine).
+
+    Refs: ``uc``/``u0`` — uniform draws, (8, 128) VMEM blocks;
+    ``cdf/mb/sb/ab/bb`` — below model's normalized truncated-weight CDF,
+    locations, scales, per-component truncation cdfs (SMEM);
+    ``wb``/``wa,ma,sa`` — raw weights of both models for the lpdf pass
+    (SMEM).  Outs: sampled candidate ``x`` (t-space) and the raw
+    two-mixture log-density difference ``ei`` (truncation normalizers
+    are scalars applied by the caller)."""
+    bounded = math.isfinite(low) and math.isfinite(high)
+    if bounded:
+        hi_in = float(np.nextafter(np.float32(high), np.float32(low)))
+
+    def kernel(uc_ref, u0_ref, cdf_ref, mb_ref, sb_ref, ab_ref, bb_ref,
+               wb_ref, wa_ref, ma_ref, sa_ref, x_ref, ei_ref):
+        uc = uc_ref[:]
+        u0 = u0_ref[:]
+
+        # -- loop 1: component pick.  First index i with uc <= cdf[i]
+        # equals the jnp path's #{cdf entries < uc} (cdf nondecreasing),
+        # expressed as an indicator carry instead of a per-lane gather.
+        def pick(i, carry):
+            done, mu, s, a, b = carry
+            sel = jnp.where(done < 0.5,
+                            jnp.where(uc <= cdf_ref[i], 1.0, 0.0),
+                            0.0)
+            mu = jnp.where(sel > 0.5, mb_ref[i], mu)
+            s = jnp.where(sel > 0.5, sb_ref[i], s)
+            a = jnp.where(sel > 0.5, ab_ref[i], a)
+            b = jnp.where(sel > 0.5, bb_ref[i], b)
+            return done + sel, mu, s, a, b
+
+        shape = uc.shape
+        init = (jnp.zeros(shape, jnp.float32),
+                jnp.full(shape, mb_ref[m - 1], jnp.float32),
+                jnp.full(shape, sb_ref[m - 1], jnp.float32),
+                jnp.full(shape, ab_ref[m - 1], jnp.float32),
+                jnp.full(shape, bb_ref[m - 1], jnp.float32))
+        _, mu_s, s_s, a_s, b_s = jax.lax.fori_loop(0, m, pick, init)
+
+        # -- inverse-CDF draw inside the picked component's truncated
+        # interval (tpe.gmm1_sample math, f32 throughout)
+        u = a_s + u0 * (b_s - a_s)
+        u = jnp.clip(u, 1e-7, 1.0 - 1e-7)
+        x = mu_s + s_s * ndtri(u)
+        if bounded:
+            # strictly inside the half-open [low, high) support — a
+            # sample at exactly `high` scores -inf under both models
+            x = jnp.clip(x, jnp.float32(low), jnp.float32(hi_in))
+
+        # -- loop 2: dual streaming logsumexp over the SAME component
+        # stream; the candidate block never leaves VMEM between phases
+        def lse(i, carry):
+            mxb, seb, mxa, sea = carry
+
+            def comp(w, mu, s):
+                logw = jnp.where(w > 0.0, jnp.log(jnp.maximum(w, 1e-12)),
+                                 jnp.float32(_VERY_NEG))
+                return (logw - 0.5 * ((x - mu) / s) ** 2
+                        - jnp.log(s) - jnp.float32(_LOG_SQRT_2PI))
+
+            cb = comp(wb_ref[i], mb_ref[i], sb_ref[i])
+            nb = jnp.maximum(mxb, cb)
+            seb = seb * jnp.exp(mxb - nb) + jnp.exp(cb - nb)
+            ca = comp(wa_ref[i], ma_ref[i], sa_ref[i])
+            na = jnp.maximum(mxa, ca)
+            sea = sea * jnp.exp(mxa - na) + jnp.exp(ca - na)
+            return nb, seb, na, sea
+
+        neg = jnp.full(shape, _VERY_NEG, jnp.float32)
+        zero = jnp.zeros(shape, jnp.float32)
+        mxb, seb, mxa, sea = jax.lax.fori_loop(
+            0, m, lse, (neg, zero, neg, zero))
+        x_ref[:] = x
+        ei_ref[:] = (mxb + jnp.log(seb)) - (mxa + jnp.log(sea))
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fused(n, m, low, high, interpret):
+    """pallas_call wrapper for ``n`` padded candidates (multiple of 1024)
+    and ``m`` components; cached per (shape, bounds, interpret)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = n // _LANES
+    grid = rows // _SUBLANES
+    comp_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    blk = pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0))
+
+    def call(uc2d, u02d, cdf, mb, sb, ab, bb, wb, wa, ma, sa):
+        return pl.pallas_call(
+            _make_fused_kernel(m, low, high),
+            out_shape=(jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+                       jax.ShapeDtypeStruct((rows, _LANES), jnp.float32)),
+            grid=(grid,),
+            in_specs=[blk, blk] + [comp_spec] * 9,
+            out_specs=(blk, blk),
+            interpret=interpret,
+        )(uc2d, u02d, cdf, mb, sb, ab, bb, wb, wa, ma, sa)
+
+    return call
+
+
+def _fused_sample_ei(key, obs, below_mask, above_mask, cfg, parz,
+                     interpret):
+    """The fused replacement for ``tpe._propose_numeric``'s middle:
+    Parzen fits + truncation tables in XLA, sample+score in the kernel,
+    normalizers in XLA.  Returns ``(samples value-space, ei)`` over
+    ``cfg['n_EI_candidates']`` candidates — drop-in for the jnp pair.
+
+    RNG: same ``split(key)`` → (component draw, interval draw) stream as
+    ``gmm1_sample``; draws beyond ``n_cand`` pad the 1024-lane tile with
+    a constant and are sliced off (their EI is never consumed)."""
+    from .algos import tpe
+
+    prior_mu, prior_sigma, low, high, q, log_space = parz
+    assert q is None
+    t_obs = jnp.log(jnp.maximum(obs, tpe.EPS)) if log_space else obs
+    fit = functools.partial(
+        tpe.adaptive_parzen_normal,
+        prior_weight=cfg["prior_weight"],
+        prior_mu=jnp.float32(prior_mu),
+        prior_sigma=jnp.float32(prior_sigma),
+        LF=cfg["LF"],
+    )
+    wb, mb, sb = fit(t_obs, below_mask)
+    wa, ma, sa = fit(t_obs, above_mask)
+    ab, bb, mass_b, pb = tpe._trunc_masses(wb, mb, sb, low, high)
+    _, _, _, pa = tpe._trunc_masses(wa, ma, sa, low, high)
+    cdf = jnp.cumsum(wb * mass_b)
+    cdf = cdf / jnp.maximum(cdf[-1], tpe.EPS)
+
+    n_cand = int(cfg["n_EI_candidates"])
+    n_pad = ((n_cand + _BLOCK - 1) // _BLOCK) * _BLOCK
+    k_comp, k_u = jax.random.split(key)
+    uc = jax.random.uniform(k_comp, (n_cand,))
+    u0 = jax.random.uniform(k_u, (n_cand,))
+    if n_pad != n_cand:
+        pad = [(0, n_pad - n_cand)]
+        uc = jnp.pad(uc, pad, constant_values=0.5)
+        u0 = jnp.pad(u0, pad, constant_values=0.5)
+
+    run = _build_fused(n_pad, int(wb.shape[0]), float(low), float(high),
+                       bool(interpret))
+    x2d, ei2d = run(uc.reshape(n_pad // _LANES, _LANES),
+                    u0.reshape(n_pad // _LANES, _LANES),
+                    cdf, mb, sb, ab, bb, wb, wa, ma, sa)
+    x = x2d.reshape(n_pad)[:n_cand]
+    ei = ei2d.reshape(n_pad)[:n_cand]
+    # truncation normalizers (scalars; the log-space Jacobian cancels in
+    # the below−above difference, exactly as in tpe._ei_pallas)
+    ei = (ei - jnp.log(jnp.maximum(pb, tpe.EPS))
+          + jnp.log(jnp.maximum(pa, tpe.EPS)))
+    samples = jnp.exp(x) if log_space else x
+    return samples, ei
+
+
+def _propose_fused(cs, cfg, qparams, interpret):
+    """``propose(history, key) -> {label: value}`` with the fused kernel
+    in place of the jnp sample+score middle; split, selection and
+    prior-mix reuse ``tpe``'s pinned RNG stream bit for bit."""
+    from .algos import tpe
+
+    parz_of = {l: tpe._parzen_from(cs.params[l].dist) for l in cs.labels}
+
+    def propose(history, key):
+        from .spaces import label_hash
+
+        losses = jnp.asarray(history["losses"]).astype(jnp.float32)
+        has_loss = jnp.asarray(history["has_loss"])
+        below, above = tpe.split_below_above(
+            losses, has_loss, cfg["gamma"], cfg["LF"])
+        out = {}
+        for label in cs.labels:
+            parz = parz_of[label]
+            _, _, low, high, q, log_space = parz
+            vals = tpe._read_vals(history, label, qparams)
+            active = jnp.asarray(history["active"][label])
+            k = jax.random.fold_in(key, label_hash(label))
+            samples, ei = _fused_sample_ei(
+                k, vals, below & active, above & active, cfg, parz,
+                interpret)
+            ei = jnp.where(jnp.isnan(ei), -jnp.inf, ei)
+            val, ei_sel = tpe._select_candidate(k, samples, ei, cfg)
+            prior_mu, prior_sigma = parz[0], parz[1]
+            t_obs = (jnp.log(jnp.maximum(vals, tpe.EPS))
+                     if log_space else vals)
+            fit = functools.partial(
+                tpe.adaptive_parzen_normal,
+                prior_weight=cfg["prior_weight"],
+                prior_mu=jnp.float32(prior_mu),
+                prior_sigma=jnp.float32(prior_sigma),
+                LF=cfg["LF"],
+            )
+            wb, mb, sb = fit(t_obs, below & active)
+            wa, ma, sa = fit(t_obs, above & active)
+            lpdf = tpe.lgmm1_lpdf if log_space else tpe.gmm1_lpdf
+            v, _, _ = tpe._mix_prior(
+                k, cfg, val, ei_sel,
+                lambda kp, p=parz: tpe._prior_draw_numeric(
+                    kp, p[0], p[1], p[2], p[3], p[4], p[5]),
+                lambda xs, a=(wb, mb, sb), b=(wa, ma, sa), lo=low, hi=high,
+                qq=q, f=lpdf: (f(xs, *a, lo, hi, qq) - f(xs, *b, lo, hi, qq)),
+            )
+            out[label] = v
+        return out
+
+    return propose
+
+
+def build_cohort(cs, cfg, n_studies, cap, n_ids, donate=True, mesh=None,
+                 qparams=None):
+    """The megakernel build of ``tpe.build_suggest_batched``'s program:
+    same ``run(hist_stack, rows_stack, seed_words[S, 2], ids[S, B]) ->
+    (hist_stack', packed[S, B, L])`` signature, same donation and
+    partition rules — only the per-label sample+score middle is the
+    fused Pallas kernel.  Returns None when the kernel fails to LOWER
+    for this space's shapes (and disarms the space — the caller then
+    rebuilds plain under the recomputed cohort key).
+
+    The lowering probe compiles the kernel eagerly at its concrete
+    shapes (component count ``cap + 1``, 1024-lane candidate tile,
+    including a vmap axis standing in for the study×id batching) so a
+    Mosaic failure surfaces HERE, at build time, never inside an ask.
+    """
+    from .algos import rand, tpe
+
+    interpret = mode() == "interpret"
+    m = int(cap) + 1  # prior component + one per history slot
+    try:
+        for label in cs.labels:
+            _, _, low, high, _, _ = tpe._parzen_from(cs.params[label].dist)
+            n_cand = int(cfg["n_EI_candidates"])
+            n_pad = ((n_cand + _BLOCK - 1) // _BLOCK) * _BLOCK
+            blk = jax.ShapeDtypeStruct((2, n_pad // _LANES, _LANES),
+                                       jnp.float32)
+            tab = jax.ShapeDtypeStruct((2, m), jnp.float32)
+            kern = _build_fused(n_pad, m, float(low), float(high),
+                                interpret)
+            jax.jit(jax.vmap(kern)).lower(
+                blk, blk, *([tab] * 9)).compile()
+    except Exception as e:  # noqa: BLE001 - any lowering error disarms
+        _disarm(cs, e)
+        return None
+
+    propose = _propose_fused(cs, cfg, qparams, interpret)
+    labels = cs.labels
+
+    def one(history, rows, seed_words, ids):
+        hist = tpe._apply_rows(labels, history, rows, qparams)
+        k = jax.random.fold_in(
+            jax.random.PRNGKey(seed_words[0]), seed_words[1])
+        keys = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+        out = jax.vmap(propose, in_axes=(None, 0))(hist, keys)
+        return hist, rand.pack_labels(cs, out)
+
+    run = jax.vmap(one)
+    donate_kw = {"donate_argnums": (0,)} if donate else {}
+    if mesh is None:
+        return jax.jit(run, **donate_kw)
+    from .parallel import sharding as _sh
+
+    in_sh, out_sh = _sh.suggest_batched_shardings(mesh, labels)
+    return jax.jit(run, in_shardings=in_sh, out_shardings=out_sh,
+                   **donate_kw)
+
+
+# ---------------------------------------------------------------------------
+# the EI-pair kernel (formerly pallas_ei.py) — the score-only fusion the
+# sharded candidate axis and the per-label `_ei_pallas` opt-in consume
+# ---------------------------------------------------------------------------
+
+
+def ei_diff_reference(x, wb, mb, sb, wa, ma, sa):
+    """jnp twin of the kernel: logsumexp_b(x) - logsumexp_a(x) over the two
+    (weights, mus, sigmas) mixtures, no truncation terms."""
+    from jax.scipy.special import logsumexp
+
+    def model(w, mu, s):
+        logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-12)), -jnp.inf)
+        comp = (logw[:, None]
+                - 0.5 * ((x[None, :] - mu[:, None]) / s[:, None]) ** 2
+                - jnp.log(s)[:, None] - _LOG_SQRT_2PI)
+        return logsumexp(comp, axis=0)
+
+    return model(wb, mb, sb) - model(wa, ma, sa)
+
+
+def _make_ei_kernel(m):
+    """Kernel body for ``m`` live components; component tables live in
+    SMEM (dynamic scalar reads)."""
+
+    def kernel(x_ref, wb_ref, mb_ref, sb_ref, wa_ref, ma_ref, sa_ref,
+               out_ref):
+        x = x_ref[:]
+
+        def mixture_lse(w_ref, mu_ref, s_ref):
+            def body(i, carry):
+                mx, se = carry
+                w = w_ref[i]
+                mu = mu_ref[i]
+                s = s_ref[i]
+                logw = jnp.where(w > 0.0, jnp.log(jnp.maximum(w, 1e-12)),
+                                 jnp.float32(_VERY_NEG))
+                comp = (logw - 0.5 * ((x - mu) / s) ** 2
+                        - jnp.log(s) - jnp.float32(_LOG_SQRT_2PI))
+                new_mx = jnp.maximum(mx, comp)
+                se = se * jnp.exp(mx - new_mx) + jnp.exp(comp - new_mx)
+                return new_mx, se
+
+            init = (jnp.full(x.shape, _VERY_NEG, jnp.float32),
+                    jnp.zeros(x.shape, jnp.float32))
+            mx, se = jax.lax.fori_loop(0, m, body, init)
+            return mx + jnp.log(se)
+
+        llb = mixture_lse(wb_ref, mb_ref, sb_ref)
+        lla = mixture_lse(wa_ref, ma_ref, sa_ref)
+        out_ref[:] = llb - lla
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ei(n, m, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = n // _LANES
+    grid = rows // _SUBLANES
+
+    def call(x2d, wb, mb, sb, wa, ma, sa):
+        comp_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        return pl.pallas_call(
+            _make_ei_kernel(m),
+            out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+                comp_spec, comp_spec, comp_spec,
+                comp_spec, comp_spec, comp_spec,
+            ],
+            out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+            interpret=interpret,
+        )(x2d, wb, mb, sb, wa, ma, sa)
+
+    return call
+
+
+def ei_diff(x, wb, mb, sb, wa, ma, sa):
+    """EI score ``lpdf_below(x) - lpdf_above(x)`` (no truncation terms).
+
+    Uses the pallas kernel when the candidate count tiles the TPU grid
+    (multiple of 1024) on a TPU backend — or on any backend under
+    ``HYPEROPT_TPU_MEGAKERNEL=interpret`` — jnp twin otherwise.
+    """
+    if wb.shape[0] != wa.shape[0]:
+        # the kernel bakes ONE component count into both fori_loops (TPE's
+        # below/above models share the padded cap, so this never triggers
+        # from tpe.py) — mismatched mixtures must take the shape-generic path
+        return ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
+    n = x.shape[0]
+    interpret = mode() == "interpret"
+    if n % _BLOCK == 0 and (pallas_available() or interpret):
+        x2d = x.reshape(n // _LANES, _LANES)
+        out = _build_ei(n, int(wb.shape[0]), interpret)(
+            x2d, wb, mb, sb, wa, ma, sa)
+        return out.reshape(n)
+    return ei_diff_reference(x, wb, mb, sb, wa, ma, sa)

@@ -623,6 +623,44 @@ def _probe_section(metrics, out):
             "probe_mismatch records)")
 
 
+def _megakernel_section(metrics, spans, out):
+    """Fused-suggest megakernel plane (ISSUE 19): arming state, quantized
+    history encode/dispatch span time, and the two warn-once fallback
+    counters (kernel lowering failure, quantizer refusal).  Rendered only
+    when the run ever armed the megakernel or tripped a fallback — a
+    plain bf16/jnp run keeps its report unchanged."""
+    armed = metrics.get("suggest.megakernel")
+    kfall = int(metrics.get("suggest.megakernel.fallback", 0))
+    qfall = int(metrics.get("suggest.quant.fallback", 0))
+    span_tot = {}
+    for s in spans:
+        n = s.get("name", "")
+        if n.startswith("suggest.megakernel."):
+            e = span_tot.setdefault(n, {"sec": 0.0, "count": 0})
+            e["sec"] += s.get("wall_sec", 0.0)
+            e["count"] += 1
+    if armed is None and not (kfall or qfall or span_tot):
+        return
+    out.append("")
+    out.append("== megakernel " + "=" * 50)
+    state = "armed" if armed else "disarmed"
+    out.append(f"  fused    {state}"
+               f"  lowering fallbacks {kfall}"
+               f"  quant fallbacks {qfall}")
+    for name in sorted(span_tot):
+        e = span_tot[name]
+        short = name[len("suggest.megakernel."):]
+        out.append(f"  {short:<8} x{e['count']:<6} "
+                   f"total {_fmt_sec(e['sec']):>8}")
+    if kfall:
+        out.append("  FALLBACK: Pallas lowering failed at least once — "
+                   "cohort(s) rebuilt on the jnp path (warn-once log has "
+                   "the first error)")
+    if qfall:
+        out.append("  FALLBACK: quantizer refused the space/dtype — "
+                   "history stored bf16 instead (asks unaffected)")
+
+
 def render_probes(path):
     """The blackbox-probe verdict view (ISSUE 18) from the durable
     CRC-sealed ledgers: give one ``<replica>.jsonl`` ledger, a
@@ -1127,6 +1165,7 @@ def render(records, top=5):
     _quality_section(_last_snapshot_metrics(records), events, out)
     _storage_section(_last_snapshot_metrics(records), out)
     _probe_section(_last_snapshot_metrics(records), out)
+    _megakernel_section(_last_snapshot_metrics(records), spans, out)
     _roofline_section(records, spans, out)
     _profile_section(profile_recs, out)
     out.append("")

@@ -249,6 +249,20 @@ KEY_DIRECTIONS = {
     # must be noise on the tenants it audits, not a tax.
     "probe_overhead_frac": {"direction": "lower", "threshold": 0.05,
                             "absolute": True},
+    # fused-suggest megakernel throughput (bench.py megakernel stage,
+    # ISSUE 19): candidates/sec through the armed (interpret-on-CPU /
+    # Pallas-on-TPU) cohort at the stage's largest (components,
+    # candidates, hist_cap) point.  Loose bar — the interpret path is an
+    # XLA emulation whose constant factors swing with scheduler noise; a
+    # real regression means the fused tick grew a per-candidate cost.
+    "megakernel_cand_per_sec": {"direction": "higher", "threshold": 0.35},
+    # quantized-history HBM footprint: int8 resident history bytes /
+    # f32 resident history bytes at EQUAL hist_cap.  Near-deterministic
+    # (pure dtype arithmetic plus the unquantized losses/flags rows), so
+    # the absolute fixed bar sits at the acceptance criterion: int8 must
+    # stay <= 0.3x f32 or quantization stopped paying for its cap.
+    "megakernel_int8_bytes_frac": {"direction": "lower", "threshold": 0.30,
+                                   "absolute": True},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -279,7 +293,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "solved_frac_atpe",
                 "quality_overhead_frac",
                 "attribution_overhead_frac", "shard_heat_skew",
-                "probe_detection_latency_sec", "probe_overhead_frac")
+                "probe_detection_latency_sec", "probe_overhead_frac",
+                "megakernel_cand_per_sec", "megakernel_int8_bytes_frac")
 
 
 def trajectory_path(root=None):

@@ -1,154 +1,23 @@
-"""Pallas TPU kernel for the fused two-model mixture EI score.
+"""Deprecated shim — the EI-pair kernel moved to ``megakernel.py``.
 
-The TPE hot op evaluates the SAME candidate vector under two Gaussian
-mixtures (below/above Parzen models) and takes the log-density difference
-(hyperopt/tpe.py sym: GMM1_lpdf × 2 + broadcast_best).  The jnp path builds
-two ``[components, candidates]`` matrices and logsumexps them; this kernel
-streams over components with a running (max, scaled-sum) carry, keeping the
-candidate block and both accumulators in VMEM/registers — one pass, no
-materialized matrix, both models in the same loop.
+This module's fused two-model mixture EI kernel (and its jnp reference
+twin) now live in :mod:`hyperopt_tpu.megakernel`, which extends the
+fusion to the whole sample+score middle of the ask tick (ISSUE 19).
+The measured verdict that governed this module's scope — XLA already
+fuses the jnp lpdf formulation near-optimally at small component
+counts, so hand-scheduling only pays where the ``[m, n]`` intermediates
+stop fitting VMEM — is recorded in docs/DESIGN.md §25 ("when
+hand-scheduling pays").
 
-Scope: the un-quantized, value-space case (``q=None``, not log-space) —
-``hp.uniform`` / ``hp.normal`` posteriors, the dominant family.  The
-truncation normalizers (``log p_accept``) are scalars applied by the caller.
-Numerics match the jnp path up to fp reassociation (streaming vs two-pass
-logsumexp); tests assert 1e-4 agreement.
-
-Fallback: any non-TPU backend (or pallas lowering failure) uses the jnp
-path — same math, so behavior is identical everywhere.
-
-MEASURED VERDICT (v5e, 2026-07-30): correct to 1e-5 vs the jnp path and
-~7% faster in isolation (43.1 vs 46.1 ms per 64×8192 EI pair, tunnel
-dispatch overhead included in both).  XLA already fuses the jnp
-formulation into a near-optimal kernel, so this module is NOT wired into
-the default TPE path — it exists as the validated pallas expression of the
-hot op for future shapes where the fusion breaks down (very large
-component counts where the [m, n] intermediate stops fitting VMEM).  The
-default path keeps the compiler-scheduled version per the "don't
-hand-schedule what XLA already fuses" doctrine.
+Importing from here keeps working (the re-exports below are the same
+objects), as does the ``HYPEROPT_TPU_PALLAS=1`` arming alias — with a
+deprecation warn-once pointing at ``HYPEROPT_TPU_MEGAKERNEL``
+(``_env.parse_pallas``).  New code should import
+``hyperopt_tpu.megakernel`` directly.
 """
 
 from __future__ import annotations
 
-import functools
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
+from .megakernel import ei_diff, ei_diff_reference, pallas_available
 
 __all__ = ["ei_diff", "ei_diff_reference", "pallas_available"]
-
-# log(sqrt(2*pi))
-_LOG_SQRT_2PI = 0.9189385332046727
-# stand-in for -inf that survives max/exp arithmetic without NaNs
-_VERY_NEG = -1e30
-
-_LANES = 128
-_SUBLANES = 8
-_BLOCK = _LANES * _SUBLANES  # candidates per grid step
-
-
-def ei_diff_reference(x, wb, mb, sb, wa, ma, sa):
-    """jnp twin of the kernel: logsumexp_b(x) - logsumexp_a(x) over the two
-    (weights, mus, sigmas) mixtures, no truncation terms."""
-    from jax.scipy.special import logsumexp
-
-    def model(w, mu, s):
-        logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-12)), -jnp.inf)
-        comp = (logw[:, None]
-                - 0.5 * ((x[None, :] - mu[:, None]) / s[:, None]) ** 2
-                - jnp.log(s)[:, None] - _LOG_SQRT_2PI)
-        return logsumexp(comp, axis=0)
-
-    return model(wb, mb, sb) - model(wa, ma, sa)
-
-
-def _make_kernel(m):
-    """Kernel body for ``m`` live components; component tables arrive padded
-    to a lane-aligned ``(1, P)`` layout (Mosaic requires the minor dim to be
-    a provable multiple of 128)."""
-
-    def kernel(x_ref, wb_ref, mb_ref, sb_ref, wa_ref, ma_ref, sa_ref, out_ref):
-        x = x_ref[:]
-
-        def mixture_lse(w_ref, mu_ref, s_ref):
-            def body(i, carry):
-                mx, se = carry
-                # component tables live in SMEM: dynamic scalar reads are
-                # exactly what scalar memory supports (a dynamic lane index
-                # into VMEM is not lowerable)
-                w = w_ref[i]
-                mu = mu_ref[i]
-                s = s_ref[i]
-                logw = jnp.where(w > 0.0, jnp.log(jnp.maximum(w, 1e-12)),
-                                 jnp.float32(_VERY_NEG))
-                comp = (logw - 0.5 * ((x - mu) / s) ** 2
-                        - jnp.log(s) - jnp.float32(_LOG_SQRT_2PI))
-                new_mx = jnp.maximum(mx, comp)
-                se = se * jnp.exp(mx - new_mx) + jnp.exp(comp - new_mx)
-                return new_mx, se
-
-            init = (jnp.full(x.shape, _VERY_NEG, jnp.float32),
-                    jnp.zeros(x.shape, jnp.float32))
-            mx, se = jax.lax.fori_loop(0, m, body, init)
-            return mx + jnp.log(se)
-
-        llb = mixture_lse(wb_ref, mb_ref, sb_ref)
-        lla = mixture_lse(wa_ref, ma_ref, sa_ref)
-        out_ref[:] = llb - lla
-
-    return kernel
-
-
-@functools.lru_cache(maxsize=None)
-def _build(n, m):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    rows = n // _LANES
-    grid = rows // _SUBLANES
-
-    def call(x2d, wb, mb, sb, wa, ma, sa):
-        comp_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
-        return pl.pallas_call(
-            _make_kernel(m),
-            out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
-            grid=(grid,),
-            in_specs=[
-                pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
-                comp_spec, comp_spec, comp_spec,
-                comp_spec, comp_spec, comp_spec,
-            ],
-            out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
-        )(x2d, wb, mb, sb, wa, ma, sa)
-
-    return call
-
-
-def pallas_available():
-    """True when the default backend lowers Mosaic (i.e. a real TPU)."""
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
-
-
-def ei_diff(x, wb, mb, sb, wa, ma, sa):
-    """EI score ``lpdf_below(x) - lpdf_above(x)`` (no truncation terms).
-
-    Uses the pallas kernel when the candidate count tiles the TPU grid
-    (multiple of 1024) on a TPU backend; jnp twin otherwise.
-    """
-    if wb.shape[0] != wa.shape[0]:
-        # the kernel bakes ONE component count into both fori_loops (TPE's
-        # below/above models share the padded cap, so this never triggers
-        # from tpe.py) — mismatched mixtures must take the shape-generic path
-        return ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
-    n = x.shape[0]
-    if n % _BLOCK == 0 and pallas_available():
-        x2d = x.reshape(n // _LANES, _LANES)
-        out = _build(n, int(wb.shape[0]))(
-            x2d, wb, mb, sb, wa, ma, sa)
-        return out.reshape(n)
-    return ei_diff_reference(x, wb, mb, sb, wa, ma, sa)

@@ -410,8 +410,12 @@ def fmin_multihost(fn, space, max_evals, batch=None, seed=0, cfg=None,
     # device-resident history storage dtype (HYPEROPT_TPU_HIST_DTYPE):
     # bf16 halves the resident bytes; kernels upcast on read and the fold
     # accumulates in f32, so the checkpoint (host numpy, always f32) and
-    # the digest are unaffected
-    hist_dtype = parse_hist_dtype()
+    # the digest are unaffected.  int8/fp8 (ISSUE 19) degrade to bf16 on
+    # this path — the multihost fold compresses by plain astype, and an
+    # astype(int8) would TRUNCATE values, not affine-encode them
+    from .. import quant
+
+    hist_dtype = str(quant.mirror_float_dtype(parse_hist_dtype()))
     if single:
         mesh = None
         shard_hist = False

@@ -158,7 +158,11 @@ def fleet_fmin(fn, space, max_evals, fleet_dir, batch=None, seed=0, cfg=None,
     propose_fn = jax.jit(jax.vmap(tpe.build_propose(cs, cfg),
                                   in_axes=(None, 0)))
     sample_fn = jax.jit(jax.vmap(cs.sample_flat))
-    hist_dt = jnp.dtype(parse_hist_dtype())
+    # int8/fp8 degrade to bf16 here: this path compresses by plain astype
+    # (no affine-code read boundary is wired into the fleet kernels)
+    from .. import quant
+
+    hist_dt = quant.mirror_float_dtype(parse_hist_dtype())
 
     def device_history():
         # full upload per generation, compressed to the storage dtype the

@@ -352,7 +352,11 @@ def place_history(history, mesh, shard_history=False, dtype=None):
 
     def put(x, spec):
         x = jnp.asarray(x)
-        if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+        # itemsize > 1: an int8/fp8 QUANTIZED leaf (ISSUE 19) holds affine
+        # codes, not values — an astype here would silently decode-corrupt
+        # them; quantized leaves place as-is (their dtype IS the storage)
+        if (dtype is not None and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.dtype.itemsize > 1):
             x = x.astype(dtype)
         return jax.device_put(x, NamedSharding(mesh, spec))
 
@@ -364,7 +368,8 @@ def replicate_history(history, mesh):
     return place_history(history, mesh, shard_history=False)
 
 
-def suggest_batch_sharded(cs, cfg, mesh, packed=False, shard_history=False):
+def suggest_batch_sharded(cs, cfg, mesh, packed=False, shard_history=False,
+                          qparams=None):
     """Data-parallel batched proposal: keys sharded over every mesh device,
     history replicated — or capacity-axis sharded with
     ``shard_history=True`` (per-chip HBM then holds ``cap / n_devices``
@@ -381,7 +386,8 @@ def suggest_batch_sharded(cs, cfg, mesh, packed=False, shard_history=False):
     """
     from ..algos import rand
 
-    propose = jax.vmap(tpe.build_propose(cs, cfg), in_axes=(None, 0))
+    propose = jax.vmap(tpe.build_propose(cs, cfg, qparams=qparams),
+                       in_axes=(None, 0))
     key_sharding = NamedSharding(mesh, P((TRIALS_AXIS, CAND_AXIS)))
     hist_spec = (P((TRIALS_AXIS, CAND_AXIS)) if shard_history else P())
     rep = NamedSharding(mesh, hist_spec)
@@ -400,7 +406,7 @@ def suggest_batch_sharded(cs, cfg, mesh, packed=False, shard_history=False):
 
 
 def propose_sharded_candidates(cs, cfg, mesh, packed=False, batch=None,
-                               topk=4):
+                               topk=4, qparams=None):
     """Proposals with the CANDIDATE axis sharded over ``mesh``'s ``cand``
     axis via ``shard_map``.  ``batch=None`` keeps the legacy one-proposal
     signature ``fn(history, key) -> {label: scalar}`` (``[1, L]`` packed);
@@ -432,7 +438,7 @@ def propose_sharded_candidates(cs, cfg, mesh, packed=False, batch=None,
     n_local = -(-n_cand // n_shards)  # ceil: pad instead of erroring
     k = int(min(topk, n_local))
     local_cfg = dict(cfg, n_EI_candidates=n_local)
-    scored = tpe.build_propose_candidates(cs, local_cfg)
+    scored = tpe.build_propose_candidates(cs, local_cfg, qparams=qparams)
     single = batch is None
     B = 1 if single else int(batch)
     neg_inf = jnp.float32(-jnp.inf)

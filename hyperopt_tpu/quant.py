@@ -1,0 +1,321 @@
+"""Static, space-derived history quantization (ISSUE 19).
+
+``HYPEROPT_TPU_HIST_DTYPE=int8|fp8`` pushes the device-mirror storage
+contract past bf16: per-label affine codes ``t(x) ≈ zero + q * scale``
+with ``q`` stored as int8 (round-to-nearest on a 255-point grid) or
+float8_e4m3fn (continuous in the same normalized range), so the same HBM
+holds 4x the bf16 ``hist_cap``.  Three rules make this safe enough for
+the bitwise-resume and donation contracts the mirror already carries:
+
+1.  **qparams are pure functions of the space.**  ``scale``/``zero``
+    derive from the ``Dist`` family/params alone (bounds for the uniform
+    families, ``mu ± 4σ`` for the unbounded normals, the exact integer
+    grid for discrete families) — never from observed data.  Two
+    processes holding the same space agree on the code without
+    coordination, and a resumed run cannot drift because the data
+    changed the code.  Log-space families quantize ``log x`` (their
+    Parzen fit consumes ``log x`` anyway), so precision is spent where
+    the posterior lives.
+
+2.  **Snap-at-ingest.**  Once a :class:`~hyperopt_tpu.base.PaddedHistory`
+    arms qparams, every host value is snapped to the dequantized grid at
+    append time (``snap_np``), and already-recorded rows are snapped
+    retroactively.  The host numpy arrays stay float32 and authoritative
+    — pickle/WAL/checkpoint carry the snapped f32 values, never the
+    codes — but every later quantization (full upload, incremental
+    scatter, in-trace row fold) rounds an *exact grid point*, which is
+    robust to the ≤few-ulp ``log``/``exp`` differences between numpy and
+    XLA.  That is what makes a crash-resumed run propose bit-identically
+    to the uninterrupted one: both quantize the same grid values to the
+    same codes no matter which path (host upload vs device scatter)
+    folds a given row.
+
+3.  **Degrade, never fail.**  A space the code cannot represent exactly
+    enough (value-quantized ``q*`` families, discrete families wider
+    than the code's exact-integer range, bounds too tight for f32 round
+    tripping) or a backend without the storage dtype falls back to
+    whole-history bf16 with a warn-once and a ``suggest.quant.fallback``
+    counter — an ask must never fail because telemetry-grade compression
+    was misconfigured (the ``_env`` convention).
+
+Kernels never see the codes: every read site dequantizes to f32 before
+the Parzen/EI math (``dequantize`` / the ``read_vals`` helpers in
+``algos/tpe.py``), preserving the f32-accumulation contract of
+DESIGN.md §13.  Losses stay bf16 under the quant modes — they are
+data-dependent (no static scale exists) and they drive the below/above
+argsort split, where int8 resolution would reorder ties.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "QUANT_NAMES",
+    "is_quant_name",
+    "vals_dtype",
+    "losses_dtype",
+    "mirror_float_dtype",
+    "label_qparams",
+    "space_qparams",
+    "resolve",
+    "quantize",
+    "dequantize",
+    "snap_np",
+    "fallback_count",
+]
+
+logger = logging.getLogger(__name__)
+
+#: storage-dtype names past bf16 (``parse_hist_dtype`` grammar)
+QUANT_NAMES = ("int8", "fp8")
+
+EPS = 1e-12
+_QMAX = 127.0  # symmetric code range; -128 unused so the grid is odd
+
+# int8 codes round-trip any integer in [-127, 127]; float8_e4m3fn (3
+# mantissa bits) only represents integers exactly up to 2**4 — past that
+# a discrete bucket would decode to the wrong category
+_DISCRETE_LIMIT = {"int8": 255, "fp8": 33}
+
+_warned = set()
+
+
+def _fallback(reason, key=None):
+    """Warn once per (reason key) and bump the scrape-visible counter —
+    quant degrade follows the observability convention: never raise."""
+    k = key if key is not None else reason
+    if k not in _warned:
+        _warned.add(k)
+        logger.warning(
+            "quantized history unavailable (%s); falling back to bf16 "
+            "storage for this history (warn-once; ask served normally)",
+            reason)
+    try:
+        from .obs.metrics import get_metrics
+
+        get_metrics("service").counter("suggest.quant.fallback").inc()
+    except Exception:  # noqa: BLE001 - telemetry must not take down an ask
+        pass
+
+
+def fallback_count():
+    """Current value of the ``suggest.quant.fallback`` counter (tests)."""
+    from .obs.metrics import get_metrics
+
+    snap = get_metrics("service").snapshot()["metrics"]
+    return int(snap.get("suggest.quant.fallback", 0) or 0)
+
+
+def is_quant_name(name):
+    return str(name) in QUANT_NAMES
+
+
+def _fp8_dtype():
+    try:
+        return jnp.dtype(jnp.float8_e4m3fn)
+    except (AttributeError, TypeError):  # ancient jax/ml_dtypes
+        return None
+
+
+def vals_dtype(name):
+    """jnp storage dtype of the ``vals`` arrays under ``name``, or None
+    when the backend lacks it (fp8 on old jax builds)."""
+    name = str(name)
+    if name == "int8":
+        return jnp.dtype(jnp.int8)
+    if name == "fp8":
+        return _fp8_dtype()
+    return jnp.dtype(name)
+
+
+def quant_dtype_name(dt):
+    """``"int8"``/``"fp8"`` when ``dt`` is a quant STORAGE dtype, else
+    None — the trace-time dispatch every read/write site keys off (the
+    history leaf's dtype, not env state, decides the traced program)."""
+    dt = jnp.dtype(dt)
+    if dt == jnp.dtype(jnp.int8):
+        return "int8"
+    f8 = _fp8_dtype()
+    if f8 is not None and dt == f8:
+        return "fp8"
+    return None
+
+
+def losses_dtype(name):
+    """jnp storage dtype of the ``losses`` array: bf16 under the quant
+    modes (data-dependent range — no static scale exists, and the
+    below/above split argsorts them), else the mode's own dtype."""
+    if is_quant_name(name):
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(str(name))
+
+
+def mirror_float_dtype(name):
+    """The plain "compress float leaves via astype" dtype for paths that
+    mirror history WITHOUT a quantization code path (the multihost
+    driver/fleet replication, ``device_fmin``'s resident loop state,
+    ``sharding.place_history``): f32/bf16 pass through, the quant names
+    degrade to bf16 with a warn-once — an ``astype(int8)`` there would
+    silently truncate values, not encode them."""
+    if is_quant_name(name):
+        _fallback(f"{name} history is not supported on this path "
+                  "(affine-code reads are not wired here)",
+                  key=("mirror", str(name)))
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(str(name))
+
+
+def label_qparams(dist, name):
+    """``(scale, zero, islog)`` for one ``Dist`` under storage ``name``,
+    or None when the family cannot be coded exactly enough.
+
+    Numeric families: bounded ones spread the 255-point grid over the
+    (log-space, for the log families) bounds; unbounded normals cover
+    ``mu ± 4σ`` (the Parzen prior's own mass; codes clip beyond).
+    Value-quantized families (``q*``) are refused — their value grid is
+    not affine in ``t``-space.  Discrete families use the exact integer
+    code (scale 1, zero at the bucket-range midpoint) and are refused
+    past the dtype's exact-integer range."""
+    from .algos.tpe import _parzen_from, _prior_probs
+
+    name = str(name)
+    fam = dist.family
+    if fam in ("categorical", "randint"):
+        K = int(_prior_probs(dist).shape[0])
+        if K > _DISCRETE_LIMIT.get(name, 0):
+            return None
+        offset = int(dist.params[0]) if fam == "randint" else 0
+        return (1.0, float(offset + (K - 1) // 2), False)
+    try:
+        _, _, low, high, q, islog = _parzen_from(dist)
+    except ValueError:
+        return None
+    if q is not None:
+        return None
+    if math.isfinite(low) and math.isfinite(high):
+        zero = 0.5 * (low + high)
+        scale = (high - low) / (2.0 * _QMAX)
+    else:
+        mu, sigma = float(dist.params[0]), float(dist.params[1])
+        zero = mu
+        scale = (8.0 * sigma) / (2.0 * _QMAX)
+    if not (scale > 0.0) or not math.isfinite(scale):
+        return None
+    # f32 round-trip guard: re-quantizing a decoded grid point must land
+    # within 0.5 code of the original even after the ± few-ulp wobble of
+    # (t - zero) cancellation and log/exp.  A grid finer than ~8 ulp of
+    # the zero offset cannot guarantee that — degrade instead of drifting.
+    if scale <= 8.0 * float(np.spacing(np.float32(abs(zero)))):
+        return None
+    return (float(scale), float(zero), bool(islog))
+
+
+def space_qparams(cs, name):
+    """Per-label qparams dict for a CompiledSpace, or None when ANY label
+    cannot be coded (the whole mirror degrades together — a split-dtype
+    mirror would fork every jit cache key for marginal savings) or the
+    backend lacks the storage dtype."""
+    if vals_dtype(name) is None:
+        return None
+    out = {}
+    for l in cs.labels:
+        qp = label_qparams(cs.params[l].dist, name)
+        if qp is None:
+            return None
+        out[l] = qp
+    return out
+
+
+def resolve(cs, name, context="history"):
+    """``(effective_name, qparams_or_None)`` — the one place that owns
+    the degrade ladder: quant names resolve to themselves plus their
+    qparams when the space/backend supports them, else to ``bfloat16``
+    with the warn-once + counter."""
+    name = str(name)
+    if not is_quant_name(name):
+        return name, None
+    qp = space_qparams(cs, name)
+    if qp is None:
+        _fallback(f"{name} cannot represent this space", key=(context, name))
+        return "bfloat16", None
+    return name, qp
+
+
+# ---------------------------------------------------------------------------
+# the code itself — trace-safe jnp on the device path, numpy twin for the
+# host snap.  Both compute in f32 with the same operation order, so a
+# snapped (grid) value quantizes to the same code everywhere.
+# ---------------------------------------------------------------------------
+
+
+def quantize(x, qp, name):
+    """f32 values → storage codes (trace-safe; used by the in-trace row
+    folds and the full-upload path)."""
+    scale, zero, islog = qp
+    x = jnp.asarray(x, jnp.float32)
+    t = jnp.log(jnp.maximum(x, EPS)) if islog else x
+    q = jnp.clip((t - jnp.float32(zero)) / jnp.float32(scale), -_QMAX, _QMAX)
+    if str(name) == "int8":
+        q = jnp.round(q)
+    return q.astype(vals_dtype(name))
+
+
+def dequantize(q, qp):
+    """Storage codes → f32 values (the kernels' read boundary; fused into
+    the megakernel's history-streaming loop on the pallas path)."""
+    scale, zero, islog = qp
+    t = q.astype(jnp.float32) * jnp.float32(scale) + jnp.float32(zero)
+    return jnp.exp(t) if islog else t
+
+
+def snap_np(x, qp, name):
+    """Host numpy encode→decode round trip: the value the device mirror
+    will decode for ``x``.  Applied at append time (and retroactively at
+    arm time) so the authoritative host arrays hold exact grid points —
+    see the module docstring's rule 2.  Idempotent by the ``resolve``
+    scale guard."""
+    scale, zero, islog = qp
+    x = np.asarray(x, np.float32)
+    scalar = x.ndim == 0
+    x = np.atleast_1d(x)
+    t = (np.log(np.maximum(x, np.float32(EPS))).astype(np.float32)
+         if islog else x)
+    q = np.clip((t - np.float32(zero)) / np.float32(scale), -_QMAX, _QMAX)
+    if str(name) == "int8":
+        q = np.rint(q).astype(np.float32)
+    else:
+        import ml_dtypes
+
+        q = q.astype(ml_dtypes.float8_e4m3fn).astype(np.float32)
+    t2 = (q * np.float32(scale) + np.float32(zero)).astype(np.float32)
+    out = np.exp(t2).astype(np.float32) if islog else t2
+    return out[0] if scalar else out
+
+
+def quantize_np(x, qp, name):
+    """Host numpy encode (the full-upload path): same ops and order as
+    :func:`quantize`, producing a numpy array in the storage dtype."""
+    scale, zero, islog = qp
+    x = np.atleast_1d(np.asarray(x, np.float32))
+    t = (np.log(np.maximum(x, np.float32(EPS))).astype(np.float32)
+         if islog else x)
+    q = np.clip((t - np.float32(zero)) / np.float32(scale), -_QMAX, _QMAX)
+    if str(name) == "int8":
+        return np.rint(q).astype(np.int8)
+    import ml_dtypes
+
+    return q.astype(ml_dtypes.float8_e4m3fn)
+
+
+def qkey(qparams, labels):
+    """Hashable form of a qparams dict (jit/updater cache-key component:
+    the traced program bakes scale/zero as constants)."""
+    if qparams is None:
+        return None
+    return tuple(qparams[l] for l in labels)

@@ -311,8 +311,16 @@ class CompilePlane:
             key = tpe.cohort_key_wide(prof[0], cfg, S, cap, B,
                                       donate=donate)
         else:
+            # resolve the EFFECTIVE storage name exactly like _Cohort
+            # does (int8/fp8 → itself when codable, else bf16), so the
+            # plane warms the program the scheduler will actually ask for
+            from .. import quant
+            from .._env import parse_hist_dtype
+
+            hd = quant.resolve(cs, parse_hist_dtype(),
+                               context="cohort")[0]
             key = tpe.cohort_key(cs, cfg, S, cap, B, donate=donate,
-                                 mesh=mesh)
+                                 mesh=mesh, hist_dtype=hd)
         return key, _Job(key, cs, spec, cfg, S, cap, B, donate, mesh,
                          widen, source)
 
@@ -379,7 +387,16 @@ class CompilePlane:
             cs = Domain(None, space).cs
         S, cap, B = job.S, job.cap, job.B
         L = len(cs.labels)
-        dt = jnp.dtype(parse_hist_dtype())
+        # the dummy stack's leaf dtypes must MATCH the live cohort's
+        # exactly (an int8/fp8 mirror retraces the jit per dtype): same
+        # resolve as _Cohort — quant vals + bf16 losses when armed, the
+        # plain float name otherwise
+        from .. import quant
+
+        hd, qp = quant.resolve(cs, parse_hist_dtype(), context="cohort")
+        vdt = (quant.vals_dtype(hd) if quant.is_quant_name(hd)
+               else jnp.dtype(hd))
+        ldt = quant.losses_dtype(hd)
         wparams = None
         if job.widen:
             profile, slots = tpe.widened_profile(cs)
@@ -387,25 +404,26 @@ class CompilePlane:
             fn = tpe.build_suggest_batched_wide(profile, job.cfg, S, cap,
                                                 B, donate=job.donate)
             hist = {
-                "vals": jnp.zeros((S, W, cap), dt),
+                "vals": jnp.zeros((S, W, cap), vdt),
                 "active": jnp.zeros((S, W, cap), bool),
-                "losses": jnp.full((S, cap), jnp.inf, dt),
+                "losses": jnp.full((S, cap), jnp.inf, ldt),
                 "has_loss": jnp.zeros((S, cap), bool),
             }
             rows = np.zeros((S, 1, 2 * W + 3), np.float32)
             rows[:, :, 2 * W + 2] = float(cap)  # no-op scatter row
             wparams = tuple(
                 {k: jnp.asarray(v) for k, v in gp.items()}
-                for gp in tpe.widened_params(cs, profile, slots))
+                for gp in tpe.widened_params(cs, profile, slots,
+                                             qparams=qp))
         else:
             fn = tpe.build_suggest_batched(cs, job.cfg, S, cap, B,
                                            donate=job.donate,
-                                           mesh=job.mesh)
+                                           mesh=job.mesh, hist_dtype=hd)
             hist = {
-                "vals": {l: jnp.zeros((S, cap), dt) for l in cs.labels},
+                "vals": {l: jnp.zeros((S, cap), vdt) for l in cs.labels},
                 "active": {l: jnp.zeros((S, cap), bool)
                            for l in cs.labels},
-                "losses": jnp.full((S, cap), jnp.inf, dt),
+                "losses": jnp.full((S, cap), jnp.inf, ldt),
                 "has_loss": jnp.zeros((S, cap), bool),
             }
             rows = np.zeros((S, 1, 2 * L + 3), np.float32)

@@ -409,10 +409,17 @@ class _Cohort:
     _ROW_BUCKET = 16  # one fixed row bucket, like PaddedHistory's
 
     def __init__(self, cs, cfg, cap, hist_dtype="float32", widen=None):
+        from .. import quant
+
         self.cs = cs
         self.cfg = dict(cfg)
         self.cap = int(cap)
-        self.hist_dtype = str(hist_dtype)
+        # int8/fp8 resolve to (name, per-label qparams) when the space is
+        # codable, else degrade to bf16 here — the cohort's hist_dtype is
+        # always the EFFECTIVE storage name (what cohort_key carries)
+        self.hist_dtype, self.qparams = quant.resolve(
+            cs, str(hist_dtype), context="cohort")
+        self._mk_armed = None  # lazy megakernel.armed(cs) cache
         self.slots = [None]  # Study | None; length is a power of two
         self.slot_of = {}    # study_id -> slot index
         self._dev = None     # stacked history pytree, or None (rebuild)
@@ -445,6 +452,20 @@ class _Cohort:
                 off += entry[-1]
             self.wide_cols = np.asarray(
                 [slot_of_label[l] for l in cs.labels], np.intp)
+
+    def megakernel_armed(self):
+        """Whether this cohort's ticks run the fused Pallas program right
+        now (drives the tick's child spans, the roofline capture and the
+        ``suggest.megakernel`` gauge).  Re-checked per tick — a lowering
+        failure disarms the space mid-run and the jnp program takes over
+        under its recomputed key."""
+        from .. import megakernel
+
+        if self._mk_armed is None:
+            # the space-shape check never changes; cache it
+            self._mk_armed = (self.widen is None
+                              and megakernel.supports(self.cs))
+        return bool(self._mk_armed) and megakernel.armed(self.cs)
 
     @property
     def n_slots(self):
@@ -482,7 +503,13 @@ class _Cohort:
         return slot
 
     def _history(self, study):
-        return study.trials.history_object(self.cs.labels)
+        ph = study.trials.history_object(self.cs.labels)
+        if self.qparams is not None:
+            # snap-at-ingest (quant.py rule 2): arm the study's host
+            # history so every value it records is an exact grid point —
+            # host uploads and in-trace row folds then encode identically
+            ph.ensure_qparams(self.cs)
+        return ph
 
     def _upload_stack(self, mesh=None):
         """Full build of the stacked device mirror from every slotted
@@ -519,15 +546,19 @@ class _Cohort:
             losses[slot, :c] = host["losses"][:c]
             has_loss[slot, :c] = host["has_loss"][:c]
             self._synced[slot] = ph.n
-        dt = jnp.dtype(self.hist_dtype)
+        from .. import quant
 
-        def put(x, floating):
+        quantized = self.qparams is not None
+        vdt = None if quantized else jnp.dtype(self.hist_dtype)
+        ldt = quant.losses_dtype(self.hist_dtype)
+
+        def put(x, dtype=None):
             # jnp.array (copy=True), NOT jnp.asarray: the stack is DONATED
             # into every tick, and on the CPU backend asarray can zero-copy
             # the numpy buffer — donating an aliased buffer lets XLA free
             # memory numpy still owns (glibc "corrupted double-linked
             # list" at the next teardown; reproduced before this guard)
-            arr = jnp.array(x, dtype=dt if floating else None)
+            arr = jnp.array(x, dtype=dtype)
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -535,19 +566,38 @@ class _Cohort:
                     arr, NamedSharding(mesh, P(mesh.axis_names)))
             return arr
 
+        def enc(x, label):
+            # int8/fp8: host-side affine encode of snapped grid values —
+            # same op order as the in-trace fold (quant.quantize), so the
+            # scatter and the upload agree code for code
+            if not quantized:
+                return put(x, vdt)
+            return put(quant.quantize_np(
+                x, self.qparams[label], self.hist_dtype))
+
         if wide:
+            if quantized:
+                sd = quant.vals_dtype(self.hist_dtype)
+                vals_q = np.zeros((S, W, cap), sd)
+                for j, l in enumerate(L):
+                    w = self.wide_cols[j]
+                    vals_q[:, w, :] = quant.quantize_np(
+                        vals_w[:, w, :], self.qparams[l], self.hist_dtype)
+                vals_dev = put(vals_q)
+            else:
+                vals_dev = put(vals_w, vdt)
             self._dev = {
-                "vals": put(vals_w, True),
-                "active": put(active_w, False),
-                "losses": put(losses, True),
-                "has_loss": put(has_loss, False),
+                "vals": vals_dev,
+                "active": put(active_w),
+                "losses": put(losses, ldt),
+                "has_loss": put(has_loss),
             }
         else:
             self._dev = {
-                "vals": {l: put(vals[l], True) for l in L},
-                "active": {l: put(active[l], False) for l in L},
-                "losses": put(losses, True),
-                "has_loss": put(has_loss, False),
+                "vals": {l: enc(vals[l], l) for l in L},
+                "active": {l: put(active[l]) for l in L},
+                "losses": put(losses, ldt),
+                "has_loss": put(has_loss),
             }
 
     def tick(self, demand, donate=True, mesh=None, cand_scale=1.0):
@@ -602,7 +652,16 @@ class _Cohort:
         if self._dev is not None and delta > self._ROW_BUCKET:
             self._dev = None
         if self._dev is None:
-            self._upload_stack(mesh=mesh)
+            if self.qparams is not None:
+                # child span: the host-side affine encode of the full
+                # stack (the quantize boundary; its in-kernel twin — the
+                # dequant fused into the history stream — is inside the
+                # fused dispatch span below)
+                with _tracer.span("suggest.megakernel.quantize",
+                                  cap=self.cap, dtype=self.hist_dtype):
+                    self._upload_stack(mesh=mesh)
+            else:
+                self._upload_stack(mesh=mesh)
             delta = 0
         K = _pow2(max(delta, 1))
 
@@ -639,12 +698,28 @@ class _Cohort:
                           for gp in self.wparams))
         else:
             run = tpe.build_suggest_batched(
-                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh)
+                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh,
+                hist_dtype=self.hist_dtype)
             self.last_key = (tpe.cohort_key(
-                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh), K)
+                self.cs, cfg, S, self.cap, B, donate=donate, mesh=mesh,
+                hist_dtype=self.hist_dtype), K)
             args = (self._dev, rows, seed_words, ids)
+        if self.megakernel_armed():
+            # roofline join (satellite 2): capture the fused program's
+            # cost table once so health.roofline_table carries a
+            # ``suggest.megakernel`` row next to the jnp programs
+            from ..obs.health import capture_jit_cost
+
+            capture_jit_cost(run, args, "suggest.megakernel")
         try:
-            new_dev, packed = run(*args)
+            if self.megakernel_armed():
+                # child span: the fused dispatch — in-kernel history
+                # dequant + dual-model accumulate + sample/score
+                with _tracer.span("suggest.megakernel.accumulate",
+                                  cap=self.cap, n_slots=S):
+                    new_dev, packed = run(*args)
+            else:
+                new_dev, packed = run(*args)
         except BaseException:
             # with donation armed the input stack may already be invalid:
             # drop it and rebuild from the authoritative host arrays
@@ -1117,14 +1192,21 @@ class StudyScheduler:
         key = (st.domain.cs.signature(), st.cfg_key, cap)
         cohort = self._cohorts.get(key)
         if cohort is None:
+            from .. import quant
             from .._env import parse_hist_dtype
 
             widen_info = None
             if self.widen:
                 prof = tpe.widened_profile(st.domain.cs)
                 if prof is not None:
+                    # widened + quantized: the per-slot scale/zero/log
+                    # tables ride the runtime wparams (identity rows when
+                    # unquantized), so one compiled program per profile
+                    # survives the dtype push
+                    qp = quant.resolve(st.domain.cs, parse_hist_dtype(),
+                                       context="cohort")[1]
                     widen_info = (prof[0], prof[1], tpe.widened_params(
-                        st.domain.cs, prof[0], prof[1]))
+                        st.domain.cs, prof[0], prof[1], qparams=qp))
             cohort = self._cohorts[key] = _Cohort(
                 st.domain.cs, st.cfg, cap, hist_dtype=parse_hist_dtype(),
                 widen=widen_info)
@@ -1330,7 +1412,11 @@ class StudyScheduler:
         geom = (None if mesh is None
                 else (tuple(mesh.shape.items()),
                       tuple(d.id for d in mesh.devices.flat)))
-        ck = (S, B, donate, geom)
+        # megakernel arming is part of the program's identity: a lowering
+        # fallback mid-run flips the cohort to the plain key, and a memo
+        # blind to that would probe the dead armed key forever (perpetual
+        # warming floor)
+        ck = (S, B, donate, geom, cohort.megakernel_armed())
         key = cohort._plane_keys.get(ck)
         if key is None:
             if cohort.widen is not None:
@@ -1338,7 +1424,8 @@ class StudyScheduler:
                                           S, cohort.cap, B, donate=donate)
             else:
                 key = tpe.cohort_key(cohort.cs, cohort.cfg, S, cohort.cap,
-                                     B, donate=donate, mesh=mesh)
+                                     B, donate=donate, mesh=mesh,
+                                     hist_dtype=cohort.hist_dtype)
             cohort._plane_keys[ck] = key
         return key
 
@@ -1450,6 +1537,10 @@ class StudyScheduler:
                 len(cohort_reqs))
             return None
         chaos.io_point("tick", self.metrics)
+        # scrape-visible arming state: 1 while ticks run the fused Pallas
+        # program, 0 on the jnp path (flips live on a lowering fallback)
+        self.metrics.gauge("suggest.megakernel").set(
+            1.0 if cohort.megakernel_armed() else 0.0)
         demand = {}
         for r in cohort_reqs:
             slot = cohort.slot_of[r.study.study_id]

@@ -82,6 +82,14 @@
 # on /studies with a stagnation event on its timeline, and /metrics
 # must lint with the quality_* gauge families — then bench_gate
 # --explain prints the windowed per-metric verdicts.
+# Opt-in kernel gate: KERNEL_GATE=1 additionally re-runs the megakernel
+# / quantized-history suites and then scripts/kernel_smoke.py — a real
+# subprocess server with HYPEROPT_TPU_MEGAKERNEL armed (interpret
+# emulation on CPU) serves the zoo mix to budget; a disarmed server and
+# an armed-but-off (MEGAKERNEL=0) server must propose bit-identically
+# (pinned directly through the scheduler AND over HTTP, with zero new
+# threads on the disarmed path), and the armed server must drain
+# cleanly on SIGTERM (exit 0).
 # Opt-in load gate: LOAD_GATE=1 additionally re-runs the cost-
 # attribution suites and then scripts/load_smoke.py — a real
 # 3-subprocess-replica fleet with a ~10:1 skewed study placement:
@@ -179,6 +187,12 @@ if [ "${LOAD_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_load.py tests/test_service_fleet.py \
         -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/load_smoke.py || exit 1
+fi
+if [ "${KERNEL_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_megakernel.py tests/test_shard_suggest.py \
+        tests/test_batched_suggest.py tests/test_journal.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/kernel_smoke.py || exit 1
 fi
 if [ "${PROBE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

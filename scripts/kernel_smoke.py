@@ -1,0 +1,205 @@
+"""KERNEL_GATE end-to-end smoke (ISSUE 19): the quantized-history
+fused-suggest megakernel against a REAL subprocess server.
+
+What it pins (the cross-process slice no in-process test can):
+
+* DISARMED IS FREE, directly: an in-process scheduler with
+  ``HYPEROPT_TPU_MEGAKERNEL=0`` proposes bit-identically to one with the
+  variable unset, and driving the disarmed scheduler spawns ZERO new
+  threads (the kernel plane must not exist when off);
+* DISARMED IS FREE, over the wire: a subprocess server with
+  ``HYPEROPT_TPU_MEGAKERNEL=0`` serves a zoo mix with proposal streams
+  byte-identical (full float round-trip through JSON) to a server with
+  the variable unset, study for study, trial for trial;
+* THE ARMED SERVER SERVES: a subprocess server with
+  ``HYPEROPT_TPU_MEGAKERNEL`` armed (``interpret`` emulation on CPU —
+  same fused program, XLA-executed) drives the same zoo mix to budget
+  with every loss finite, ``/metrics`` lints clean and carries the
+  ``hyperopt_tpu_suggest_megakernel`` gauge at 1 (the fused cohort
+  really ticked), and the server drains cleanly on SIGTERM (exit 0).
+
+Opt in via ``KERNEL_GATE=1 ./run_tests.sh``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+#: 2 studies keeps the three-server smoke to the cheapest analytic
+#: domains (quadratic1 budget 20, branin budget 30)
+_MIX_N = 2
+
+
+def fail(msg):
+    print(f"kernel_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def _drive_mix(client, items, zoo):
+    """Create + ask/tell every mix study to budget; return the proposal
+    stream per study name (the exact params dicts off the wire)."""
+    sids, streams = {}, {}
+    for m in items:
+        sids[m.name] = client.create_study(
+            zoo=m.domain.name, seed=m.seed,
+            n_startup_jobs=m.n_startup_jobs)
+    for m in items:
+        stream = []
+        for _ in range(m.budget):
+            t = client.ask(sids[m.name])[0]
+            stream.append(t["params"])
+            loss = float(zoo[m.domain.name].objective(t["params"]))
+            if not (loss == loss and abs(loss) != float("inf")):
+                raise AssertionError(f"non-finite loss {loss} on {m.name}")
+            client.tell(sids[m.name], t["tid"], loss=loss)
+        streams[m.name] = stream
+    return streams
+
+
+def _server(env_extra, store):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HYPEROPT_TPU_MEGAKERNEL", None)
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.server",
+         "--port", "0", "--announce", "--store", store],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    url, deadline = None, time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SERVICE_URL "):
+            url = line.split(None, 1)[1].strip()
+            break
+        if proc.poll() is not None:
+            break
+    if url is None:
+        err = (proc.stderr.read() or "")[-2000:]
+        proc.kill()
+        proc.communicate()
+        raise RuntimeError(f"server never announced: {err}")
+    return proc, url
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.communicate()
+
+
+def main():
+    from validate_scrape import validate_metrics_text
+
+    from hyperopt_tpu.service.client import ServiceClient
+    from hyperopt_tpu.zoo import ZOO, make_study_mix
+
+    items = make_study_mix(_MIX_N, 0)
+
+    # -- pin 1: disarmed == armed-off, directly + zero new threads --------
+    import threading
+
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+
+    def direct_stream(megakernel_env):
+        prev = os.environ.pop("HYPEROPT_TPU_MEGAKERNEL", None)
+        if megakernel_env is not None:
+            os.environ["HYPEROPT_TPU_MEGAKERNEL"] = megakernel_env
+        try:
+            sched = StudyScheduler(wal=False)
+            out = {}
+            for m in items:
+                sid = sched.create_study(m.domain.space, seed=m.seed,
+                                         n_startup_jobs=m.n_startup_jobs)
+                stream = []
+                for _ in range(m.budget):
+                    a = sched.ask_many([(sid, 1)])[sid][0]
+                    stream.append(a["params"])
+                    sched.tell(sid, a["tid"],
+                               float(m.domain.objective(a["params"])))
+                out[m.name] = stream
+            return out
+        finally:
+            os.environ.pop("HYPEROPT_TPU_MEGAKERNEL", None)
+            if prev is not None:
+                os.environ["HYPEROPT_TPU_MEGAKERNEL"] = prev
+
+    threads_before = threading.active_count()
+    unset = direct_stream(None)
+    if threading.active_count() != threads_before:
+        return fail("disarmed scheduler drive changed the thread count "
+                    f"({threads_before} -> {threading.active_count()})")
+    armed_off = direct_stream("0")
+    if unset != armed_off:
+        return fail("MEGAKERNEL=0 proposals diverge from unset (direct)")
+    print("kernel_smoke: disarmed == armed-off bit-identical (direct), "
+          "zero new threads")
+
+    # -- pin 2 + 3: the three subprocess servers --------------------------
+    tmp = tempfile.mkdtemp(prefix="kernel_smoke_")
+    proc_a, url_a = _server({}, os.path.join(tmp, "store_unset"))
+    try:
+        base = _drive_mix(ServiceClient(url_a), items, ZOO)
+    finally:
+        _stop(proc_a)
+    print(f"kernel_smoke: baseline server served {len(base)} studies")
+
+    proc_b, url_b = _server({"HYPEROPT_TPU_MEGAKERNEL": "0"},
+                            os.path.join(tmp, "store_off"))
+    try:
+        off = _drive_mix(ServiceClient(url_b), items, ZOO)
+    finally:
+        _stop(proc_b)
+    if off != base:
+        return fail("MEGAKERNEL=0 proposals diverge from unset over HTTP")
+    print("kernel_smoke: disarmed == armed-off bit-identical over HTTP")
+
+    proc_c, url_c = _server({"HYPEROPT_TPU_MEGAKERNEL": "interpret"},
+                            os.path.join(tmp, "store_armed"))
+    try:
+        armed = _drive_mix(ServiceClient(url_c), items, ZOO)
+        for m in items:
+            if len(armed[m.name]) != m.budget:
+                return fail(f"armed server served {len(armed[m.name])} "
+                            f"asks for {m.name}, wanted {m.budget}")
+
+        import urllib.request
+
+        with urllib.request.urlopen(url_c + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        errs = validate_metrics_text(text)
+        if errs:
+            return fail("armed /metrics lint: " + "; ".join(errs[:5]))
+        gauge = [ln for ln in text.splitlines()
+                 if ln.startswith("hyperopt_tpu_suggest_megakernel{")]
+        if not gauge or not any(ln.rsplit(None, 1)[1] == "1.0"
+                                for ln in gauge):
+            return fail("armed server never reported "
+                        f"suggest.megakernel=1: {gauge}")
+        print("kernel_smoke: armed server served the mix, megakernel "
+              "gauge=1, /metrics lints clean")
+
+        proc_c.send_signal(signal.SIGTERM)
+        rc = proc_c.wait(timeout=120)
+        if rc != 0:
+            return fail(f"armed server exited {rc} on SIGTERM")
+    finally:
+        _stop(proc_c)
+    print("kernel_smoke: OK — disarmed byte-identical (direct + HTTP, "
+          "zero new threads); armed server served the zoo mix and "
+          "drained cleanly on SIGTERM")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

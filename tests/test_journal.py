@@ -151,6 +151,32 @@ def test_crash_resume_bitwise_with_store(tmp_path):
     assert first == ref_first and rest == ref_rest
 
 
+@pytest.mark.parametrize("qname", ("int8", "fp8"))
+def test_crash_resume_bitwise_quant_history(tmp_path, monkeypatch, qname):
+    """ISSUE 19: the WAL crash-resume pin holds under a QUANTIZED device
+    history — values snap to the code grid at ingest, so the journaled
+    doc stream already lives on the grid and a resumed scheduler rebuilds
+    the same codes: proposals continue bit-identically to an
+    uninterrupted same-dtype run."""
+    from hyperopt_tpu import quant
+
+    if quant.vals_dtype(qname) is None:
+        pytest.skip(f"backend lacks the {qname} storage dtype")
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", qname)
+    ref = _reference(7, 12)
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    sid = s1.create_study(SPACE, seed=7, n_startup_jobs=3,
+                          space_spec=SPEC, study_id="study-q-" + qname)
+    first = _drive(s1, sid, 7)
+    del s1  # crash: no drain, no compaction
+    s2 = StudyScheduler(wal=wal)
+    assert s2.last_resume["errors"] == 0
+    assert s2.last_resume["regenerated"] == 7
+    rest = _drive(s2, sid, 5)
+    assert first + rest == ref
+
+
 def test_resume_twice_is_idempotent(tmp_path):
     """Resuming, crashing again immediately and resuming again replays
     to the same state (duplicate tells skipped, nothing double-folds)."""

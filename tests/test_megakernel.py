@@ -1,0 +1,238 @@
+"""ISSUE 19: the quantized-history fused-suggest megakernel.
+
+CPU lane: the arming ladder (env parsing, space support, backend gate,
+lowering-failure disarm) runs for real; the kernel BODY runs through the
+Pallas interpreter (``HYPEROPT_TPU_MEGAKERNEL=interpret``) — the same
+traced program a TPU would lower, executed as XLA ops.  Agreement with
+the jnp cohort is asserted to tolerance, not bitwise: on CPU the
+interpreter reproduces the jnp stream exactly (same RNG, same math), but
+real Mosaic scheduling may reassociate the streamed accumulations, and
+the contract ISSUE 19 gates on is the quality/health trajectory, not
+bit-equality (see bench.py ``search_quality``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp, megakernel, pallas_ei, quant
+from hyperopt_tpu._env import parse_megakernel
+from hyperopt_tpu.algos import tpe
+from hyperopt_tpu.base import Domain
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -4, 0),
+}
+
+CFG = {"prior_weight": 1.0, "n_EI_candidates": 24, "gamma": 0.25,
+       "LF": 25, "ei_select": "argmax", "ei_tau": 1.0, "prior_eps": 0.0}
+
+
+def _hist_stack(cs, S, cap, rng):
+    devs = []
+    for s in range(S):
+        vals = {l: np.zeros(cap, np.float32) for l in cs.labels}
+        act = {l: np.zeros(cap, bool) for l in cs.labels}
+        losses = np.full(cap, np.inf, np.float32)
+        has = np.zeros(cap, bool)
+        for i in range(5 + s):
+            # (0.05, 0.9) sits inside the support of every label used in
+            # this file (uniform(-5,5), loguniform(-4,0), uniform(0,1))
+            for l in cs.labels:
+                vals[l][i] = rng.uniform(0.05, 0.9)
+                act[l][i] = True
+            losses[i] = rng.uniform()
+            has[i] = True
+        devs.append({"vals": {l: jnp.asarray(vals[l]) for l in cs.labels},
+                     "active": {l: jnp.asarray(act[l]) for l in cs.labels},
+                     "losses": jnp.asarray(losses),
+                     "has_loss": jnp.asarray(has)})
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *devs)
+
+
+def _drive(cs, fn, S, cap, B, seed0=500):
+    L = len(cs.labels)
+    rows = np.zeros((S, 16, 2 * L + 3), np.float32)
+    rows[:, :, -1] = cap
+    seeds = np.stack([tpe._seed_words(seed0 + s) for s in range(S)])
+    ids = np.asarray([[3 + s, 9 + s] for s in range(S)][:S], np.uint32)
+    stack = _hist_stack(cs, S, cap, np.random.default_rng(7))
+    _, packed = fn(stack, rows, seeds, ids)
+    return np.asarray(packed)
+
+
+# ---------------------------------------------------------------------------
+# the arming ladder
+# ---------------------------------------------------------------------------
+
+
+def test_env_parsing(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_MEGAKERNEL", raising=False)
+    assert parse_megakernel() == "off"
+    for raw, want in (("0", "off"), ("off", "off"), ("1", "on"),
+                      ("on", "on"), ("interpret", "interpret"),
+                      ("bogus", "off")):
+        monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", raw)
+        assert parse_megakernel() == want, raw
+
+
+def test_pallas_alias_maps_to_on(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_MEGAKERNEL", raising=False)
+    monkeypatch.setenv("HYPEROPT_TPU_PALLAS", "1")
+    assert megakernel.mode() == "on"
+    # explicit megakernel setting wins over the alias
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    assert megakernel.mode() == "interpret"
+
+
+def test_supports_numeric_only():
+    assert megakernel.supports(Domain(None, SPACE).cs)
+    for bad in ({"k": hp.randint("k", 4)},
+                {"c": hp.choice("c", [1, 2])},
+                {"q": hp.quniform("q", 0, 10, 2)}):
+        assert not megakernel.supports(Domain(None, bad).cs)
+
+
+def test_armed_needs_tpu_or_interpret(monkeypatch):
+    cs = Domain(None, SPACE).cs
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "1")
+    # CPU CI: mode "on" must NOT arm (the jnp program serves) ...
+    assert megakernel.armed(cs) == megakernel.pallas_available()
+    # ... while interpret arms anywhere
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    assert megakernel.armed(cs)
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "0")
+    assert not megakernel.armed(cs)
+
+
+def test_disarmed_build_is_the_same_program(monkeypatch):
+    """MEGAKERNEL=0 and unset hit the SAME cohort-LRU entry — the
+    disarmed path is byte-identical by construction, not by luck."""
+    cs = Domain(None, SPACE).cs
+    monkeypatch.delenv("HYPEROPT_TPU_MEGAKERNEL", raising=False)
+    fn_unset = tpe.build_suggest_batched(cs, CFG, 2, 16, 2, donate=False)
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "0")
+    fn_off = tpe.build_suggest_batched(cs, CFG, 2, 16, 2, donate=False)
+    assert fn_unset is fn_off
+
+
+# ---------------------------------------------------------------------------
+# the fused program (interpret lane)
+# ---------------------------------------------------------------------------
+
+
+def test_interpret_cohort_matches_jnp(monkeypatch):
+    cs = Domain(None, SPACE).cs
+    S, cap, B = 2, 16, 2
+    monkeypatch.delenv("HYPEROPT_TPU_MEGAKERNEL", raising=False)
+    want = _drive(cs, tpe.build_suggest_batched(cs, CFG, S, cap, B,
+                                                donate=False), S, cap, B)
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    assert megakernel.armed(cs)
+    got = _drive(cs, tpe.build_suggest_batched(cs, CFG, S, cap, B,
+                                               donate=False), S, cap, B)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_interpret_cohort_key_forks(monkeypatch):
+    """Armed and disarmed builds may not share a cohort-LRU slot — the
+    compile plane's bank must treat them as different programs."""
+    cs = Domain(None, SPACE).cs
+    monkeypatch.delenv("HYPEROPT_TPU_MEGAKERNEL", raising=False)
+    k_off = tpe.cohort_key(cs, CFG, 2, 16, 2, donate=False)
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    k_on = tpe.cohort_key(cs, CFG, 2, 16, 2, donate=False)
+    assert k_off != k_on
+
+
+def test_quantized_cohort_serves_in_bounds(monkeypatch):
+    """int8-coded history through the ARMED fused program: proposals are
+    finite and inside the space's support (the dequant boundary feeds
+    the kernel f32 tables)."""
+    cs = Domain(None, SPACE).cs
+    S, cap, B = 2, 16, 2
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    name, qp = quant.resolve(cs, "int8", context="test")
+    assert name == "int8" and qp is not None
+    fn = tpe.build_suggest_batched(cs, CFG, S, cap, B, donate=False,
+                                   hist_dtype="int8")
+    stack = _hist_stack(cs, S, cap, np.random.default_rng(7))
+    enc = {l: quant.quantize_np(np.asarray(stack["vals"][l]), qp[l],
+                                "int8") for l in cs.labels}
+    stack = dict(stack, vals={l: jnp.asarray(enc[l]) for l in cs.labels},
+                 losses=jnp.asarray(np.asarray(stack["losses"]),
+                                    jnp.bfloat16))
+    L = len(cs.labels)
+    rows = np.zeros((S, 16, 2 * L + 3), np.float32)
+    rows[:, :, -1] = cap
+    seeds = np.stack([tpe._seed_words(600 + s) for s in range(S)])
+    ids = np.asarray([[3, 9], [4, 10]], np.uint32)
+    _, packed = fn(stack, rows, seeds, ids)
+    packed = np.asarray(packed, np.float64)
+    assert np.isfinite(packed).all()
+    xi = cs.labels.index("x")
+    li = cs.labels.index("lr")
+    assert (packed[:, :, xi] >= -5).all() and (packed[:, :, xi] <= 5).all()
+    assert (packed[:, :, li] > 0).all() and (packed[:, :, li] <= 1.0).all()
+
+
+def test_lowering_failure_falls_back_and_counts(monkeypatch):
+    """A kernel that fails to lower disarms the space (warn-once +
+    counter), and build_suggest_batched serves the jnp program under the
+    recomputed plain key — an ask never fails."""
+    space = {"z": hp.uniform("z", 0, 1)}
+    cs = Domain(None, space).cs
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic Mosaic lowering failure")
+
+    monkeypatch.setattr(megakernel, "_build_fused", boom)
+    before = megakernel.fallback_count()
+    try:
+        fn = tpe.build_suggest_batched(cs, CFG, 2, 16, 2, donate=False)
+        assert fn is not None
+        assert megakernel.fallback_count() == before + 1
+        assert cs.signature() in megakernel._failed
+        assert not megakernel.armed(cs)  # stays disarmed for this space
+        # the fallback program really serves
+        out = _drive(cs, fn, 2, 16, 2)
+        assert np.isfinite(np.asarray(out, np.float64)).all()
+        # and a repeat build is a cache hit, not another fallback event
+        tpe.build_suggest_batched(cs, CFG, 2, 16, 2, donate=False)
+        assert megakernel.fallback_count() == before + 1
+    finally:
+        megakernel._failed.discard(cs.signature())
+        megakernel._warned.discard(cs.signature())
+
+
+# ---------------------------------------------------------------------------
+# the absorbed EI-pair kernel + shim
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_ei_is_a_shim():
+    assert pallas_ei.ei_diff is megakernel.ei_diff
+    assert pallas_ei.ei_diff_reference is megakernel.ei_diff_reference
+    assert pallas_ei.pallas_available is megakernel.pallas_available
+
+
+def test_ei_diff_interpret_matches_reference(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_MEGAKERNEL", "interpret")
+    rng = np.random.default_rng(3)
+    m = 17
+    def mix():
+        w = np.abs(rng.random(m)).astype(np.float32)
+        w /= w.sum()
+        return (jnp.asarray(w),
+                jnp.asarray(rng.uniform(-3, 3, m).astype(np.float32)),
+                jnp.asarray(rng.uniform(0.1, 2.0, m).astype(np.float32)))
+    wb, mb, sb = mix()
+    wa, ma, sa = mix()
+    x = jnp.asarray(rng.uniform(-4, 4, 1024).astype(np.float32))
+    got = megakernel.ei_diff(x, wb, mb, sb, wa, ma, sa)  # interpret lane
+    want = megakernel.ei_diff_reference(x, wb, mb, sb, wa, ma, sa)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

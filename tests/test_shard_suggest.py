@@ -19,7 +19,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu import Trials, fmin, hp, quant
 from hyperopt_tpu._env import (parse_hist_dtype, parse_hist_shard_min,
                                parse_pallas, parse_shard)
 from hyperopt_tpu.algos import rand, tpe
@@ -73,6 +73,11 @@ def test_env_knob_parsing():
     assert parse_hist_dtype({}) == "float32"
     assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "bf16"}) == "bfloat16"
     assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "f64"}) == "float32"
+    assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "int8"}) == "int8"
+    assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "i8"}) == "int8"
+    assert parse_hist_dtype({"HYPEROPT_TPU_HIST_DTYPE": "fp8"}) == "fp8"
+    assert parse_hist_dtype(
+        {"HYPEROPT_TPU_HIST_DTYPE": "float8_e4m3fn"}) == "fp8"
     assert parse_hist_shard_min({}) == 65536
     assert parse_hist_shard_min({"HYPEROPT_TPU_HIST_SHARD_MIN": "128"}) == 128
     assert parse_pallas({}) is False
@@ -292,6 +297,122 @@ def test_device_loop_chunk_sharded_state(monkeypatch):
          rstate=np.random.default_rng(0), show_progressbar=False)
     assert len(t) == 40
     assert min(l for l in t.losses() if l is not None) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# int8/fp8 quantized history (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_QUANT_NAMES = ("int8", "fp8")
+
+
+def _skip_unless_backend(qname):
+    if quant.vals_dtype(qname) is None:
+        pytest.skip(f"backend lacks the {qname} storage dtype")
+
+
+def test_int8_history_quarter_resident_bytes():
+    # the acceptance bar: int8 history <= 0.3x f32 bytes at equal cap
+    # (vals go 4 -> 1 byte; losses go 4 -> 2, bf16 — data-dependent range
+    # rules out a static loss scale).  Needs >= 6 labels for the loss
+    # floor to amortize under 0.3.
+    space = {f"x{i}": hp.uniform(f"x{i}", -5, 5) for i in range(6)}
+    cs = Domain(None, space).cs
+
+    def resident_bytes(dtype):
+        ph = PaddedHistory(cs.labels, hist_dtype=dtype)
+        ph.ensure_qparams(cs)
+        for i in range(20):
+            ph.append({l: float(i % 5) - 2.0 for l in cs.labels}, float(i))
+        dev = ph.device_view()
+        return (sum(dev["vals"][l].nbytes for l in cs.labels)
+                + dev["losses"].nbytes)
+
+    f32, i8 = resident_bytes("float32"), resident_bytes("int8")
+    assert i8 / f32 <= 0.3, (i8, f32)
+
+
+@pytest.mark.parametrize("qname", _QUANT_NAMES)
+def test_quant_mirror_really_quantized(monkeypatch, qname):
+    _skip_unless_backend(qname)
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", qname)
+    t = _populated()
+    dom = Domain(obj, SPACE)
+    tpe.suggest(t.new_trial_ids(4), dom, t, 5, n_startup_jobs=5)
+    ph = t.history_object(dom.cs.labels)
+    assert ph.hist_dtype == qname and ph.qparams is not None
+    assert ph._dev["vals"]["x"].dtype == quant.vals_dtype(qname)
+    assert ph._dev["losses"].dtype == jnp.bfloat16
+    # host numpy (the pickle payload) stays f32 authoritative
+    assert ph._losses.dtype == np.float32
+
+
+@pytest.mark.parametrize("qname", _QUANT_NAMES)
+def test_quant_history_deterministic_and_valid(monkeypatch, qname):
+    _skip_unless_backend(qname)
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", qname)
+    a, b = _proposals(seed=9), _proposals(seed=9)
+    assert a == b
+    for v in a:
+        assert -5 <= v["x"][0] <= 5
+        assert np.exp(-4) - 1e-5 <= v["lr"][0] <= 1 + 1e-5
+        assert v["k"][0] in range(4)
+
+
+@pytest.mark.parametrize("qname", _QUANT_NAMES)
+def test_quant_pickle_midrun_resumes_bitwise(monkeypatch, qname):
+    # ISSUE 19 round-trip pin: pickling Trials mid-run with the QUANTIZED
+    # mirror live and resuming reproduces the uninterrupted same-dtype
+    # run bitwise — values snap to the code grid at ingest, so the doc
+    # stream (the pickle payload) already lives on the grid and a rebuilt
+    # mirror re-encodes to the same codes.
+    _skip_unless_backend(qname)
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", qname)
+    algo = functools.partial(tpe.suggest, n_startup_jobs=6)
+
+    def make_iter(trials, rng):
+        return FMinIter(algo, Domain(obj, SPACE), trials, rstate=rng,
+                        max_evals=20, show_progressbar=False)
+
+    t_full = Trials()
+    make_iter(t_full, np.random.default_rng(3)).run(20)
+
+    rng = np.random.default_rng(3)
+    t_a = Trials()
+    make_iter(t_a, rng).run(12)
+    labels = Domain(obj, SPACE).cs.labels
+    ph = t_a.history_object(labels)
+    assert ph._dev is not None
+    assert ph._dev["vals"]["x"].dtype == quant.vals_dtype(qname)
+    t_b = pickle.loads(pickle.dumps(t_a))
+    assert t_b._history is None  # device codes never travel
+    make_iter(t_b, rng).run(8)
+    assert [d["misc"]["vals"] for d in t_b.trials] == \
+        [d["misc"]["vals"] for d in t_full.trials]
+    np.testing.assert_array_equal(t_b.losses(), t_full.losses())
+
+
+def test_quant_unsupported_space_degrades_to_bf16(monkeypatch):
+    # a q* family's value grid is not affine in t-space: the quantizer
+    # refuses, the WHOLE mirror degrades to bf16 (warn-once + counter),
+    # and the ask is served normally — degrade never fails an ask
+    monkeypatch.setenv("HYPEROPT_TPU_HIST_DTYPE", "int8")
+    space = {"x": hp.uniform("x", -5, 5), "q": hp.quniform("q", 0, 10, 2)}
+
+    def qobj(d):
+        return d["x"] ** 2 + 0.1 * d["q"]
+
+    before = quant.fallback_count()
+    t = Trials()
+    fmin(qobj, space, algo=rand.suggest, max_evals=8, trials=t,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    dom = Domain(qobj, space)
+    docs = tpe.suggest(t.new_trial_ids(4), dom, t, 5, n_startup_jobs=5)
+    assert len(docs) == 4
+    ph = t.history_object(dom.cs.labels)
+    assert ph.hist_dtype == "bfloat16" and ph.qparams is None
+    assert ph._dev["losses"].dtype == jnp.bfloat16
+    assert quant.fallback_count() > before
 
 
 # ---------------------------------------------------------------------------
