@@ -724,6 +724,142 @@ def bench_load_attribution(n_tells=150, repeats=5, seed=0):
     return out
 
 
+def bench_tenant_fairness(n_tells=150, repeats=5, seed=0, window_sec=1.5,
+                          noisy_threads=4):
+    """Tenant-observatory acceptance bars (ISSUE 20), two halves:
+
+    1. ``tenant_overhead_frac`` — armed-vs-disarmed ask+tell rounds
+       through the REAL handler path with an ``x-tenant`` header on
+       every request (the header is parsed on both sides; only the
+       armed side pays the ledger/sketch/gauge work).  Gated ABSOLUTE
+       at ≤5%: attribution must be noise on the ask, not a tax.
+    2. ``tenant_p99_skew`` — a light tenant's ask p99 under a noisy
+       neighbour hammering from ``noisy_threads`` concurrent studies,
+       as a multiple of the same light tenant's SOLO p99, with the DRR
+       wave packer armed and a real gather window so concurrent askers
+       coalesce into shared waves.  The acceptance bar is ≤3x; the
+       weighted-fair packer is what keeps the light tenant's tail from
+       scaling with the noisy tenant's offered load.
+    """
+    import threading
+
+    from hyperopt_tpu.obs.tenant import TenantLedger
+    from hyperopt_tpu.service.scheduler import StudyScheduler
+    from hyperopt_tpu.service.server import ServiceHTTPServer
+
+    space_spec = {"x": {"dist": "uniform", "args": [-5, 10]},
+                  "y": {"dist": "uniform", "args": [0, 15]}}
+
+    def once(armed):
+        sched = StudyScheduler(
+            wal=False, quality=False, load=False,
+            tenants=TenantLedger() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, trace=False,
+                                slo=False)
+        hdr = {"x-tenant": "bench"}
+        code, r = srv.handle("POST", "/study", {
+            "space": space_spec, "seed": seed,
+            "n_startup_jobs": n_tells + 1}, headers=hdr)
+        assert code == 200, r
+        sid = r["study_id"]
+        t0 = time.perf_counter()
+        for i in range(n_tells):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid},
+                                 headers=hdr)
+            assert code == 200, a
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": a["trials"][0]["tid"],
+                "loss": float(i % 7)}, headers=hdr)
+            assert code == 200
+        return time.perf_counter() - t0
+
+    once(False)  # warm the route/admission path for both sides
+    out = {"n_tells": n_tells, "repeats": repeats,
+           "window_sec": window_sec, "noisy_threads": noisy_threads,
+           "bar": "tenant plane <=5% per ask+tell round (absolute); "
+                  "light-tenant p99 <=3x solo under a noisy neighbour"}
+    out["tenant_off_sec"] = min(once(False) for _ in range(repeats))
+    out["tenant_on_sec"] = min(once(True) for _ in range(repeats))
+    out["tenant_overhead_frac"] = (
+        (out["tenant_on_sec"] - out["tenant_off_sec"])
+        / max(out["tenant_off_sec"], 1e-9))
+    out["tenant_overhead_us_per_ask"] = (
+        (out["tenant_on_sec"] - out["tenant_off_sec"])
+        / n_tells * 1e6)
+
+    # half 2: the noisy-neighbour mix through real waves.  A gather
+    # window makes concurrent askers coalesce into shared waves, which
+    # is where the DRR packer orders light-tenant reqs ahead of the
+    # noisy tenant's backlog; n_startup_jobs is small so asks leave the
+    # inline startup path and actually ride waves.
+    def p99(lat):
+        lat = sorted(lat)
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3
+
+    def mix(noisy):
+        sched = StudyScheduler(wal=False, quality=False, load=False,
+                               tenants=TenantLedger(),
+                               wave_window=0.005, max_pending=1 << 20)
+        srv = ServiceHTTPServer(0, scheduler=sched, trace=False,
+                                slo=False)
+
+        def new_study(name, tenant):
+            code, r = srv.handle("POST", "/study", {
+                "space": space_spec, "seed": seed, "study_id": name,
+                "n_startup_jobs": 2, "tenant": tenant})
+            assert code == 200, r
+            return r["study_id"]
+
+        light = new_study("bench-light", "light")
+        loud = [new_study(f"bench-noisy-{i}", "noisy")
+                for i in range(noisy_threads)]
+        stop = threading.Event()
+
+        def hammer(sid):
+            while not stop.is_set():
+                srv.handle("POST", "/ask", {"study_id": sid},
+                           headers={"x-tenant": "noisy"})
+
+        # warm every study past the inline startup path WITH tells, then
+        # freeze: the timed loops are ask-only, so each cohort's padded
+        # history shape never widens and no jit recompile lands inside a
+        # timed window (the tell path is the overhead half's job)
+        for sid, tenant in [(light, "light")] + [(s, "noisy")
+                                                 for s in loud]:
+            for i in range(4):
+                code, a = srv.handle("POST", "/ask", {"study_id": sid},
+                                     headers={"x-tenant": tenant})
+                assert code == 200, a
+                srv.handle("POST", "/tell", {
+                    "study_id": sid, "tid": a["trials"][0]["tid"],
+                    "loss": float(i)}, headers={"x-tenant": tenant})
+        threads = [threading.Thread(target=hammer, args=(s,), daemon=True)
+                   for s in (loud if noisy else [])]
+        for t in threads:
+            t.start()
+        lat = []
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < window_sec:
+            t1 = time.perf_counter()
+            code, a = srv.handle("POST", "/ask", {"study_id": light},
+                                 headers={"x-tenant": "light"})
+            assert code == 200, a
+            lat.append(time.perf_counter() - t1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        return p99(lat), len(lat)
+
+    solo_p99, solo_n = mix(noisy=False)
+    mixed_p99, mixed_n = mix(noisy=True)
+    out["light_solo_p99_ms"] = solo_p99
+    out["light_mixed_p99_ms"] = mixed_p99
+    out["light_solo_asks"] = solo_n
+    out["light_mixed_asks"] = mixed_n
+    out["tenant_p99_skew"] = mixed_p99 / max(solo_p99, 1e-9)
+    return out
+
+
 def bench_blackbox_probe(window_sec=2.0, repeats=2, seed=0,
                          probe_period=1.0):
     """Blackbox-prober acceptance bars (ISSUE 18), two halves:
@@ -2420,6 +2556,10 @@ _JAX_STAGES = (
     # prober armed (gated ≤5% absolute) + inject→detect wall latency of
     # a chaos-corrupted serving path
     ("blackbox_probe", bench_blackbox_probe),
+    # ISSUE 20: tenant-observatory bars — armed-vs-disarmed tenant-plane
+    # per-ask delta (gated ≤5% absolute) + the light-tenant p99 skew
+    # under a noisy neighbour with the DRR wave packer armed
+    ("tenant_fairness", bench_tenant_fairness),
 )
 
 _PROBE_SNIPPET = (
@@ -2835,6 +2975,10 @@ def main():
                 "megakernel", "megakernel_cand_per_sec"),
             "megakernel_int8_bytes_frac": _stage_val(
                 "megakernel", "megakernel_int8_bytes_frac"),
+            "tenant_overhead_frac": _stage_val(
+                "tenant_fairness", "tenant_overhead_frac"),
+            "tenant_p99_skew": _stage_val("tenant_fairness",
+                                          "tenant_p99_skew"),
             # widest mesh = the scaling design point
             "sharded_cand_per_sec": next(
                 (v for _, v in sorted(ss_by_shards.items(),

@@ -44,6 +44,10 @@ __all__ = [
     "parse_probe",
     "parse_probe_period",
     "parse_probe_slo",
+    "parse_tenant",
+    "parse_tenant_top_k",
+    "parse_tenant_quota",
+    "parse_tenant_slo",
 ]
 
 logger = logging.getLogger(__name__)
@@ -892,6 +896,119 @@ def parse_store_gc(env=None):
     env = os.environ if env is None else env
     raw = env.get("HYPEROPT_TPU_STORE_GC", "").strip().lower()
     return raw not in ("0", "off", "false", "no")
+
+
+# -- tenant observatory knobs (ISSUE 20) ------------------------------------
+
+
+def parse_tenant(env=None):
+    """``HYPEROPT_TPU_TENANT`` → whether the tenant observatory
+    (``obs/tenant.py``: per-tenant attribution, the weighted-fair wave
+    packer, per-tenant SLO objectives) is armed on the scheduler.
+    Default ON — like the cost ledger, attribution is pure arithmetic
+    on already-measured wave time (no threads, never touches
+    proposals), and a multi-tenant edge that cannot say which principal
+    is burning the fleet cannot be fair (ROADMAP 5b).  ``0``/``off``
+    disarms everything: ``scheduler.tenants is None``, first-come
+    packing, no gauges, no per-tenant SLOs."""
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_TENANT", "").strip().lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+def parse_tenant_top_k(env=None):
+    """``HYPEROPT_TPU_TENANT_TOP_K`` → the tenant ledger's named-row
+    bound (top-K by activity; everything past it rolls into the
+    ``other`` bucket).  Default 64; must be ≥ 1."""
+    from .obs.tenant import DEFAULT_TOP_K
+
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_TENANT_TOP_K", "").strip()
+    if not raw:
+        return DEFAULT_TOP_K
+    try:
+        k = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_TENANT_TOP_K", raw, "a positive integer")
+        return DEFAULT_TOP_K
+    if k < 1:
+        _warn_once("HYPEROPT_TPU_TENANT_TOP_K", raw, "a positive integer")
+        return DEFAULT_TOP_K
+    return k
+
+
+def parse_tenant_quota(env=None):
+    """``HYPEROPT_TPU_TENANT_QUOTA`` → the per-tenant admission budget:
+    the maximum asks ONE tenant may hold admitted (waiting or in a
+    wave) at once.  Past it that tenant sheds (429 + ``Retry-After``)
+    while others keep admitting — the noisy-neighbor breaker.
+
+    * unset / ``0`` / ``off`` → None (no per-tenant budget; the global
+      queue bound still applies);
+    * a positive integer → the per-tenant inflight-ask cap.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_TENANT_QUOTA", "").strip()
+    if not raw or raw.lower() in ("0", "off", "false", "no"):
+        return None
+    try:
+        q = int(raw)
+    except ValueError:
+        _warn_once("HYPEROPT_TPU_TENANT_QUOTA", raw,
+                   "a positive integer or 0/off")
+        return None
+    return q if q >= 1 else None
+
+
+def parse_tenant_slo(env=None):
+    """``HYPEROPT_TPU_TENANT_SLO`` → the per-tenant objective targets
+    installed for each top-K tenant, or None when disabled:
+
+    * unset / ``1`` / ``on`` → the defaults (:data:`~hyperopt_tpu.obs
+      .slo.TENANT_TARGETS`: 99% availability, 99% of asks under 2s,
+      ≤10% of offered asks shed — per tenant);
+    * ``0`` / ``off`` → None — attribution still runs, tenants just
+      do not burn error budgets;
+    * ``avail=P`` / ``ask_p=P`` / ``shed=P`` → the target fraction of
+      GOOD events per objective (in (0, 1));
+    * ``ask_ms=N`` → the per-tenant ask latency threshold in ms.
+      Malformed tokens warn once and keep the defaults.
+    """
+    env = os.environ if env is None else env
+    raw = env.get("HYPEROPT_TPU_TENANT_SLO", "").strip()
+    if raw.lower() in ("", "1", "on", "true", "yes", "auto"):
+        from .obs.slo import TENANT_TARGETS
+
+        return {k: dict(v) for k, v in TENANT_TARGETS.items()}
+    if raw.lower() in ("0", "off", "false", "no"):
+        return None
+    from .obs.slo import TENANT_TARGETS
+
+    targets = {k: dict(v) for k, v in TENANT_TARGETS.items()}
+    for token in raw.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        key, _, val = token.partition("=")
+        key = key.strip().lower()
+        try:
+            v = float(val)
+        except ValueError:
+            _warn_once("HYPEROPT_TPU_TENANT_SLO", token,
+                       "a key=number token")
+            continue
+        if key == "avail" and 0.0 < v < 1.0:
+            targets["availability"]["target"] = v
+        elif key == "ask_p" and 0.0 < v < 1.0:
+            targets["ask_p99"]["target"] = v
+        elif key == "ask_ms" and v > 0:
+            targets["ask_p99"]["threshold_ms"] = v
+        elif key == "shed" and 0.0 < v < 1.0:
+            targets["shed_rate"]["target"] = v
+        else:
+            _warn_once("HYPEROPT_TPU_TENANT_SLO", token,
+                       "avail/ask_p/shed=<frac in (0,1)> or ask_ms=<ms>")
+    return targets
 
 
 _CACHE_CONFIGURED = False

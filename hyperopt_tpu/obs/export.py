@@ -226,6 +226,31 @@ def to_trace_events(records, pid=0, name=None):
                     "ts": _us(ts), "pid": pid, "tid": _TID_COUNTERS,
                     "cat": "load", "args": {"heat_ms": v},
                 })
+            # per-tenant counter tracks (ISSUE 20): each tenant's
+            # cumulative device time plotted alongside the shard heat —
+            # from the service.tenant.<t>.device_ms gauges or the
+            # snapshot's own `tenants.table`
+            ten_ms = {}
+            for src in (snap.get("metrics") or {},
+                        (snap.get("sections") or {}).get("service") or {}):
+                for mname, v in src.items():
+                    if (mname.startswith("service.tenant.")
+                            and mname.endswith(".device_ms")
+                            and isinstance(v, (int, float))):
+                        t = mname[len("service.tenant."):
+                                  -len(".device_ms")]
+                        ten_ms[t] = float(v)
+            ten_tbl = (snap.get("tenants") or {}).get("table") or {}
+            for t, row in ten_tbl.items():
+                if isinstance(row, dict) and row.get("device_ms") is not None:
+                    ten_ms.setdefault(str(t), float(row["device_ms"]))
+            for t, v in sorted(ten_ms.items()):
+                used_tracks.add(_TID_COUNTERS)
+                events.append({
+                    "name": f"tenant.{t}", "ph": "C",
+                    "ts": _us(ts), "pid": pid, "tid": _TID_COUNTERS,
+                    "cat": "tenant", "args": {"device_ms": v},
+                })
 
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": name or f"stream-{pid}"}}]

@@ -1031,6 +1031,60 @@ def render_fleet_load(store_root, width=24):
     return "\n".join(out) + "\n"
 
 
+def render_tenants(source, width=24):
+    """The per-tenant attribution view (ISSUE 20).  ``source`` is
+    either a merged tenant STATUS dict (``GET /tenants`` /
+    ``/snapshot``'s ``tenants`` section — full columns) or a store root
+    (str — durable fleet-merged tenant heat from the heat ledgers,
+    device-time only).  One row per tenant: a budget bar of its share
+    of attributed device time, plus asks/tells/sheds and the ask-p99
+    column when known; a NOISY-TENANT banner flags a tenant holding
+    over half the fleet's attributed time while others wait."""
+    out = ["== tenants " + "=" * 53]
+    if isinstance(source, str):
+        from .tenant import read_tenant_heat
+
+        heat = read_tenant_heat(source)["tenants"]
+        table = {t: {"device_ms": ms} for t, ms in heat.items()}
+        out.append(f"  store {source}   (durable tenant heat; arm "
+                   "HYPEROPT_TPU_TENANT + _LOAD for live columns)")
+    else:
+        status = source or {}
+        table = dict(status.get("table") or {})
+        out.append(f"  tracked {status.get('tenants', len(table))}"
+                   f"   top-K {status.get('top_k', '?')}"
+                   f"   evictions {status.get('evictions', 0)}"
+                   f"   sheds {status.get('sheds', 0)}")
+    if not table:
+        out.append("  (no tenant attribution yet — is the service "
+                   "serving with HYPEROPT_TPU_TENANT armed?)")
+        return "\n".join(out) + "\n"
+    total = sum(float(r.get("device_ms") or 0.0)
+                for r in table.values()) or 1.0
+    w = min(24, max(len(t) for t in table) + 2)
+    out.append(f"  {'tenant':<{w}} {'device':>8}  {'share':<14}  "
+               f"{'asks':>6} {'tells':>6} {'sheds':>6}  ask_p99")
+    noisy = None
+    for t in sorted(table,
+                    key=lambda k: -float(table[k].get("device_ms") or 0)):
+        r = table[t]
+        ms = float(r.get("device_ms") or 0.0)
+        share = ms / total
+        if noisy is None and share > 0.5 and len(table) > 1:
+            noisy = (t, share)
+        p99 = r.get("ask_p99_ms")
+        out.append(
+            f"  {t[:w]:<{w}} {ms / 1e3:>7.1f}s  [{_bar(share, 10)}]  "
+            f"{r.get('asks', '-'):>6} {r.get('tells', '-'):>6} "
+            f"{r.get('sheds', '-'):>6}  "
+            + (f"{p99:.0f}ms" if p99 is not None else "-"))
+    if noisy is not None:
+        out.append(f"  NOISY-TENANT {noisy[0]!r} holds {noisy[1]:.0%} of "
+                   f"attributed device time (fair-share packing + "
+                   f"HYPEROPT_TPU_TENANT_QUOTA bound it)")
+    return "\n".join(out) + "\n"
+
+
 def _profile_section(profile_recs, out):
     """On-demand / stall device captures recorded by obs/profiler.py: the
     pointers from this stream to its device-timeline artifacts."""
@@ -1670,6 +1724,12 @@ def main(argv=None):
                         "heat ledgers under STORE_ROOT/fleet/heat/: merged "
                         "per-shard heat with sparklines, replica busy "
                         "fractions, and a SKEW banner on imbalance")
+    p.add_argument("--tenants", metavar="SRC", default=None,
+                   help="render the per-tenant attribution view: SRC is "
+                        "a store root (durable fleet-merged tenant heat "
+                        "from the heat ledgers) or a JSON file holding a "
+                        "GET /tenants (or /snapshot) payload — budget "
+                        "bars per tenant + a NOISY-TENANT banner")
     p.add_argument("--probes", metavar="PATH", default=None,
                    help="render the blackbox-probe verdict view from the "
                         "durable probe ledger(s): a <replica>.jsonl "
@@ -1702,6 +1762,40 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
         sys.stdout.write(render_probes(args.probes))
+        return 0
+    if args.tenants is not None:
+        if (args.merge or args.postmortem or args.export_trace
+                or args.trend or args.study or args.fleet):
+            print("error: --tenants is its own view; it does not combine "
+                  "with --merge/--postmortem/--export-trace/--trend/"
+                  "--study/--fleet", file=sys.stderr)
+            return 2
+        if args.format == "json":
+            # erroring beats a scripted consumer silently getting text:
+            # the live view is already served as JSON by GET /tenants
+            print("error: --tenants renders text only; for machine-"
+                  "readable tables GET /tenants or read the heat "
+                  "ledgers under fleet/heat/", file=sys.stderr)
+            return 2
+        if os.path.isdir(args.tenants):
+            sys.stdout.write(render_tenants(args.tenants))
+            return 0
+        if not os.path.exists(args.tenants):
+            print(f"error: no store root or payload file at "
+                  f"{args.tenants}", file=sys.stderr)
+            return 2
+        try:
+            with open(args.tenants, encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.tenants}: {e}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(payload, dict) and "tenants" in payload \
+                and isinstance(payload["tenants"], dict):
+            # a /snapshot (or /fleet/load) payload: unwrap its section
+            payload = payload["tenants"]
+        sys.stdout.write(render_tenants(payload))
         return 0
     if args.fleet is not None:
         if (args.merge or args.postmortem or args.export_trace

@@ -63,8 +63,8 @@ import time
 from collections import deque
 
 __all__ = ["SLOPlane", "Objective", "DEFAULT_TARGETS", "QUALITY_TARGETS",
-           "LOAD_TARGETS", "PROBE_TARGETS", "WINDOWS", "FAST_BURN",
-           "SLOW_BURN"]
+           "LOAD_TARGETS", "PROBE_TARGETS", "TENANT_TARGETS", "WINDOWS",
+           "FAST_BURN", "SLOW_BURN"]
 
 logger = logging.getLogger(__name__)
 
@@ -127,6 +127,20 @@ PROBE_TARGETS = {
     "probe_avail": {"target": 0.99},
     "probe_golden_match": {"target": 0.999},
     "probe_ask_p99_ms": {"target": 0.99, "threshold_ms": 2000.0},
+}
+
+
+#: per-tenant golden-signal objectives (ISSUE 20,
+#: ``HYPEROPT_TPU_TENANT_SLO``) — installed per TOP-K tenant as
+#: ``tenant:<id>:<name>`` via :meth:`SLOPlane.add_objective` at
+#: gauge-refresh time (idempotent; the cardinality bound on the tenant
+#: ledger bounds the objective count too), fed pre-judged booleans via
+#: :meth:`SLOPlane.record_event` from the server's response path.
+#: Probe-tagged canary traffic never reaches them.
+TENANT_TARGETS = {
+    "availability": {"target": 0.99},
+    "ask_p99": {"target": 0.99, "threshold_ms": 2000.0},
+    "shed_rate": {"target": 0.90},
 }
 
 
@@ -308,6 +322,20 @@ class SLOPlane:
             if obj is None:
                 return
             obj.record(bool(balanced), now)
+        self._maybe_evaluate(now)
+
+    def record_event(self, objective, ok, now=None):
+        """Feed one pre-judged boolean into any installed objective by
+        name (the per-tenant ``tenant:<id>:<name>`` objectives ride
+        this — the server judges good/bad from the response it already
+        has and this plane only does the burn math).  No-op when the
+        objective was never installed."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            obj = self.objectives.get(str(objective))
+            if obj is None:
+                return
+            obj.record(bool(ok), now)
         self._maybe_evaluate(now)
 
     def record_probe(self, objective, ok, now=None):

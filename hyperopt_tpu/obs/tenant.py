@@ -1,0 +1,475 @@
+"""Tenant observatory: per-tenant attribution for the serving edge
+(ISSUE 20).
+
+ROADMAP item 5(b)'s fairness bug at high fan-out: the scheduler packs
+waves first-come and the admission guard sheds globally, so one noisy
+tenant starves everyone — and nothing in the stack could even *say
+which tenant* was burning the fleet.  Every aggregate the repo keeps is
+per-study or per-shard; this module adds the per-principal dimension:
+
+**The tenant id** is opaque, bounded and sanitized
+(:func:`sanitize_tenant`): a string of ≤ :data:`MAX_TENANT_LEN`
+printable characters, default ``"anon"``.  Hostile values (control
+bytes, non-strings, over-length) raise ``ValueError`` — the HTTP layer
+maps that to 400, never 500.  The id is minted client-side
+(``ServiceClient(tenant=...)`` stamps the ``x-tenant`` header on EVERY
+request), accepted on ``POST /study``, carried on the study registry
+and the WAL admit record (an OPTIONAL ``kwargs`` field, the canary
+idiom — journals written before the field existed replay bitwise).
+
+**The tenant ledger** (:class:`TenantLedger`, owned by the scheduler
+exactly like the cost ledger) is fed at the same chokepoints: the wave
+path's measured dispatch+readback share, ``_apply_tell``, and the
+server's response observation (ask latency + sheds).  O(1) per-tenant
+rows — ``{studies, asks, tells, sheds, device_ms, hbm_bytes,
+ask-latency quantile sketch, activity EWMA}`` — under a HARD
+cardinality bound: at most ``top_k`` named rows (K default 64) plus an
+``other`` roll-up bucket.  A tenant-id bomb (10k distinct ids) evicts
+the least-active row into ``other`` instead of growing memory; totals
+are conserved across eviction.
+
+**Actionability** rides the same measurement: the admission guard
+takes per-tenant budgets (per-tenant 429 + ``Retry-After`` while other
+tenants admit), and the scheduler's wave packer orders requests by
+deficit-round-robin over tenants weighted by the inverse of each
+tenant's EWMA'd device_ms share (:meth:`TenantLedger.drr_order`).
+Packing ORDER only: per-id PRNG keys derive from the id value and the
+study seed, never slot position or wave composition, so reordering is
+proposal-invariant — armed == disarmed bit-identical, pinned directly
+and over HTTP.  Disarmed (``HYPEROPT_TPU_TENANT=off``) means
+``scheduler.tenants is None``: zero threads, zero allocations, one
+``is None`` check on the wave path.
+
+Fleet durability piggybacks the heat ledger (ISSUE 17): each cumulative
+heat record optionally carries a ``tenants`` table; old readers ignore
+the unknown field, :func:`read_tenant_heat` MAX-merges it per
+(shard, tenant) and sums across shards — the ``GET /fleet/load`` and
+``obs.report --tenants`` view.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+
+__all__ = [
+    "ANON",
+    "OTHER",
+    "MAX_TENANT_LEN",
+    "DEFAULT_TOP_K",
+    "sanitize_tenant",
+    "TenantRow",
+    "TenantLedger",
+    "merge_status",
+    "read_tenant_heat",
+]
+
+logger = logging.getLogger(__name__)
+
+#: the default principal: requests and studies that never named one
+ANON = "anon"
+
+#: the roll-up bucket evicted/overflow tenants charge into — a RESERVED
+#: id (sanitize_tenant refuses it from clients so the bucket can never
+#: be impersonated)
+OTHER = "other"
+
+#: hard length bound on a tenant id (satellite 1)
+MAX_TENANT_LEN = 128
+
+#: default named-row bound (``HYPEROPT_TPU_TENANT_TOP_K``)
+DEFAULT_TOP_K = 64
+
+#: activity-EWMA weight — same memory as the cost ledger's busy EWMA
+DEFAULT_ALPHA = 0.3
+
+#: latency-sketch ring bound per tenant row (most-recent observations;
+#: the same bounded-percentile discipline as obs.metrics.Histogram)
+SKETCH_LEN = 256
+
+
+def sanitize_tenant(value, default=ANON):
+    """Validate one tenant id → its canonical string, or raise
+    ``ValueError`` (the HTTP layer answers 400 — never 500 — on it).
+
+    Rules (satellite 1, the hostile-id hardening):
+
+    * ``None`` / empty string → ``default`` (``"anon"``);
+    * must be ``str`` (bytes, ints, dicts and lists are client bugs,
+      not principals);
+    * length ≤ :data:`MAX_TENANT_LEN`;
+    * no control bytes (ord < 32 or 127) — ids land in access logs,
+      JSONL ledgers and HTTP headers, where a newline is an injection;
+    * the reserved ``other`` bucket cannot be claimed by a client.
+    """
+    if value is None:
+        return default
+    if not isinstance(value, str):
+        raise ValueError(
+            f"tenant id must be a string, got {type(value).__name__}")
+    if value == "":
+        return default
+    if len(value) > MAX_TENANT_LEN:
+        raise ValueError(
+            f"tenant id too long ({len(value)} > {MAX_TENANT_LEN})")
+    for ch in value:
+        o = ord(ch)
+        if o < 32 or o == 127:
+            raise ValueError(
+                f"tenant id contains control byte 0x{o:02x}")
+    if value == OTHER:
+        raise ValueError(
+            f"tenant id {OTHER!r} is reserved for the roll-up bucket")
+    return value
+
+
+def _metric_label(tenant):
+    """Metric-name-safe tenant label (the gauges surface as
+    ``hyperopt_tpu_service_tenant_*`` families and must lint)."""
+    return "".join(c if c.isalnum() or c == "_" else "_"
+                   for c in str(tenant))
+
+
+class TenantRow:
+    """One tenant's accumulated attribution.  All mutators are O(1),
+    no I/O, no RNG — pure arithmetic on already-measured quantities."""
+
+    __slots__ = ("tenant", "studies", "asks", "tells", "sheds",
+                 "device_ms", "hbm_bytes", "ewma_ms", "deficit",
+                 "_lat")
+
+    def __init__(self, tenant):
+        self.tenant = tenant
+        self.studies = 0
+        self.asks = 0
+        self.tells = 0
+        self.sheds = 0
+        self.device_ms = 0.0
+        self.hbm_bytes = 0.0
+        self.ewma_ms = 0.0       # activity EWMA of attributed ms/tick
+        self.deficit = 0.0       # deficit-round-robin credit (packer)
+        self._lat = deque(maxlen=SKETCH_LEN)  # ask latency sketch (ms)
+
+    def charge(self, share_ms, k, hbm_bytes, alpha):
+        """Fold this tenant's K-row share of one cohort tick."""
+        self.device_ms += share_ms
+        self.asks += k
+        self.hbm_bytes += hbm_bytes
+        self.ewma_ms = alpha * share_ms + (1.0 - alpha) * self.ewma_ms
+
+    def observe_latency(self, latency_ms):
+        self._lat.append(float(latency_ms))
+
+    def absorb(self, other):
+        """Fold another row's totals into this one (eviction into the
+        ``other`` bucket) — totals are conserved, the sketch is not
+        (a percentile over mixed evicted tenants would mean nothing)."""
+        self.studies += other.studies
+        self.asks += other.asks
+        self.tells += other.tells
+        self.sheds += other.sheds
+        self.device_ms += other.device_ms
+        self.hbm_bytes += other.hbm_bytes
+        self.ewma_ms = max(self.ewma_ms, other.ewma_ms)
+
+    def _lat_pct(self, p):
+        ring = sorted(self._lat)
+        if not ring:
+            return None
+        return ring[min(len(ring) - 1, int(p * (len(ring) - 1) + 0.5))]
+
+    def status_dict(self):
+        out = {
+            "studies": self.studies,
+            "asks": self.asks,
+            "tells": self.tells,
+            "sheds": self.sheds,
+            "device_ms": round(self.device_ms, 3),
+            "hbm_bytes": round(self.hbm_bytes, 1),
+            "ewma_ms": round(self.ewma_ms, 3),
+        }
+        p50, p99 = self._lat_pct(0.5), self._lat_pct(0.99)
+        if p50 is not None:
+            out["ask_p50_ms"] = round(p50, 3)
+            out["ask_p99_ms"] = round(p99, 3)
+        return out
+
+
+class TenantLedger:
+    """Per-scheduler tenant attribution (zero threads), the cost
+    ledger's sibling: wave/tell mutations arrive under the scheduler's
+    RLock so the hot path is lock-free; the ledger's own lock guards
+    only row admission/eviction.  Scrape-side reads are deliberately
+    unlocked (a scrape racing a wave sees the tick one charge early or
+    late, both true).
+
+    The HARD cardinality bound: at most ``top_k`` named rows plus the
+    ``other`` bucket.  A charge for an unseen tenant past the bound
+    evicts the least-active named row (minimum activity EWMA,
+    tenant-name tie-break for determinism) into ``other`` — so a 10k-id
+    bomb churns one row, never grows the table.  ``anon`` and ``other``
+    are never evicted."""
+
+    def __init__(self, metrics=None, top_k=None, alpha=DEFAULT_ALPHA):
+        self.metrics = metrics
+        self.top_k = DEFAULT_TOP_K if top_k is None else max(1, int(top_k))
+        self.alpha = float(alpha)
+        self._rows = {}
+        self._lock = threading.Lock()
+        self.evictions = 0
+        # scheduler-level totals (attributed — they sum to the measured
+        # tick times exactly, like the cost ledger's)
+        self.device_ms = 0.0
+        self.asks = 0
+        self.tells = 0
+        self.sheds = 0
+
+    # -- row admission under the cardinality bound -------------------------
+
+    def _row(self, tenant):
+        row = self._rows.get(tenant)
+        if row is not None:
+            return row
+        with self._lock:
+            row = self._rows.get(tenant)
+            if row is not None:
+                return row
+            named = [t for t in self._rows if t != OTHER]
+            if len(named) >= self.top_k and tenant != OTHER:
+                # evict the least-active named row into `other` —
+                # `anon` is a principal like any other here, but a
+                # fresh ledger always has room for it before the bound
+                victim = min(
+                    (t for t in named),
+                    key=lambda t: (self._rows[t].ewma_ms, t))
+                other = self._rows.get(OTHER)
+                if other is None:
+                    other = TenantRow(OTHER)
+                    self._rows[OTHER] = other
+                other.absorb(self._rows.pop(victim))
+                self.evictions += 1
+            row = TenantRow(tenant)
+            self._rows[tenant] = row
+            return row
+
+    # -- the chokepoint hooks ----------------------------------------------
+
+    def note_study(self, tenant):
+        """One study admitted (create or WAL replay — the tenant table
+        is REBUILT from admit records on resume, satellite 4)."""
+        self._row(tenant).studies += 1
+
+    def observe_tick(self, entries, device_sec, hbm_bytes=0.0):
+        """Attribute one measured cohort tick.  ``entries`` is
+        ``[(tenant, k_rows), ...]``; each tenant is charged
+        ``k_i / sum(k)`` of the tick.  Called under the scheduler RLock;
+        never touches proposals."""
+        total_k = 0
+        for _, k in entries:
+            total_k += k
+        if total_k <= 0:
+            return
+        ms = float(device_sec) * 1e3
+        inv = 1.0 / total_k
+        for tenant, k in entries:
+            share = k * inv
+            self._row(tenant).charge(ms * share, k, hbm_bytes * share,
+                                     self.alpha)
+        self.device_ms += ms
+        self.asks += total_k
+
+    def observe_tell(self, tenant):
+        """One settled tell (canary excluded by the caller; replayed
+        tells COUNT — they are the crash-resume rebuild)."""
+        self.tells += 1
+        self._row(tenant).tells += 1
+
+    def observe_request(self, tenant, latency_sec=None, shed=False):
+        """One finished HTTP ask, from the server's response path
+        (probe traffic excluded by the caller, exactly as it is from
+        the tenant SLOs)."""
+        row = self._row(tenant)
+        if shed:
+            self.sheds += 1
+            row.sheds += 1
+        elif latency_sec is not None:
+            row.observe_latency(float(latency_sec) * 1e3)
+
+    def forget_study(self, tenant):
+        """One study closed/forgotten — the studies gauge tracks LIVE
+        studies; accumulated cost stays (history, not occupancy)."""
+        row = self._rows.get(tenant)
+        if row is not None and row.studies > 0:
+            row.studies -= 1
+
+    # -- the weighted-fair packer's inputs ----------------------------------
+
+    def drr_order(self, tenants):
+        """Deficit-round-robin serving order over ``tenants`` (any
+        iterable, duplicates ignored): tenants earn credit inversely
+        proportional to their EWMA'd device_ms share, so a light tenant
+        outranks a noisy one until the noisy one's history decays.
+        Returns the tenants sorted most-deserving first; mutates the
+        rows' persistent deficit counters (bounded — deficits live on
+        the bounded row table).  Pure arithmetic on already-measured
+        charge history: never reads the RNG, never changes WHAT is
+        proposed, only the packing order."""
+        uniq = []
+        seen = set()
+        for t in tenants:
+            if t not in seen:
+                seen.add(t)
+                uniq.append(t)
+        if len(uniq) <= 1:
+            return uniq
+        rows = {t: self._row(t) for t in uniq}
+        mean_ms = sum(r.ewma_ms for r in rows.values()) / len(rows)
+        for t in uniq:
+            # quantum: inverse activity share, normalized so an evenly
+            # loaded set earns 1.0 each (plain round-robin)
+            r = rows[t]
+            r.deficit += (mean_ms + 1e-6) / (r.ewma_ms + 1e-6)
+        order = sorted(uniq,
+                       key=lambda t: (-rows[t].deficit, t))
+        # the served (front) tenant spends one unit of credit; deficits
+        # are clamped so an idle tenant cannot bank unbounded priority
+        rows[order[0]].deficit -= 1.0
+        for t in uniq:
+            r = rows[t]
+            if r.deficit > 64.0:
+                r.deficit = 64.0
+            elif r.deficit < -64.0:
+                r.deficit = -64.0
+        return order
+
+    # -- pull-based publication --------------------------------------------
+
+    def status(self):
+        """The tenant roll-up (``GET /tenants`` + ``/snapshot``
+        section): totals plus the bounded per-tenant table, most
+        active first."""
+        rows = list(self._rows.values())
+        table = {}
+        for row in sorted(rows, key=lambda r: (-r.device_ms, r.tenant)):
+            table[row.tenant] = row.status_dict()
+        return {
+            "tenants": len(rows),
+            "top_k": self.top_k,
+            "evictions": self.evictions,
+            "device_ms": round(self.device_ms, 3),
+            "asks": self.asks,
+            "tells": self.tells,
+            "sheds": self.sheds,
+            "table": table,
+        }
+
+    def publish(self):
+        """Refresh the ``service.tenant.*`` gauges (scrape/snapshot
+        time, the cost ledger's pull-based discipline) and return
+        :meth:`status`."""
+        st = self.status()
+        if self.metrics is not None:
+            g = self.metrics.gauge
+            g("service.tenant.tracked").set(st["tenants"])
+            g("service.tenant.evictions").set(st["evictions"])
+            g("service.tenant.sheds").set(st["sheds"])
+            for tenant, row in st["table"].items():
+                base = f"service.tenant.{_metric_label(tenant)}"
+                g(f"{base}.device_ms").set(row["device_ms"])
+                g(f"{base}.asks").set(row["asks"])
+                g(f"{base}.tells").set(row["tells"])
+                g(f"{base}.sheds").set(row["sheds"])
+                g(f"{base}.studies").set(row["studies"])
+                if row.get("ask_p99_ms") is not None:
+                    g(f"{base}.ask_p99_ms").set(row["ask_p99_ms"])
+        return st
+
+    def heat_table(self):
+        """The per-tenant cumulative device_ms table one heat-ledger
+        record carries (``tenants`` field, ISSUE-17 records; unknown to
+        old readers, MAX-merged by :func:`read_tenant_heat`)."""
+        return {row.tenant: round(row.device_ms, 3)
+                for row in self._rows.values()}
+
+    def study_status(self, tenant):
+        row = self._rows.get(tenant)
+        return None if row is None else row.status_dict()
+
+
+def merge_status(statuses):
+    """Merge per-scheduler :meth:`TenantLedger.status` dicts (a fleet
+    replica runs one ledger per adopted shard) into the replica-level
+    view: summed totals and the merged per-tenant table (still bounded:
+    each input is)."""
+    statuses = [s for s in statuses if s]
+    if not statuses:
+        return None
+    out = {"tenants": 0, "evictions": 0, "device_ms": 0.0,
+           "asks": 0, "tells": 0, "sheds": 0, "table": {}}
+    top_k = 0
+    for s in statuses:
+        top_k = max(top_k, int(s.get("top_k") or 0))
+        for k in ("evictions", "asks", "tells", "sheds"):
+            out[k] += int(s.get(k) or 0)
+        out["device_ms"] += float(s.get("device_ms") or 0.0)
+        for tenant, row in (s.get("table") or {}).items():
+            cur = out["table"].setdefault(tenant, {
+                "studies": 0, "asks": 0, "tells": 0, "sheds": 0,
+                "device_ms": 0.0, "hbm_bytes": 0.0, "ewma_ms": 0.0})
+            for k in ("studies", "asks", "tells", "sheds"):
+                cur[k] += int(row.get(k) or 0)
+            for k in ("device_ms", "hbm_bytes"):
+                cur[k] += float(row.get(k) or 0.0)
+            cur["ewma_ms"] = max(cur["ewma_ms"],
+                                 float(row.get("ewma_ms") or 0.0))
+            # shards tick independently; report the WORST tail seen
+            if row.get("ask_p99_ms") is not None:
+                cur["ask_p99_ms"] = max(
+                    float(cur.get("ask_p99_ms") or 0.0),
+                    float(row["ask_p99_ms"]))
+                cur.setdefault("ask_p50_ms", row.get("ask_p50_ms"))
+    out["tenants"] = len(out["table"])
+    out["top_k"] = top_k
+    out["device_ms"] = round(out["device_ms"], 3)
+    for cur in out["table"].values():
+        cur["device_ms"] = round(cur["device_ms"], 3)
+        cur["hbm_bytes"] = round(cur["hbm_bytes"], 1)
+        cur["ewma_ms"] = round(cur["ewma_ms"], 3)
+    return out
+
+
+def read_tenant_heat(store_root):
+    """The fleet-merged per-tenant heat view from the durable heat
+    ledgers: heat records optionally carry a cumulative ``tenants``
+    table per (shard, replica) snapshot — take the MAX per
+    (shard, tenant) across records (cumulative snapshots, the shard
+    heat discipline), then SUM across shards per tenant.  Tolerant of
+    pre-ISSUE-20 records (no ``tenants`` field) and unreadable ledgers
+    — the view must never fail a request."""
+    from .load import _iter_heat_records
+
+    per_shard = {}  # (shard, tenant) -> max cumulative device_ms
+    try:
+        for _fname, rec, _status in _iter_heat_records(store_root):
+            if rec is None or rec.get("kind") != "heat":
+                continue
+            table = rec.get("tenants")
+            if not isinstance(table, dict):
+                continue
+            shard = rec.get("shard")
+            for tenant, ms in table.items():
+                try:
+                    ms = float(ms)
+                except (TypeError, ValueError):
+                    continue
+                key = (shard, str(tenant))
+                if ms > per_shard.get(key, 0.0):
+                    per_shard[key] = ms
+    except Exception:  # noqa: BLE001 - fail-open read
+        logger.warning("tenant heat: ledger read failed (continuing "
+                       "with what parsed)", exc_info=True)
+    tenants = {}
+    for (_shard, tenant), ms in per_shard.items():
+        tenants[tenant] = round(tenants.get(tenant, 0.0) + ms, 3)
+    return {"tenants": tenants}

@@ -370,6 +370,30 @@ def _render_service_source(name, snap, out, w):
             pline += (f"  MISMATCH x{probes['escalations']} "
                       "(golden-stream divergence)")
         out.append(pline)
+    # the TENANT row (ISSUE 20): who is consuming this server — tracked
+    # tenant count, the dominant tenant's device-time share, and shed
+    # pressure, from /snapshot's tenants section (tenant-armed servers)
+    ten = snap.get("tenants")
+    if ten and ten.get("tenants"):
+        tline = (f"  {'':<{w}}  TENANT  tracked {ten.get('tenants', 0)}"
+                 f"  asks {ten.get('asks', 0)}"
+                 f"  dev {float(ten.get('device_ms', 0.0)):.0f}ms")
+        table = ten.get("table") or {}
+        total_ms = sum(float(r.get("device_ms") or 0.0)
+                       for r in table.values())
+        top_t = max(table.items(),
+                    key=lambda kv: float(kv[1].get("device_ms") or 0.0),
+                    default=None)
+        if top_t is not None and total_ms > 0:
+            share = float(top_t[1].get("device_ms") or 0.0) / total_ms
+            tline += f"  top {top_t[0][:24]} ({share:.0%})"
+            if share > 0.5 and len(table) > 1:
+                tline += "  NOISY"
+        if ten.get("sheds"):
+            tline += f"  sheds {ten['sheds']}"
+        if ten.get("evictions"):
+            tline += f"  evicted {ten['evictions']}"
+        out.append(tline)
     degrade = snap.get("degrade")
     if degrade and (degrade.get("level") or degrade.get("faults")):
         out.append(f"  {'':<{w}}  ladder {degrade.get('name', '?')}"

@@ -263,6 +263,18 @@ KEY_DIRECTIONS = {
     # stay <= 0.3x f32 or quantization stopped paying for its cap.
     "megakernel_int8_bytes_frac": {"direction": "lower", "threshold": 0.30,
                                    "absolute": True},
+    # tenant-fairness skew (bench.py tenant_fairness stage, ISSUE 20):
+    # light-tenant ask p99 under a 10:1 noisy neighbour, as a multiple
+    # of the light tenant's solo p99, with the DRR packer armed.  The
+    # acceptance bar is 3x; the loose trajectory bar catches the packer
+    # silently degenerating to first-come order, not shared-hardware
+    # tail noise.
+    "tenant_p99_skew": {"direction": "lower", "threshold": 0.50},
+    # armed-vs-disarmed tenant-plane per-ask delta through the real
+    # handle() path — the same 5% absolute acceptance bar as the other
+    # planes: attribution + DRR must be noise on the ask, not a tax.
+    "tenant_overhead_frac": {"direction": "lower", "threshold": 0.05,
+                             "absolute": True},
 }
 
 #: metrics mined from a bench round's recorded output tail (the same
@@ -294,7 +306,8 @@ TAIL_METRICS = ("trials_per_sec", "candidates_per_sec", "cv_fits_per_sec",
                 "quality_overhead_frac",
                 "attribution_overhead_frac", "shard_heat_skew",
                 "probe_detection_latency_sec", "probe_overhead_frac",
-                "megakernel_cand_per_sec", "megakernel_int8_bytes_frac")
+                "megakernel_cand_per_sec", "megakernel_int8_bytes_frac",
+                "tenant_p99_skew", "tenant_overhead_frac")
 
 
 def trajectory_path(root=None):

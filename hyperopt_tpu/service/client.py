@@ -85,8 +85,10 @@ class ServiceClient:
     max_hops = 4
 
     def __init__(self, url, retry=None, timeout=60.0, deadline_ms=None,
-                 sleep=time.sleep, key=0, trace=None, headers=None):
+                 sleep=time.sleep, key=0, trace=None, headers=None,
+                 tenant=None):
         from .._env import parse_reqtrace
+        from ..obs.tenant import ANON, sanitize_tenant
 
         urls = [url] if isinstance(url, str) else list(url)
         self.urls = [str(u).rstrip("/") for u in urls]
@@ -95,6 +97,15 @@ class ServiceClient:
         # server-side tenant SLO objectives); attempt-scoped headers
         # (traceparent) still layer on top
         self.headers = dict(headers or {})
+        # tenant identity (ISSUE 20): sanitized client-side (same rules
+        # the server enforces — fail fast at construction, not per
+        # request) and stamped on EVERY request via the static headers,
+        # so mid-study traffic (ask/tell/close), retries and 307 fleet
+        # redirects all attribute to the same principal.  "anon" sends
+        # no header — the wire stays byte-identical to pre-ISSUE-20.
+        self.tenant = sanitize_tenant(tenant)
+        if self.tenant != ANON:
+            self.headers.setdefault("x-tenant", self.tenant)
         self.retry = (RetryPolicy(max_retries=5, base_delay=0.2,
                                   max_delay=5.0)
                       if retry is None else RetryPolicy.coerce(retry))
@@ -307,6 +318,11 @@ class ServiceClient:
             body["space"] = space
         if zoo is not None:
             body["zoo"] = zoo
+        if self.tenant != "anon":
+            # explicit in the body too (the header already rides): the
+            # admit record's tenant must survive any proxy that strips
+            # unknown request headers
+            body.setdefault("tenant", self.tenant)
         status, payload = self.request("POST", "/study", body)
         if status != 200:
             raise ServiceUnavailable(

@@ -411,7 +411,7 @@ class FleetReplica:
         # attributed (best-effort: HeatLedger.append absorbs OSError)
         if sched.load is not None:
             try:
-                self.heat.append(sched.load.heat_record())
+                self.heat.append(self._heat_rec(sched))
             except Exception:  # noqa: BLE001
                 logger.warning("fleet: heat flush for %s failed",
                                _shard_name(shard), exc_info=True)
@@ -614,10 +614,26 @@ class FleetReplica:
             if sched.load is None:
                 continue
             try:
-                self.heat.append(sched.load.heat_record())
+                self.heat.append(self._heat_rec(sched))
             except Exception:  # noqa: BLE001
                 logger.warning("fleet: heat roll-up for %s failed",
                                _shard_name(shard), exc_info=True)
+
+    @staticmethod
+    def _heat_rec(sched):
+        """One scheduler's heat-ledger record, with the per-tenant heat
+        table piggybacked (ISSUE 20) when the tenant plane is armed —
+        an OPTIONAL field pre-ISSUE-20 readers ignore, MAX-merged by
+        ``obs.tenant.read_tenant_heat``."""
+        rec = sched.load.heat_record()
+        if sched.tenants is not None:
+            try:
+                table = sched.tenants.heat_table()
+                if table:
+                    rec["tenants"] = table
+            except Exception:  # noqa: BLE001 - heat stays load-only
+                pass
+        return rec
 
     def manage_once(self):
         """Reclaim stale leases fleet-wide (adopting what we freed
@@ -765,6 +781,23 @@ class FleetReplica:
             # what obs/top.py's FLEET row and the load smoke read
             out["load"] = {"heat_ms": round(heat_ms, 3),
                            "busy_frac": round(busy, 4)}
+        tracked = sheds = evictions = 0
+        any_tenants = False
+        with self._lock:
+            for sched in self.schedulers.values():
+                if sched.tenants is None:
+                    continue
+                any_tenants = True
+                try:
+                    ts = sched.tenants.status()
+                    tracked = max(tracked, ts["tenants"])
+                    sheds += ts["sheds"]
+                    evictions += ts["evictions"]
+                except Exception:  # noqa: BLE001 - fail-open roll-up
+                    pass
+        if any_tenants:
+            out["tenants"] = {"tracked": tracked, "sheds": sheds,
+                              "evictions": evictions}
         # replica -> advertised addr, from the published ownership
         # table: the `obs.top --fleet <seed-url>` discovery seam (the
         # `replicas` list above is ids only)
@@ -784,7 +817,7 @@ class FleetReplica:
         FLEET row reads."""
         with self._lock:
             scheds = dict(self.schedulers)
-        studies, cohorts = [], []
+        studies, cohorts, tenant_stats = [], [], []
         n_slots = n_live = 0
         wal = None
         for shard in sorted(scheds):
@@ -796,6 +829,8 @@ class FleetReplica:
                 n_live += c["n_live"]
             if st.get("wal"):
                 wal = st["wal"]  # representative; healthz has all
+            if st.get("tenants"):
+                tenant_stats.append(st["tenants"])
         from ..algos import tpe
 
         out = {
@@ -808,6 +843,13 @@ class FleetReplica:
             "draining": self._draining,
             "fleet": self.healthz(),
         }
+        if tenant_stats:
+            from ..obs.tenant import merge_status
+
+            try:
+                out["tenants"] = merge_status(tenant_stats)
+            except Exception:  # noqa: BLE001 - fail-open roll-up
+                pass
         if wal is not None:
             out["wal"] = wal
         return out

@@ -148,7 +148,9 @@ class Study:
 
     def __init__(self, study_id, space, seed=0, n_startup_jobs=None,
                  max_trials=None, trials=None, space_spec=None,
-                 canary=False, **tpe_kwargs):
+                 canary=False, tenant=None, **tpe_kwargs):
+        from ..obs.tenant import ANON, sanitize_tenant
+
         self.study_id = study_id
         # canary (ISSUE 18): a synthetic blackbox-prober study.  Serves
         # EXACTLY like a tenant study (same ask/tell/WAL path — that is
@@ -157,6 +159,13 @@ class Study:
         # bank, so canary traffic is free.  Round-trips through the WAL
         # admit record like every other admit kwarg.
         self.canary = bool(canary)
+        # tenant (ISSUE 20): the opaque principal the study's device
+        # time, tells and sheds are attributed to.  Bounded + sanitized
+        # here too (a direct-API caller gets the same ValueError the
+        # HTTP layer maps to 400); "anon" is the default principal and
+        # is NOT stamped into the admit kwargs, so pre-ISSUE-20
+        # journals — and tenantless new ones — stay byte-identical.
+        self.tenant = sanitize_tenant(tenant)
         self.domain = Domain(None, space)
         self.trials = trials if trials is not None else Trials()
         self.rstate = np.random.default_rng(seed)
@@ -170,6 +179,8 @@ class Study:
         self.admit_kwargs = {}
         if self.canary:
             self.admit_kwargs["canary"] = True
+        if self.tenant != ANON:
+            self.admit_kwargs["tenant"] = self.tenant
         if n_startup_jobs is not None:
             self.admit_kwargs["n_startup_jobs"] = int(n_startup_jobs)
         if max_trials is not None:
@@ -334,6 +345,12 @@ class Study:
             # only stamped on synthetic prober studies — tenant status
             # payloads stay byte-for-byte what they always were
             out["canary"] = True
+        from ..obs.tenant import ANON
+
+        if self.tenant != ANON:
+            # same conditional-stamp rule: anonymous studies keep the
+            # pre-ISSUE-20 status payload byte-for-byte
+            out["tenant"] = self.tenant
         return out
 
 
@@ -813,14 +830,15 @@ class StudyScheduler:
     def __init__(self, max_studies=None, max_pending=None, idle_sec=None,
                  store_root=None, wave_window=0.0, wal=None, degrade=None,
                  overload=None, auto_resume=True, compile_plane=None,
-                 widen=None, quality=None, load=None):
+                 widen=None, quality=None, load=None, tenants=None):
         from .._env import (parse_compile_plane, parse_compile_widen,
                             parse_load, parse_quality, parse_service_degrade,
                             parse_service_idle_sec,
                             parse_service_max_pending,
                             parse_service_max_studies,
                             parse_service_wal, parse_store_gc,
-                            parse_store_watermark)
+                            parse_store_watermark, parse_tenant,
+                            parse_tenant_top_k)
 
         self.max_studies = (parse_service_max_studies()
                             if max_studies is None else int(max_studies))
@@ -954,6 +972,25 @@ class StudyScheduler:
         else:
             self.load = load
 
+        # tenant observatory (ISSUE 20): None resolves
+        # HYPEROPT_TPU_TENANT (default ON — same wave-time arithmetic
+        # shape as the cost ledger, bounded top-K rows, never feeds
+        # proposals), False disarms (`self.tenants is None` — the wave
+        # path pays one identity check and allocates nothing), an
+        # instance arms explicitly.  Built BEFORE auto_resume: replayed
+        # admits + tells ARE the crash-resume rebuild of the tenant
+        # tables (unlike heat there is no durable tenant-inherit path).
+        if tenants is None:
+            from ..obs.tenant import TenantLedger
+
+            self.tenants = (TenantLedger(metrics=self.metrics,
+                                         top_k=parse_tenant_top_k())
+                            if parse_tenant() else None)
+        elif tenants is False:
+            self.tenants = None
+        else:
+            self.tenants = tenants
+
         self.last_resume = None  # stats dict of the latest WAL replay
         if auto_resume and self.journal is not None:
             self.resume()
@@ -1013,6 +1050,16 @@ class StudyScheduler:
             st.note("admit", trace=trace,
                     replay=True if _replay else None)
             self._studies[study_id] = st
+            if self.tenants is not None and not st.canary:
+                # replay INCLUDED: WAL replay is how crash-resume
+                # rebuilds the tenant tables (admit kwargs carry the
+                # tenant).  Canary traffic is free here exactly as in
+                # the quality and cost planes.
+                try:
+                    self.tenants.note_study(st.tenant)
+                except Exception as e:  # noqa: BLE001
+                    logging.getLogger(__name__).warning(
+                        "tenant note_study failed: %s", e)
             self.metrics.counter("service.studies_created").inc()
             self.metrics.gauge("service.studies_live").set(live + 1)
             return study_id
@@ -1034,6 +1081,11 @@ class StudyScheduler:
                                                            trace=trace))
                 self.journal.sync()
             st.note("close", trace=trace)
+            if self.tenants is not None and not st.canary:
+                try:
+                    self.tenants.forget_study(st.tenant)
+                except Exception:
+                    pass
             self._evict_from_cohort(st)
             self._gc_cohorts()
             self.metrics.gauge("service.studies_live").set(
@@ -1623,36 +1675,47 @@ class StudyScheduler:
                 r.error = e
 
     def _charge_wave(self, cohort, cohort_reqs, device_sec):
-        """Feed one cohort tick to the cost ledger (ISSUE 17): the
-        measured dispatch+readback seconds, attributed across the
-        tick's studies by their K-row share.  Armed path only (callers
-        guard on ``self.load is not None``); a ledger fault is absorbed
-        — cost accounting must never fail a wave — and the ledger never
-        touches the reqs' docs/seeds, so armed proposals stay
-        bit-identical to disarmed (the standing obs invariant)."""
-        try:
-            # canary reqs are never charged: probe traffic must read as
-            # free in the cost observatory (it is synthetic, and billing
-            # it would skew every per-study share on a quiet fleet)
-            entries = [(r.study.study_id, len(r.new_ids))
-                       for r in cohort_reqs if not r.study.canary]
-            if not entries:
-                return
-            n_ask = 0
-            for _, k in entries:
-                n_ask += k
-            cand = float(n_ask * cohort.cfg.get("n_EI_candidates", 24))
-            # cohort history footprint the tick streamed: per label an
-            # f32 vals plane + a bool active plane, plus the f32 losses
-            # + bool has_loss planes — all [n_slots, cap]
-            hbm = float(cohort.n_slots * cohort.cap
-                        * (len(cohort.cs.labels) * 5 + 5))
-            self.load.observe_tick(entries, device_sec, cand=cand,
-                                   hbm_bytes=hbm,
-                                   cohort=f"cap{cohort.cap}")
-        except Exception as e:  # noqa: BLE001
-            logging.getLogger(__name__).warning(
-                "load observe_tick failed: %s", e)
+        """Feed one cohort tick to the cost ledger (ISSUE 17) and the
+        tenant ledger (ISSUE 20): the measured dispatch+readback
+        seconds, attributed across the tick's studies (resp. tenants)
+        by their K-row share.  Armed path only (callers guard on either
+        plane being armed); a ledger fault is absorbed — attribution
+        must never fail a wave — and neither ledger touches the reqs'
+        docs/seeds, so armed proposals stay bit-identical to disarmed
+        (the standing obs invariant)."""
+        # canary reqs are never charged: probe traffic must read as
+        # free in the cost observatory (it is synthetic, and billing
+        # it would skew every per-study share on a quiet fleet)
+        billable = [r for r in cohort_reqs if not r.study.canary]
+        if not billable:
+            return
+        # cohort history footprint the tick streamed: per label an
+        # f32 vals plane + a bool active plane, plus the f32 losses
+        # + bool has_loss planes — all [n_slots, cap]
+        hbm = float(cohort.n_slots * cohort.cap
+                    * (len(cohort.cs.labels) * 5 + 5))
+        if self.load is not None:
+            try:
+                entries = [(r.study.study_id, len(r.new_ids))
+                           for r in billable]
+                n_ask = 0
+                for _, k in entries:
+                    n_ask += k
+                cand = float(n_ask * cohort.cfg.get("n_EI_candidates", 24))
+                self.load.observe_tick(entries, device_sec, cand=cand,
+                                       hbm_bytes=hbm,
+                                       cohort=f"cap{cohort.cap}")
+            except Exception as e:  # noqa: BLE001
+                logging.getLogger(__name__).warning(
+                    "load observe_tick failed: %s", e)
+        if self.tenants is not None:
+            try:
+                self.tenants.observe_tick(
+                    [(r.study.tenant, len(r.new_ids)) for r in billable],
+                    device_sec, hbm_bytes=hbm)
+            except Exception as e:  # noqa: BLE001
+                logging.getLogger(__name__).warning(
+                    "tenant observe_tick failed: %s", e)
 
     def _retry_cohort_down_ladder(self, cohort, cohort_reqs, mesh, exc):
         """A cohort tick device-faulted: walk the ladder down and retry
@@ -1734,6 +1797,27 @@ class StudyScheduler:
         # entering low-space compacts + GCs before any shed is armed
         self._check_store()
         self.evict_idle()
+        # either attribution plane armed → measure tick wall time
+        charge = self.load is not None or self.tenants is not None
+        if self.tenants is not None and len(reqs) > 1:
+            # weighted-fair packing (ISSUE 20): stable-reorder the wave
+            # by deficit-round-robin over tenants so a light tenant's
+            # asks pack ahead of a noisy one's backlog.  Stable per
+            # tenant → stable per study (a study has ONE tenant), so the
+            # first-come one-ask-per-study round split below picks the
+            # same req per study; only the packing ORDER changes — and
+            # per-id PRNG keys never depend on order, so proposals stay
+            # bit-identical to the unfair packer (pinned by test).
+            try:
+                order = self.tenants.drr_order(
+                    [r.study.tenant for r in reqs])
+                rank = {t: i for i, t in enumerate(order)}
+                reqs = sorted(reqs,
+                              key=lambda r: rank.get(r.study.tenant,
+                                                     len(rank)))
+            except Exception as e:  # noqa: BLE001 - packing is advisory
+                logging.getLogger(__name__).warning(
+                    "tenant drr_order failed (first-come order): %s", e)
         while reqs:
             this_round, leftover, seen = [], [], set()
             for r in reqs:
@@ -1769,8 +1853,7 @@ class StudyScheduler:
                 # readback seconds per cohort tick.  Disarmed pays one
                 # `is None` check and allocates nothing (0.0 is a code
                 # constant; the dispatched tuple exists either way).
-                t_c = (time.perf_counter() if self.load is not None
-                       else 0.0)
+                t_c = time.perf_counter() if charge else 0.0
                 try:
                     packed = self._dispatch_cohort(
                         cohort, cohort_reqs, mesh, spec)
@@ -1778,34 +1861,32 @@ class StudyScheduler:
                     wave_faults += self._retry_cohort_down_ladder(
                         cohort, cohort_reqs, mesh, e)
                     served_any = True
-                    if self.load is not None:
+                    if charge:
                         self._charge_wave(cohort, cohort_reqs,
                                           time.perf_counter() - t_c)
                     continue
                 if packed is None:  # ladder floor: host-side service
                     self._serve_cohort_host_side(cohort_reqs)
                     served_any = True
-                    if self.load is not None:
+                    if charge:
                         # host-side service spends no device time; the
                         # charge still counts the asks/waves so /studies
                         # cost columns cover rand-floor studies too
                         self._charge_wave(cohort, cohort_reqs, 0.0)
                     continue
-                dt_disp = (time.perf_counter() - t_c
-                           if self.load is not None else 0.0)
+                dt_disp = (time.perf_counter() - t_c if charge else 0.0)
                 dispatched.append((cohort, cohort_reqs, mesh, packed,
                                    dt_disp))
             # readback phase: block per cohort, build and land the docs
             for cohort, cohort_reqs, mesh, packed, dt_disp in dispatched:
                 served_any = True
-                t_c = (time.perf_counter() if self.load is not None
-                       else 0.0)
+                t_c = time.perf_counter() if charge else 0.0
                 try:
                     self._readback_cohort(cohort, cohort_reqs, packed)
                 except Exception as e:  # noqa: BLE001 - runtime XLA error
                     wave_faults += self._retry_cohort_down_ladder(
                         cohort, cohort_reqs, mesh, e)
-                if self.load is not None:
+                if charge:
                     self._charge_wave(
                         cohort, cohort_reqs,
                         dt_disp + (time.perf_counter() - t_c))
@@ -2092,6 +2173,15 @@ class StudyScheduler:
             except Exception as e:  # noqa: BLE001 - never fail a tell
                 logging.getLogger(__name__).warning(
                     "load observe_tell failed: %s", e)
+        if self.tenants is not None and not st.canary:
+            # replayed tells COUNT here (unlike the cost ledger): the
+            # tenant table has no durable inherit path — WAL replay IS
+            # the crash-resume rebuild (satellite 4)
+            try:
+                self.tenants.observe_tell(st.tenant)
+            except Exception as e:  # noqa: BLE001 - never fail a tell
+                logging.getLogger(__name__).warning(
+                    "tenant observe_tell failed: %s", e)
         if (st.max_trials is not None
                 and st.n_trials >= st.max_trials and st.n_pending == 0):
             st.state = "done"
@@ -2472,6 +2562,14 @@ class StudyScheduler:
                     except Exception as e:  # noqa: BLE001
                         logging.getLogger(__name__).warning(
                             "quality observe_tell failed: %s", e)
+                if self.tenants is not None and not st.canary:
+                    # the tenant table rebuilds from replay on BOTH tell
+                    # branches — store-ahead tells count too
+                    try:
+                        self.tenants.observe_tell(st.tenant)
+                    except Exception as e:  # noqa: BLE001
+                        logging.getLogger(__name__).warning(
+                            "tenant observe_tell failed: %s", e)
                 if (st.max_trials is not None
                         and st.n_trials >= st.max_trials
                         and st.n_pending == 0):
@@ -2611,6 +2709,8 @@ class StudyScheduler:
                 "studies": studies,
                 "draining": self._draining,
             }
+            if self.tenants is not None:
+                out["tenants"] = self.tenants.status()
             if self._quarantined:
                 out["quarantined"] = {
                     sid: info.get("reason")

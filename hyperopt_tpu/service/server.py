@@ -83,6 +83,7 @@ import time
 
 from ..obs import reqtrace
 from ..obs.serve import prometheus_text, split_hostport
+from ..obs.tenant import ANON, sanitize_tenant
 from ..obs.trace import JsonlSink, Tracer
 from ..exceptions import StoreFullError
 from .fleet import ShardNotOwned, ShardUnavailable
@@ -100,7 +101,7 @@ logger = logging.getLogger(__name__)
 
 _STUDY_KWARGS = ("n_startup_jobs", "max_trials", "prior_weight",
                  "n_EI_candidates", "gamma", "linear_forgetting",
-                 "ei_select", "ei_tau", "prior_eps", "canary")
+                 "ei_select", "ei_tau", "prior_eps", "canary", "tenant")
 
 
 class _RequestError(Exception):
@@ -228,6 +229,18 @@ class ServiceHTTPServer:
                     self.slo.add_objective(name, spec)
                 self.load_skew_max = l_targets.get(
                     "imbalance", {}).get("skew_max")
+        # per-tenant SLO objectives (ISSUE 20): the targets grammar
+        # (HYPEROPT_TPU_TENANT_SLO) judged server-side per finished ask;
+        # objectives install lazily (`tenant:<id>:<name>`) and ONLY for
+        # up to top-K tenants — the burn-rate plane's cardinality stays
+        # bounded exactly like the tenant ledger's
+        self.tenant_slo = None
+        self._tenant_objs = set()
+        if self.slo is not None:
+            from .._env import parse_tenant_slo, parse_tenant_top_k
+
+            self.tenant_slo = parse_tenant_slo()
+            self._tenant_obj_bound = parse_tenant_top_k()
         # opt-in structured access log (JSONL; one record per request)
         log_path = (parse_service_access_log() if access_log is None
                     else (access_log or None))
@@ -257,7 +270,8 @@ class ServiceHTTPServer:
         so a client can correlate its own retries, including through a
         429/503."""
         headers = headers or {}
-        observing = self.slo is not None or self.access_log is not None
+        observing = (self.slo is not None or self.access_log is not None
+                     or bool(self._tenant_planes()))
         if not self.trace_enabled and not observing:
             # fully disarmed: the pre-PR handler path, nothing extra
             status, payload = self._handle(method, path, body, headers)
@@ -286,19 +300,32 @@ class ServiceHTTPServer:
             # by the sanitizer) — the client's own correlation token
             payload.setdefault("request_id", req_id)
         self._count_response(method, path, status)
+        try:
+            # hostile ids already answered 400 inside _handle; they are
+            # attributed to no one (a row minted per hostile id would BE
+            # the cardinality bomb the ledger bounds against)
+            tenant = sanitize_tenant(headers.get("x-tenant"))
+        except ValueError:
+            tenant = None
         self._observe_response(method, path, status, latency, payload,
                                ctx, req_id,
-                               probe=headers.get("x-probe") == "1")
+                               probe=headers.get("x-probe") == "1",
+                               tenant=tenant)
         return status, payload
 
     def _observe_response(self, method, path, status, latency_sec,
-                          payload, ctx, req_id, probe=False):
+                          payload, ctx, req_id, probe=False, tenant=None):
         """Post-response observability: feed the SLO plane and write the
         access-log record (JSONL + flight ring).  Never raises.
         ``probe`` marks blackbox-prober traffic (the ``x-probe: 1``
         header): it must NOT feed the server-side tenant SLO objectives
         — the prober judges itself through its own ``probe_*``
-        objectives — but it stays in the access log, tagged."""
+        objectives — but it stays in the access log, tagged.  ``tenant``
+        (ISSUE 20) is the request's sanitized principal (None = hostile
+        header, already 400d): it feeds the tenant ledger's ask-latency
+        sketch + shed counters and the per-tenant SLO objectives, with
+        probe traffic excluded from BOTH, exactly as from the global
+        tenant SLOs."""
         ep = self._endpoint_label(method, path)
         shed = bool(status == 429 and isinstance(payload, dict)
                     and payload.get("retry_after") is not None)
@@ -315,6 +342,12 @@ class ServiceHTTPServer:
                     self._slo_warned = True
                     logger.warning("slo plane record failed (continuing)",
                                    exc_info=True)
+        if tenant is not None and not probe and ep == "ask":
+            try:
+                self._observe_tenant(tenant, payload, status,
+                                     latency_sec, shed)
+            except Exception:  # noqa: BLE001 - observability never fails a req
+                pass
         if self.access_log is None:
             return
         try:
@@ -324,6 +357,10 @@ class ServiceHTTPServer:
                    "trace": ctx.trace_id if ctx is not None else None}
             if probe:
                 rec["probe"] = True
+            if tenant is not None and tenant != ANON:
+                # the access log's tenant column; anonymous records stay
+                # byte-identical to pre-ISSUE-20
+                rec["tenant"] = tenant
             if req_id:
                 rec["request_id"] = req_id
             if isinstance(payload, dict):
@@ -395,7 +432,7 @@ class ServiceHTTPServer:
         unbounded metric families)."""
         known = ("/study", "/ask", "/tell", "/close", "/studies",
                  "/metrics", "/snapshot", "/healthz", "/fleet/load",
-                 "/probes", "/")
+                 "/probes", "/tenants", "/")
         if path in known:
             return path.strip("/").replace("/", "_") or "root"
         if _timeline_study_id(path) is not None:
@@ -461,6 +498,14 @@ class ServiceHTTPServer:
             out["store"] = store
             if store.get("store_full"):
                 out["ok"] = False
+        if sched.tenants is not None:
+            try:  # tenant column (ISSUE 20): roll-up only, fail-open
+                ts = sched.tenants.status()
+                out["tenants"] = {"tracked": ts["tenants"],
+                                  "sheds": ts["sheds"],
+                                  "evictions": ts["evictions"]}
+            except Exception:  # noqa: BLE001
+                pass
         out["ok"] = out["ok"] and not sched._draining
         if self.prober is not None:
             out["probe"] = self.prober.healthz_fields()
@@ -473,9 +518,15 @@ class ServiceHTTPServer:
 
     def _handle(self, method, path, body, headers):
         try:
+            # hostile-tenant hardening (ISSUE 20): a malformed
+            # ``x-tenant`` answers 400 on EVERY route (the ValueError
+            # maps below) — never 500, never a minted ledger row
+            tenant = sanitize_tenant(headers.get("x-tenant"))
             if method == "GET":
                 if path == "/studies":
                     return 200, self._studies_status()
+                if path == "/tenants":
+                    return 200, self.tenants_dict()
                 if path == "/healthz":
                     return 200, self.healthz_dict()
                 if path == "/snapshot":
@@ -497,12 +548,13 @@ class ServiceHTTPServer:
                                       "GET /healthz",
                                       "GET /metrics", "GET /snapshot",
                                       "GET /fleet/load",
-                                      "GET /probes"]}
+                                      "GET /probes",
+                                      "GET /tenants"]}
                 raise _RequestError(404, f"no such endpoint: {path}")
             if method != "POST":
                 raise _RequestError(405, f"{method} not supported")
             if path == "/study":
-                return 200, self._create_study(body)
+                return 200, self._create_study(body, tenant)
             if path == "/ask":
                 study_id = self._required(body, "study_id")
                 sched = self._route(study_id)
@@ -517,12 +569,12 @@ class ServiceHTTPServer:
                     req_id = None
                 deadline = Deadline.from_request(
                     headers.get("x-deadline-ms"), self.default_deadline_ms)
-                token = self.guard.admit_ask(deadline)
+                token = self.guard.admit_ask(deadline, tenant=tenant)
                 try:
                     trials = sched.ask(study_id, n, deadline=deadline,
                                        req_id=req_id)
                 finally:
-                    self.guard.release(token)
+                    self.guard.release(token, tenant=tenant)
                 out = {"ok": True, "study_id": study_id,
                        "trials": [{k: t[k] for k in
                                    ("tid", "params", "degraded", "algo",
@@ -656,7 +708,7 @@ class ServiceHTTPServer:
             raise _RequestError(400, f"missing required field {key!r}")
         return v
 
-    def _create_study(self, body):
+    def _create_study(self, body, header_tenant=ANON):
         if "space" in body:
             space = space_from_spec(body["space"])
             space_spec = {"space": body["space"]}
@@ -673,6 +725,12 @@ class ServiceHTTPServer:
         else:
             raise _RequestError(400, "POST /study needs 'space' or 'zoo'")
         kwargs = {k: body[k] for k in _STUDY_KWARGS if k in body}
+        # tenant (ISSUE 20): an explicit body field wins; the x-tenant
+        # header (already sanitized) covers clients that only set the
+        # ambient identity.  A hostile BODY value is rejected by
+        # ``Study.__init__``'s sanitize — ValueError → 400, never 500.
+        if "tenant" not in kwargs and header_tenant != ANON:
+            kwargs["tenant"] = header_tenant
         # the wire schema IS the WAL registry entry: every HTTP-created
         # study is crash-resumable
         if self.fleet is not None:
@@ -751,6 +809,125 @@ class ServiceHTTPServer:
             pass
         return merged
 
+    def _tenant_planes(self):
+        """Every armed tenant ledger this server fronts: one per adopted
+        shard scheduler in fleet mode, the scheduler's own otherwise."""
+        if self.fleet is not None:
+            return [s.tenants for s in self.fleet.schedulers.values()
+                    if s.tenants is not None]
+        if (self.scheduler is not None
+                and self.scheduler.tenants is not None):
+            return [self.scheduler.tenants]
+        return []
+
+    def _tenant_plane_for(self, payload):
+        """The tenant ledger the request's study lives on (fleet mode
+        routes by the payload's study id; a routing miss falls back to
+        the first armed plane — one observation lands on exactly one
+        ledger either way, and the merge sums them)."""
+        if self.fleet is None:
+            return (self.scheduler.tenants
+                    if self.scheduler is not None else None)
+        sid = (payload.get("study_id")
+               if isinstance(payload, dict) else None)
+        if sid:
+            try:
+                return self.fleet.scheduler_for(sid).tenants
+            except Exception:  # noqa: BLE001 - not owned / mid-handoff
+                pass
+        planes = self._tenant_planes()
+        return planes[0] if planes else None
+
+    def _observe_tenant(self, tenant, payload, status, latency_sec,
+                        shed):
+        """One finished (non-probe) ask's tenant accounting: the
+        ledger's latency/shed row plus the per-tenant SLO events."""
+        plane = self._tenant_plane_for(payload)
+        if plane is not None:
+            if shed or status == 429:
+                plane.observe_request(tenant, shed=True)
+            elif status == 200:
+                plane.observe_request(tenant, latency_sec=latency_sec)
+        if self.slo is None or not self.tenant_slo:
+            return
+        self._ensure_tenant_objectives(tenant)
+        pre = f"tenant:{tenant}:"
+        self.slo.record_event(pre + "availability", status < 500)
+        self.slo.record_event(pre + "shed_rate", not (shed
+                                                      or status == 429))
+        if status == 200:
+            thr = float(self.tenant_slo.get("ask_p99", {})
+                        .get("threshold_ms") or 2000.0)
+            self.slo.record_event(pre + "ask_p99",
+                                  latency_sec * 1e3 <= thr)
+
+    def _ensure_tenant_objectives(self, tenant):
+        """Install this tenant's burn-rate objectives once (idempotent;
+        bounded at top-K installed tenants — past the bound a new
+        tenant's traffic still counts in the LEDGER's ``other`` bucket,
+        it just gets no dedicated burn-rate alarms)."""
+        if tenant in self._tenant_objs:
+            return
+        if len(self._tenant_objs) >= self._tenant_obj_bound:
+            return
+        for name, spec in self.tenant_slo.items():
+            self.slo.add_objective(f"tenant:{tenant}:{name}", spec)
+        self._tenant_objs.add(tenant)
+
+    def _refresh_tenant_gauges(self):
+        """Scrape/snapshot-time ``service.tenant.*`` gauge refresh
+        (ISSUE 20): merge every armed ledger's status (per-shard tables
+        in fleet mode — gauges are set ONCE from the merged view, so
+        shards never overwrite each other's families) and make sure the
+        merged table's tenants have their SLO objectives installed.
+        Returns the merged status section for ``/snapshot`` +
+        ``GET /tenants``, or None when disarmed."""
+        from ..obs.tenant import _metric_label, merge_status
+
+        try:
+            merged = merge_status([p.status()
+                                   for p in self._tenant_planes()])
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            return None
+        if merged is None:
+            return None
+        try:
+            g = self.metrics.gauge
+            g("service.tenant.tracked").set(merged["tenants"])
+            g("service.tenant.evictions").set(merged["evictions"])
+            g("service.tenant.sheds").set(merged["sheds"])
+            g("service.tenant.device_ms").set(merged["device_ms"])
+            for tenant, row in merged["table"].items():
+                base = f"service.tenant.{_metric_label(tenant)}"
+                g(f"{base}.device_ms").set(row["device_ms"])
+                g(f"{base}.asks").set(row["asks"])
+                g(f"{base}.tells").set(row["tells"])
+                g(f"{base}.sheds").set(row["sheds"])
+                g(f"{base}.studies").set(row["studies"])
+                if row.get("ask_p99_ms") is not None:
+                    g(f"{base}.ask_p99_ms").set(row["ask_p99_ms"])
+            if self.slo is not None and self.tenant_slo:
+                for tenant in merged["table"]:
+                    if tenant != "other":
+                        self._ensure_tenant_objectives(tenant)
+        except Exception:  # noqa: BLE001 - fail-open scrape
+            pass
+        return merged
+
+    def tenants_dict(self):
+        """``GET /tenants``: the bounded per-tenant attribution table
+        (merged across shards in fleet mode), freshly published.
+        Disarmed servers answer ``{"armed": false}`` instead of a 404 so
+        dashboards can scrape unconditionally."""
+        out = {"ok": True, "ts": time.time(), "endpoint": "tenants"}
+        merged = self._refresh_tenant_gauges()
+        if merged is None:
+            out["armed"] = False
+            return out
+        out["armed"] = True
+        out.update(merged)
+        return out
+
     def fleet_load_dict(self):
         """``GET /fleet/load``: this replica's merged cost-attribution
         view plus the FLEET-WIDE heat table read from every replica's
@@ -758,26 +935,35 @@ class ServiceHTTPServer:
         cumulative heat (max over cumulative snapshots, so it survives
         restarts and ownership moves), per-replica latest snapshot, and
         the heat-skew scalar.  Works single-server too (no `fleet`
-        section without a store root)."""
+        section without a store root).  Carries the fleet-merged
+        per-tenant heat table (ISSUE 20) when any heat record stamps
+        one."""
         out = {"ok": True, "ts": time.time(), "endpoint": "fleet_load"}
         merged = self._refresh_load_gauges()
         if merged is not None:
             out["local"] = merged
+        ten = self._refresh_tenant_gauges()
+        if ten is not None:
+            out["tenants"] = ten
+        store_root = None
         if self.fleet is not None:
-            from ..obs.load import read_heat
-
             out["replica"] = self.fleet.replica_id
+            store_root = self.fleet.store_root
+        elif self.scheduler is not None:
+            store_root = self.scheduler.store_root
+        if store_root is not None:
+            from ..obs.load import read_heat
+            from ..obs.tenant import read_tenant_heat
+
             try:
-                out["fleet"] = read_heat(self.fleet.store_root)
+                out["fleet"] = read_heat(store_root)
             except Exception:  # noqa: BLE001 - fail-open read
                 logger.warning("fleet/load: heat-ledger read failed",
                                exc_info=True)
-        elif self.scheduler is not None \
-                and self.scheduler.store_root is not None:
-            from ..obs.load import read_heat
-
             try:
-                out["fleet"] = read_heat(self.scheduler.store_root)
+                heat = read_tenant_heat(store_root)["tenants"]
+                if heat:
+                    out["tenant_heat"] = heat
             except Exception:  # noqa: BLE001 - fail-open read
                 pass
         return out
@@ -813,6 +999,9 @@ class ServiceHTTPServer:
         load = self._refresh_load_gauges()
         if load is not None:
             out["load"] = load
+        tenants = self._refresh_tenant_gauges()
+        if tenants is not None:
+            out["tenants"] = tenants
         self._refresh_compile_gauges()
         out["sections"] = {
             "service": self.metrics.snapshot()["metrics"]}
@@ -1043,6 +1232,7 @@ def _make_handler(server):
                         pass
                     server._refresh_quality_gauges()
                     server._refresh_load_gauges()
+                    server._refresh_tenant_gauges()
                     server._refresh_store_gauges()
                     server._count_response(method, path, 200)
                     self._answer(

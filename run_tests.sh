@@ -99,6 +99,15 @@
 # heat-aware volunteer handoff must drain the hottest shard first,
 # the durable heat ledger must replay after a SIGKILL (the adopter
 # inherits the shard's heat), and zero tells may be lost throughout.
+# Opt-in tenant gate: TENANT_GATE=1 additionally re-runs the tenant-
+# observatory suites and then scripts/tenant_smoke.py — a real
+# subprocess server under a ~10:1 adversarial tenant mix: the light
+# tenant's ask p99 stays bounded vs its own solo baseline, the noisy
+# tenant trips its per-tenant ask budget with typed 429s carrying
+# Retry-After, GET /tenants serves the bounded attribution table,
+# /metrics lints with the service_tenant_* roll-up families
+# (validate_scrape.py --require-tenant), probe traffic never mints a
+# tenant row, zero tells are lost, and SIGTERM drains cleanly.
 PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
 rc=$?
 [ "$rc" -ne 0 ] && exit "$rc"
@@ -193,6 +202,12 @@ if [ "${KERNEL_GATE:-0}" = "1" ]; then
         python -m pytest tests/test_megakernel.py tests/test_shard_suggest.py \
         tests/test_batched_suggest.py tests/test_journal.py -q || exit 1
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/kernel_smoke.py || exit 1
+fi
+if [ "${TENANT_GATE:-0}" = "1" ]; then
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_tenant.py tests/test_overload.py \
+        tests/test_service.py -q || exit 1
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/tenant_smoke.py || exit 1
 fi
 if [ "${PROBE_GATE:-0}" = "1" ]; then
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -145,6 +145,30 @@ def validate_probe_families(text):
     return errors
 
 
+#: the tenant-observatory gauge families (ISSUE 20) a tenant-armed
+#: server must expose after serving traffic: the ledger roll-up gauges
+#: refreshed on every scrape.  Per-tenant families
+#: (``hyperopt_tpu_service_tenant_<label>_*``) are table-lazy, so only
+#: the unconditional roll-ups are required.
+TENANT_FAMILIES = (
+    "hyperopt_tpu_service_tenant_tracked",
+    "hyperopt_tpu_service_tenant_evictions",
+    "hyperopt_tpu_service_tenant_sheds",
+)
+
+
+def validate_tenant_families(text):
+    """Full exposition lint PLUS presence of every tenant roll-up gauge
+    family — the check a tenant-armed scrape must pass (empty =
+    valid)."""
+    errors = validate_metrics_text(text)
+    names = {name for name, _ in parse_samples(text)}
+    for fam in TENANT_FAMILIES:
+        if fam not in names:
+            errors.append(f"tenant-armed scrape lacks family {fam!r}")
+    return errors
+
+
 _SNAPSHOT_SECTIONS = ("report", "health", "utilization", "ask_pipeline")
 
 
@@ -311,6 +335,11 @@ def main(argv=None):
                    help="additionally require the hyperopt_tpu_probe_* "
                         "gauge families in every metrics payload (a "
                         "probe-armed server's scrape contract)")
+    p.add_argument("--require-tenant", action="store_true",
+                   help="additionally require the "
+                        "hyperopt_tpu_service_tenant_* roll-up gauge "
+                        "families in every metrics payload (a "
+                        "tenant-armed server's scrape contract)")
     args = p.parse_args(argv)
     if args.self_test:
         return _self_test()
@@ -330,8 +359,16 @@ def main(argv=None):
             except ValueError as e:
                 errors = [f"not JSON: {e}"]
         else:
-            errors = (validate_probe_families(body) if args.require_probe
-                      else validate_metrics_text(body))
+            errors = validate_metrics_text(body)
+            names = None
+            if args.require_probe or args.require_tenant:
+                names = {name for name, _ in parse_samples(body)}
+            if args.require_probe:
+                errors += [f"probe-armed scrape lacks family {fam!r}"
+                           for fam in PROBE_FAMILIES if fam not in names]
+            if args.require_tenant:
+                errors += [f"tenant-armed scrape lacks family {fam!r}"
+                           for fam in TENANT_FAMILIES if fam not in names]
         if errors:
             rc = 1
             print(f"{path}: INVALID")

@@ -1,0 +1,757 @@
+"""ISSUE 20: the tenant observatory.
+
+The acceptance pins:
+
+* tenant ids are hostile input: sanitation is a hard 400 (never a 500)
+  for control bytes, over-long ids, non-strings and the reserved
+  ``other`` bucket — and a 10k-distinct-id cardinality bomb leaves the
+  ledger bounded at top-K named rows + ``other``;
+* armed attribution + DRR packing NEVER change proposals: armed ==
+  disarmed bit-identical, directly and over HTTP — and disarmed really
+  is ``scheduler.tenants is None``: zero threads, zero allocations
+  traced to the tenant module on the serving path;
+* per-tenant admission budgets shed ONE tenant (typed 429 +
+  ``Retry-After``) while others keep admitting;
+* pre-ISSUE-20 journals (no tenant field on admit records) replay
+  bitwise on a tenant-armed scheduler, and a SIGKILLed armed run
+  resumes with its tenant table rebuilt from the admit records — no
+  new WAL record kinds;
+* the surfaces: /tenants, /snapshot + /healthz sections, the
+  ``service.tenant.*`` gauge families (scrape-lintable), fleet-merged
+  tenant heat, obs.report --tenants, the obs.top TENANT row, Perfetto
+  per-tenant counter tracks — and the new bench keys really gate.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu._env import (
+    parse_tenant,
+    parse_tenant_quota,
+    parse_tenant_slo,
+    parse_tenant_top_k,
+)
+from hyperopt_tpu.obs.slo import TENANT_TARGETS, SLOPlane
+from hyperopt_tpu.obs.tenant import (
+    ANON,
+    OTHER,
+    TenantLedger,
+    merge_status,
+    read_tenant_heat,
+    sanitize_tenant,
+)
+from hyperopt_tpu.service.overload import AdmissionGuard, OverloadError
+from hyperopt_tpu.service.scheduler import StudyScheduler
+from hyperopt_tpu.service.server import ServiceHTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+SPACE_SPEC = {"x": {"dist": "uniform", "args": [-5, 5]}}
+
+#: the hostile-id fuzz corpus: every entry must be REJECTED (ValueError
+#: direct, 400 over HTTP) without ever minting a ledger row
+HOSTILE_IDS = [
+    "a" * 129,                     # over the 128 cap
+    "evil\nname",                  # header-splitting newline
+    "evil\rname",
+    "nul\x00byte",
+    "tab\tname",                   # control byte (< 32)
+    "esc\x1b[31m",                 # terminal escape injection
+    "del\x7fchar",
+    OTHER,                         # the reserved eviction bucket
+    123, 1.5, ["a"], {"t": "x"}, True,   # non-strings
+]
+
+
+def _drive(sched, sid, n):
+    seq = []
+    for _ in range(n):
+        a = sched.ask(sid)[0]
+        seq.append((a["tid"], repr(a["params"]["x"])))
+        sched.tell(sid, a["tid"], float((a["params"]["x"] - 1.0) ** 2))
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# sanitation: tenant ids are hostile input
+# ---------------------------------------------------------------------------
+
+
+def test_sanitize_tenant_contract():
+    assert sanitize_tenant(None) == ANON
+    assert sanitize_tenant("") == ANON
+    assert sanitize_tenant(None, default=None) is None
+    assert sanitize_tenant("team-a") == "team-a"
+    assert sanitize_tenant("a" * 128) == "a" * 128    # at the cap: fine
+    assert sanitize_tenant("Ünïcode-ok") == "Ünïcode-ok"
+    for bad in HOSTILE_IDS:
+        with pytest.raises((ValueError, TypeError)):
+            sanitize_tenant(bad)
+
+
+def test_hostile_tenant_ids_400_never_500_and_mint_no_rows():
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=TenantLedger())
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    code, r = srv.handle("POST", "/study", {
+        "space": SPACE_SPEC, "seed": 1, "n_startup_jobs": 4})
+    assert code == 200
+    sid = r["study_id"]
+    for bad in HOSTILE_IDS:
+        if not isinstance(bad, str):
+            continue                     # header values are strings
+        for method, path, body in (
+                ("POST", "/ask", {"study_id": sid}),
+                ("GET", "/studies", None),
+                ("GET", "/tenants", None),
+                ("POST", "/study", {"space": SPACE_SPEC, "seed": 2})):
+            code, p = srv.handle(method, path, body,
+                                 headers={"x-tenant": bad})
+            assert code == 400, (bad, path, code, p)
+            assert p["ok"] is False
+    # hostile BODY tenants on POST /study: 400, typed, never a study
+    for bad in HOSTILE_IDS:
+        code, p = srv.handle("POST", "/study", {
+            "space": SPACE_SPEC, "seed": 3, "tenant": bad})
+        assert code == 400, (bad, code, p)
+    # nothing hostile ever minted a ledger row OR a study (the one
+    # clean study above files under anon)
+    assert set(sched.tenants.status()["table"]) <= {ANON}
+    assert len(sched._studies) == 1
+
+
+def test_cardinality_bomb_stays_bounded_at_top_k_plus_other():
+    led = TenantLedger(top_k=16)
+    for i in range(10_000):
+        led.observe_tick([(f"bot-{i:05d}", 1)], device_sec=0.001)
+    st = led.status()
+    assert st["tenants"] <= 16 + 1                    # named rows + other
+    assert OTHER in st["table"]
+    assert st["evictions"] >= 10_000 - 17
+    # totals survive eviction: every ask is still accounted somewhere
+    assert sum(r["asks"] for r in st["table"].values()) == 10_000
+    assert led.device_ms == pytest.approx(10_000 * 1.0)
+    # the default bound matches the env knob default
+    assert TenantLedger().top_k == parse_tenant_top_k({}) == 64
+
+
+# ---------------------------------------------------------------------------
+# attribution math
+# ---------------------------------------------------------------------------
+
+
+def test_tick_share_attribution_and_request_accounting():
+    led = TenantLedger()
+    led.note_study("acme")
+    led.note_study("acme")
+    led.note_study("umbrella")
+    # one 4 ms tick: acme asked 3 of the 4 rows, umbrella 1
+    led.observe_tick([("acme", 2), ("acme", 1), ("umbrella", 1)],
+                     device_sec=0.004, hbm_bytes=400.0)
+    a = led.status()["table"]["acme"]
+    assert a["device_ms"] == pytest.approx(3.0)
+    assert a["asks"] == 3 and a["studies"] == 2
+    assert a["hbm_bytes"] == pytest.approx(300.0)
+    u = led.status()["table"]["umbrella"]
+    assert u["device_ms"] == pytest.approx(1.0)
+    assert led.device_ms == pytest.approx(4.0)
+    # tells and request-level accounting ride separately
+    led.observe_tell("acme")
+    led.observe_request("acme", latency_sec=0.010)
+    led.observe_request("acme", shed=True)
+    a = led.status()["table"]["acme"]
+    assert a["tells"] == 1 and a["sheds"] == 1
+    assert a["ask_p99_ms"] == pytest.approx(10.0, rel=0.2)
+    assert led.sheds == 1
+    led.forget_study("umbrella")
+    assert led.status()["table"]["umbrella"]["studies"] == 0
+
+
+def test_drr_order_prefers_the_light_tenant():
+    led = TenantLedger()
+    for _ in range(50):
+        led.observe_tick([("noisy", 4)], device_sec=0.040)
+    led.observe_tick([("light", 1)], device_sec=0.001)
+    order = led.drr_order(["noisy", "light"])
+    assert order[0] == "light"
+    # repeated calls stay stable and bounded (the deficit clamp)
+    for _ in range(200):
+        order = led.drr_order(["noisy", "light", "noisy"])
+        assert sorted(order) == ["light", "noisy"]    # deduped
+    # degenerate shapes: unknown tenants and singletons never throw
+    assert led.drr_order([]) == []
+    assert led.drr_order(["solo"]) == ["solo"]
+    assert sorted(led.drr_order(["a", "b"])) == ["a", "b"]
+
+
+def test_merge_status_and_tenant_heat(tmp_path):
+    a, b = TenantLedger(), TenantLedger()
+    a.observe_tick([("acme", 1)], device_sec=0.009)
+    a.observe_tell("acme")
+    b.observe_tick([("acme", 1), ("umbrella", 2)], device_sec=0.003)
+    m = merge_status([a.status(), b.status(), None])
+    assert m["asks"] == 4 and m["tells"] == 1
+    assert m["device_ms"] == pytest.approx(12.0)
+    assert m["table"]["acme"]["device_ms"] == pytest.approx(10.0)
+    assert m["table"]["umbrella"]["device_ms"] == pytest.approx(2.0)
+    assert merge_status([]) is None
+
+    # the durable view piggybacks the load plane's heat records: MAX
+    # per (shard, tenant) across cumulative snapshots, SUM across shards
+    from hyperopt_tpu.obs.load import HeatLedger, heat_path_for
+
+    root = str(tmp_path)
+    led = HeatLedger(heat_path_for(root, "rep-a"))
+    led.append({"kind": "heat", "replica": "rep-a", "shard": 0,
+                "heat_ms": 10.0, "busy_frac": 0.5, "ts": 1.0,
+                "tenants": {"acme": 5.0}})
+    led.append({"kind": "heat", "replica": "rep-a", "shard": 0,
+                "heat_ms": 30.0, "busy_frac": 0.5, "ts": 2.0,
+                "tenants": {"acme": 25.0, "umbrella": 2.0}})
+    HeatLedger(heat_path_for(root, "rep-b")).append(
+        {"kind": "heat", "replica": "rep-b", "shard": 1, "heat_ms": 7.0,
+         "busy_frac": 0.1, "ts": 3.0, "tenants": {"acme": 7.0}})
+    # pre-ISSUE-20 record (no tenants field): tolerated silently
+    HeatLedger(heat_path_for(root, "rep-c")).append(
+        {"kind": "heat", "replica": "rep-c", "shard": 2, "heat_ms": 1.0,
+         "busy_frac": 0.1, "ts": 4.0})
+    heat = read_tenant_heat(root)["tenants"]
+    assert heat["acme"] == pytest.approx(32.0)        # max(5,25) + 7
+    assert heat["umbrella"] == pytest.approx(2.0)
+    assert read_tenant_heat(str(tmp_path / "empty")) == {"tenants": {}}
+
+
+def test_gauges_publish_flat_names():
+    from hyperopt_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    led = TenantLedger(metrics=reg)
+    led.observe_tick([("acme", 1)], device_sec=0.002)
+    led.observe_request("acme", shed=True)
+    led.publish()
+    snap = reg.snapshot()["metrics"]
+    assert snap["service.tenant.tracked"] == 1
+    assert snap["service.tenant.sheds"] == 1
+    assert snap["service.tenant.acme.device_ms"] == pytest.approx(2.0)
+    assert snap["service.tenant.acme.asks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# armed == disarmed: the observatory never changes proposals
+# ---------------------------------------------------------------------------
+
+
+def test_armed_equals_disarmed_bit_identical():
+    on = StudyScheduler(wal=False, quality=False, load=False,
+                        tenants=TenantLedger())
+    off = StudyScheduler(wal=False, quality=False, load=False,
+                         tenants=False)
+    assert on.tenants is not None and off.tenants is None
+    seqs = {}
+    for sched in (on, off):
+        a = sched.create_study(SPACE, seed=21, n_startup_jobs=2,
+                               study_id="st-a", tenant="acme")
+        b = sched.create_study(SPACE, seed=22, n_startup_jobs=2,
+                               study_id="st-b", tenant="umbrella")
+        seq = []
+        for _ in range(6):                 # interleaved: DRR sees both
+            seq += _drive(sched, a, 1) + _drive(sched, b, 1)
+        seqs[sched is on] = seq
+    assert seqs[True] == seqs[False]
+    st = on.tenants.status()
+    assert st["table"]["acme"]["tells"] == 6
+    assert st["table"]["acme"]["device_ms"] > 0.0
+
+
+def test_armed_equals_disarmed_over_http_and_surfaces():
+    def drive(srv, sid, tenant, n):
+        seq = []
+        for _ in range(n):
+            code, a = srv.handle("POST", "/ask", {"study_id": sid},
+                                 headers={"x-tenant": tenant})
+            assert code == 200
+            t = a["trials"][0]
+            seq.append((t["tid"], repr(t["params"]["x"])))
+            code, _ = srv.handle("POST", "/tell", {
+                "study_id": sid, "tid": t["tid"],
+                "loss": float((t["params"]["x"] - 1.0) ** 2)},
+                headers={"x-tenant": tenant})
+            assert code == 200
+        return seq
+
+    seqs = {}
+    for armed in (True, False):
+        sched = StudyScheduler(wal=False, quality=False, load=False,
+                               tenants=TenantLedger() if armed else False)
+        srv = ServiceHTTPServer(0, scheduler=sched, slo=armed,
+                                trace=False)
+        code, r = srv.handle("POST", "/study", {
+            "space": SPACE_SPEC, "seed": 33, "n_startup_jobs": 2,
+            "study_id": "st-h", "tenant": "acme"},
+            headers={"x-tenant": "ignored-when-body-wins"})
+        assert code == (200 if armed else 200)
+        seqs[armed] = drive(srv, r["study_id"], "acme", 8)
+        code, ten = srv.handle("GET", "/tenants", None)
+        assert code == 200
+        if armed:
+            assert ten["armed"] and "acme" in ten["table"]
+            assert ten["table"]["acme"]["tells"] == 8
+            snap = srv.snapshot_dict()
+            assert snap["tenants"]["table"]["acme"]["device_ms"] > 0
+            hz = srv.healthz_dict()
+            assert hz["tenants"]["tracked"] == 1
+            code, rows = srv.handle("GET", "/studies", None)
+            assert rows["studies"][0]["tenant"] == "acme"
+        else:
+            assert ten["armed"] is False and "table" not in ten
+            assert "tenants" not in srv.snapshot_dict()
+    assert seqs[True] == seqs[False]
+
+
+def test_disarmed_is_none_no_threads_no_tenant_allocations():
+    n0 = threading.active_count()
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=False)
+    assert sched.tenants is None
+    sid = sched.create_study(SPACE, seed=9, n_startup_jobs=2)
+    _drive(sched, sid, 3)                  # compile outside the trace
+    tenant_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "hyperopt_tpu", "obs", "tenant.py")
+    tracemalloc.start()
+    try:
+        _drive(sched, sid, 3)              # device waves, disarmed
+        snap = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    stats = snap.filter_traces(
+        [tracemalloc.Filter(True, tenant_py)]).statistics("filename")
+    assert stats == []                     # zero tenant-plane allocations
+    # and the armed plane spawns no threads either
+    TenantLedger().observe_tick([("a", 1)], device_sec=0.001)
+    assert threading.active_count() == n0
+
+
+def test_tenant_fault_never_fails_the_wave_or_tell():
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=TenantLedger())
+
+    def boom(*a, **kw):
+        raise RuntimeError("tenant ledger exploded")
+
+    sched.tenants.observe_tick = boom
+    sched.tenants.observe_tell = boom
+    sched.tenants.drr_order = boom
+    sched.tenants.note_study = boom
+    sid = sched.create_study(SPACE, seed=2, n_startup_jobs=1,
+                             tenant="acme")
+    seq = _drive(sched, sid, 3)            # asks past startup: device waves
+    assert len(seq) == 3
+    assert sched._studies[sid].best_loss() is not None
+
+
+# ---------------------------------------------------------------------------
+# per-tenant admission budgets
+# ---------------------------------------------------------------------------
+
+
+def test_admission_guard_per_tenant_budget():
+    g = AdmissionGuard(max_queue=100, tenant_quota=2)
+    t1 = g.admit_ask(tenant="noisy")
+    t2 = g.admit_ask(tenant="noisy")
+    with pytest.raises(OverloadError) as ei:
+        g.admit_ask(tenant="noisy")
+    assert "ask budget" in str(ei.value)
+    assert ei.value.retry_after > 0.0
+    # ...while every other tenant keeps admitting
+    t3 = g.admit_ask(tenant="light")
+    t4 = g.admit_ask()                                # anon traffic too
+    g.release(t1, tenant="noisy")
+    g.release(t2, tenant="noisy")
+    g.release(t3, tenant="light")
+    g.release(t4)
+    # drop-at-zero: the inflight map is bounded by concurrency, not
+    # by tenant cardinality
+    assert g._tenant_inflight == {}
+    g.admit_ask(tenant="noisy")                       # budget freed
+    # disarmed (the default): no quota, no map entries
+    g2 = AdmissionGuard(max_queue=4)
+    assert g2.tenant_quota is None
+    for _ in range(4):
+        g2.admit_ask(tenant="noisy")
+    assert g2._tenant_inflight == {}
+
+
+def test_per_tenant_429_rides_the_http_path():
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=TenantLedger())
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    srv.guard = AdmissionGuard(max_queue=100, tenant_quota=1,
+                               metrics=sched.metrics)
+    code, r = srv.handle("POST", "/study", {
+        "space": SPACE_SPEC, "seed": 5, "n_startup_jobs": 8,
+        "tenant": "noisy"})
+    assert code == 200
+    sid = r["study_id"]
+    held = srv.guard.admit_ask(tenant="noisy")        # hold the budget
+    code, p = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-tenant": "noisy"})
+    assert code == 429
+    assert "ask budget" in p["error"] and p["retry_after"] > 0
+    # the other tenant is untouched by noisy's exhaustion
+    code, p = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-tenant": "light"})
+    assert code == 200
+    srv.guard.release(held, tenant="noisy")
+    code, _ = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-tenant": "noisy"})
+    assert code == 200
+    # the shed was attributed to the tenant that caused it
+    assert sched.tenants.status()["table"]["noisy"]["sheds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# WAL back-compat + crash-resume
+# ---------------------------------------------------------------------------
+
+
+def test_pre_issue20_journal_replays_bitwise_on_armed_scheduler(tmp_path):
+    """A journal written with the tenant plane OFF carries no tenant
+    fields at all (the pre-ISSUE-20 shape); an armed scheduler must
+    replay it bitwise and file the studies under ``anon``."""
+    ref = StudyScheduler(wal=False, tenants=False)
+    rsid = ref.create_study(SPACE, seed=7, n_startup_jobs=3)
+    want = _drive(ref, rsid, 12)
+
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal, tenants=False)
+    sid = s1.create_study(SPACE, seed=7, n_startup_jobs=3,
+                          space_spec={"space": SPACE_SPEC},
+                          study_id="study-a")
+    first = _drive(s1, sid, 7)
+    del s1                                            # crash, no drain
+    from hyperopt_tpu.service import StudyJournal
+
+    admits = [r for r in StudyJournal(wal).records()
+              if r["kind"] == "admit"]
+    assert admits and all("tenant" not in (r.get("kwargs") or {})
+                          for r in admits)
+    s2 = StudyScheduler(wal=wal)                      # tenants armed
+    assert s2.tenants is not None
+    assert s2.last_resume["errors"] == 0
+    rest = _drive(s2, sid, 5)
+    assert first + rest == want
+    assert s2.tenants.status()["table"][ANON]["studies"] == 1
+
+
+def test_tenant_stamped_journal_rebuilds_table_on_resume(tmp_path):
+    """An armed run's admit records carry the tenant; resume rebuilds
+    the attribution table from replay (note_study + observe_tell COUNT
+    during replay — replay IS the crash-resume rebuild)."""
+    ref = StudyScheduler(wal=False, tenants=False)
+    rsid = ref.create_study(SPACE, seed=11, n_startup_jobs=3)
+    want = _drive(ref, rsid, 10)
+
+    wal = str(tmp_path / "wal.jsonl")
+    s1 = StudyScheduler(wal=wal)
+    sid = s1.create_study(SPACE, seed=11, n_startup_jobs=3,
+                          space_spec={"space": SPACE_SPEC},
+                          study_id="study-t", tenant="acme")
+    first = _drive(s1, sid, 6)
+    del s1
+    from hyperopt_tpu.service import StudyJournal
+
+    admit = next(r for r in StudyJournal(wal).records()
+                 if r["kind"] == "admit")
+    assert admit["kwargs"]["tenant"] == "acme"        # stamped, optional
+    s2 = StudyScheduler(wal=wal)
+    row = s2.tenants.status()["table"]["acme"]
+    assert row["studies"] == 1 and row["tells"] == 6  # rebuilt via replay
+    rest = _drive(s2, sid, 4)
+    assert first + rest == want
+    # and the tenant column survives onto /studies rows
+    assert s2._studies[sid].status_dict()["tenant"] == "acme"
+
+
+def test_sigkilled_armed_run_resumes_with_tenant_table(tmp_path):
+    root = str(tmp_path / "store")
+    child = (
+        "import sys\n"
+        "from hyperopt_tpu import hp\n"
+        "from hyperopt_tpu.service.scheduler import StudyScheduler\n"
+        "s = StudyScheduler(store_root=sys.argv[1])\n"
+        "spec = {'space': {'x': {'dist': 'uniform', 'args': [-5, 5]}}}\n"
+        "sid = s.create_study({'x': hp.uniform('x', -5, 5)}, seed=3,\n"
+        "                     n_startup_jobs=2, study_id='study-k',\n"
+        "                     tenant='acme', space_spec=spec)\n"
+        "print('READY', flush=True)\n"
+        "while True:\n"
+        "    a = s.ask(sid)[0]\n"
+        "    s.tell(sid, a['tid'], float(a['params']['x'] ** 2))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(filter(None, (
+                   os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))),
+                   os.environ.get("PYTHONPATH")))))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen([sys.executable, "-c", child, root], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().startswith("READY")
+        from hyperopt_tpu.service.journal import wal_path_for
+
+        wal = wal_path_for(root)
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            try:
+                if open(wal, "rb").read().count(b'"kind":"tell"') >= 4:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        else:
+            pytest.fail("child never told 4 trials")
+        proc.send_signal(signal.SIGKILL)              # mid-wave, maybe
+    finally:
+        proc.kill()
+        proc.wait()
+    s2 = StudyScheduler(store_root=root)
+    assert s2.last_resume["studies"] == 1
+    row = s2.tenants.status()["table"]["acme"]
+    assert row["studies"] == 1 and row["tells"] >= 4
+    # and serving continues under the same principal
+    a = s2.ask("study-k")[0]
+    s2.tell("study-k", a["tid"], 0.5)
+
+
+# ---------------------------------------------------------------------------
+# env knobs + per-tenant SLOs
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("HYPEROPT_TPU_TENANT", raising=False)
+    assert parse_tenant()                             # default ON
+    for off in ("0", "off", "false", "no"):
+        assert not parse_tenant({"HYPEROPT_TPU_TENANT": off})
+    assert parse_tenant({"HYPEROPT_TPU_TENANT": "1"})
+    assert parse_tenant_top_k({}) == 64
+    assert parse_tenant_top_k({"HYPEROPT_TPU_TENANT_TOP_K": "8"}) == 8
+    assert parse_tenant_top_k({"HYPEROPT_TPU_TENANT_TOP_K": "0"}) == 64
+    assert parse_tenant_top_k(
+        {"HYPEROPT_TPU_TENANT_TOP_K": "banana"}) == 64
+    assert parse_tenant_quota({}) is None             # default: no budget
+    assert parse_tenant_quota({"HYPEROPT_TPU_TENANT_QUOTA": "6"}) == 6
+    for off in ("0", "off"):
+        assert parse_tenant_quota(
+            {"HYPEROPT_TPU_TENANT_QUOTA": off}) is None
+    assert parse_tenant_quota(
+        {"HYPEROPT_TPU_TENANT_QUOTA": "banana"}) is None
+    # the SLO grammar
+    assert parse_tenant_slo({}) == TENANT_TARGETS
+    assert parse_tenant_slo({}) is not TENANT_TARGETS  # a copy
+    assert parse_tenant_slo({"HYPEROPT_TPU_TENANT_SLO": "off"}) is None
+    t = parse_tenant_slo({"HYPEROPT_TPU_TENANT_SLO":
+                          "avail=0.999,ask_ms=500"})
+    assert t["availability"]["target"] == 0.999
+    assert t["ask_p99"]["threshold_ms"] == 500.0
+    assert t["shed_rate"] == TENANT_TARGETS["shed_rate"]
+    assert parse_tenant_slo(
+        {"HYPEROPT_TPU_TENANT_SLO": "avail=banana"}) == TENANT_TARGETS
+
+
+def test_slo_record_event_and_bounded_tenant_objectives():
+    slo = SLOPlane(metrics=None, clock=lambda: 1000.0)
+    slo.add_objective("tenant:acme:availability",
+                      TENANT_TARGETS["availability"])
+    for _ in range(9):
+        slo.record_event("tenant:acme:availability", False, now=1000.0)
+    slo.record_event("tenant:acme:availability", True, now=1000.0)
+    st = slo.status(now=1000.0)["tenant:acme:availability"]
+    assert st["budget_remaining_frac"] < 1.0
+    # unknown objective: a no-op, never a KeyError
+    slo.record_event("tenant:ghost:availability", True, now=1000.0)
+
+    # the server installs objectives per request-seen tenant, bounded
+    # at top-K — past the bound new tenants attribute but don't mint
+    # objective state (the burn plane's cardinality stays bounded)
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=TenantLedger())
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False)
+    assert srv.slo is not None and srv.tenant_slo is not None
+    srv._tenant_obj_bound = 2
+    code, r = srv.handle("POST", "/study", {
+        "space": SPACE_SPEC, "seed": 8, "n_startup_jobs": 9})
+    sid = r["study_id"]
+    for t in ("t-a", "t-b", "t-c"):
+        code, _ = srv.handle("POST", "/ask", {"study_id": sid},
+                             headers={"x-tenant": t})
+        assert code == 200
+    objs = [o for o in srv.slo.objectives if o.startswith("tenant:")]
+    assert {o.split(":")[1] for o in objs} == {"t-a", "t-b"}
+    assert all(f"tenant:{t}:{k}" in srv.slo.objectives
+               for t in ("t-a", "t-b")
+               for k in ("availability", "ask_p99", "shed_rate"))
+    # probe traffic attributes to NO tenant (same exclusion as SLOs)
+    code, _ = srv.handle("POST", "/ask", {"study_id": sid},
+                         headers={"x-tenant": "canary", "x-probe": "1"})
+    assert code == 200
+    assert "canary" not in sched.tenants.status()["table"]
+
+
+# ---------------------------------------------------------------------------
+# the scrape contract + render surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_scrape_lints_with_tenant_families():
+    from hyperopt_tpu.obs.serve import prometheus_text
+    from validate_scrape import (
+        TENANT_FAMILIES,
+        validate_metrics_text,
+        validate_tenant_families,
+    )
+
+    sched = StudyScheduler(wal=False, quality=False, load=False,
+                           tenants=TenantLedger())
+    srv = ServiceHTTPServer(0, scheduler=sched, trace=False, slo=False)
+    code, r = srv.handle("POST", "/study", {
+        "space": SPACE_SPEC, "seed": 4, "n_startup_jobs": 2,
+        "tenant": "team/a b"})
+    sid = r["study_id"]
+    for _ in range(3):
+        code, a = srv.handle("POST", "/ask", {"study_id": sid},
+                             headers={"x-tenant": "team/a b"})
+        srv.handle("POST", "/tell", {
+            "study_id": sid, "tid": a["trials"][0]["tid"], "loss": 0.5},
+            headers={"x-tenant": "team/a b"})
+    srv._refresh_tenant_gauges()          # the /metrics-dispatch refresh
+    text = prometheus_text([sched.metrics.namespace])
+    assert validate_metrics_text(text) == []
+    assert validate_tenant_families(text) == []
+    for fam in TENANT_FAMILIES:
+        assert fam in text
+    # hostile-ish tenant label characters were mangled, not emitted raw
+    assert "hyperopt_tpu_service_tenant_team_a_b_asks" in text
+
+
+def test_report_tenants_view(tmp_path, capsys):
+    from hyperopt_tpu.obs.load import HeatLedger, heat_path_for
+    from hyperopt_tpu.obs.report import main, render_tenants
+
+    # dict view (a /tenants payload): full columns + the noisy banner
+    status = {
+        "tenants": 2, "top_k": 64, "evictions": 0, "device_ms": 100.0,
+        "asks": 12, "tells": 10, "sheds": 3,
+        "table": {
+            "noisy": {"device_ms": 90.0, "asks": 10, "tells": 8,
+                      "sheds": 3, "studies": 4, "hbm_bytes": 0.0,
+                      "ewma_ms": 9.0, "ask_p99_ms": 40.0},
+            "light": {"device_ms": 10.0, "asks": 2, "tells": 2,
+                      "sheds": 0, "studies": 1, "hbm_bytes": 0.0,
+                      "ewma_ms": 1.0, "ask_p99_ms": 5.0}}}
+    text = render_tenants(status)
+    assert "tenants" in text and "noisy" in text and "light" in text
+    assert "NOISY-TENANT" in text         # 90% share > the 50% banner bar
+    # store-root view: the durable fleet heat
+    root = str(tmp_path)
+    HeatLedger(heat_path_for(root, "rep-a")).append(
+        {"kind": "heat", "replica": "rep-a", "shard": 0, "heat_ms": 9.0,
+         "busy_frac": 0.1, "ts": 1.0, "tenants": {"acme": 9.0}})
+    assert "acme" in render_tenants(root)
+    payload = tmp_path / "tenants.json"
+    payload.write_text(json.dumps(status))
+    assert main(["--tenants", str(payload)]) == 0
+    assert "NOISY-TENANT" in capsys.readouterr().out
+    assert main(["--tenants", root]) == 0
+    capsys.readouterr()
+    # --tenants is its own view and text-only
+    assert main(["--tenants", root, "--trend"]) == 2
+    assert main(["--tenants", root, "--format", "json"]) == 2
+
+
+def test_top_renders_tenant_row():
+    from hyperopt_tpu.obs.top import _render_service_source
+
+    snap = {"sections": {"service": {}}, "studies": [],
+            "tenants": {"tenants": 2, "asks": 12, "device_ms": 100.0,
+                        "sheds": 3, "evictions": 1,
+                        "table": {"noisy": {"device_ms": 90.0},
+                                  "light": {"device_ms": 10.0}}}}
+    out = []
+    _render_service_source("svc", snap, out, 8)
+    row = next(line for line in out if "TENANT" in line)
+    assert "tracked 2" in row and "top noisy (90%)" in row
+    assert "NOISY" in row and "sheds 3" in row
+    # disarmed snapshots render no row
+    out2 = []
+    _render_service_source("svc", {"sections": {"service": {}},
+                                   "studies": []}, out2, 8)
+    assert not any("TENANT" in line for line in out2)
+
+
+def test_export_emits_per_tenant_counters(tmp_path):
+    from hyperopt_tpu.obs.export import write_trace
+
+    stream = [
+        {"kind": "run_meta", "ts": 1.0, "run_id": "r"},
+        {"kind": "metrics", "ts": 2.0, "snapshot": {
+            "metrics": {"service.tenant.acme.device_ms": 12.0},
+            "tenants": {"table": {"umbrella": {"device_ms": 7.0}}}}},
+    ]
+    out = str(tmp_path / "trace.json")
+    write_trace(out, [("s", iter(stream))])
+    events = json.load(open(out))["traceEvents"]
+    ten = {e["name"]: e for e in events if e.get("cat") == "tenant"}
+    assert ten["tenant.acme"]["args"]["device_ms"] == 12.0
+    assert ten["tenant.umbrella"]["args"]["device_ms"] == 7.0
+    assert all(e["ph"] == "C" for e in ten.values())
+
+
+# ---------------------------------------------------------------------------
+# the new bench keys really gate
+# ---------------------------------------------------------------------------
+
+
+def _bench_rec(ts, **keys):
+    return {"kind": "bench", "ts": ts, "backend": "cpu",
+            "source": "test", "keys": keys}
+
+
+def test_tenant_overhead_gates_absolute_from_first_run():
+    import bench_gate
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS
+
+    old = _bench_rec(0.0, trials_per_sec=100.0)       # no tenant keys yet
+    over = _bench_rec(1.0, tenant_overhead_frac=0.09)
+    regs, _ = bench_gate.windowed_compare([old], over, KEY_DIRECTIONS)
+    assert any("tenant_overhead_frac" in r for r in regs)
+    ok = _bench_rec(1.0, tenant_overhead_frac=0.04)
+    regs, _ = bench_gate.windowed_compare([old], ok, KEY_DIRECTIONS)
+    assert regs == []
+
+
+def test_tenant_p99_skew_gates_windowed_lower_is_better():
+    import bench_gate
+    from hyperopt_tpu.obs.trajectory import KEY_DIRECTIONS, TAIL_METRICS
+
+    assert "tenant_p99_skew" in TAIL_METRICS
+    assert "tenant_overhead_frac" in TAIL_METRICS
+    history = [_bench_rec(float(i), tenant_p99_skew=1.2)
+               for i in range(3)]
+    bad = _bench_rec(3.0, tenant_p99_skew=2.0)        # +67% > the 50% bar
+    regs, _ = bench_gate.windowed_compare(history, bad, KEY_DIRECTIONS)
+    assert any("tenant_p99_skew" in r for r in regs)
+    ok = _bench_rec(3.0, tenant_p99_skew=1.3)
+    regs, _ = bench_gate.windowed_compare(history, ok, KEY_DIRECTIONS)
+    assert regs == []
